@@ -1,0 +1,34 @@
+"""iterative_cleaner_tpu — a TPU-native framework for iterative RFI excision.
+
+Re-implements the capabilities of ``larskuenkel/iterative_cleaner`` (the
+coast_guard "surgical scrub" strategy, reference at
+``/root/reference/iterative_cleaner.py``) as an idiomatic JAX/XLA/Pallas
+framework: the archive cube lives in HBM and the whole
+template-subtract -> robust-stats -> threshold loop runs as one jit-compiled
+``lax.while_loop``, with ``vmap`` over subint x channel cells and masked
+median/MAD reductions that scale to 4k-channel archives.
+
+Package layout (see SURVEY.md section 7 for the design rationale):
+
+- :mod:`iterative_cleaner_tpu.archive`   — the host-side archive data model.
+- :mod:`iterative_cleaner_tpu.io`        — load/save, synthetic fixtures,
+  optional PSRCHIVE bridge, native C++ loader.
+- :mod:`iterative_cleaner_tpu.ops`       — DSP primitives (baseline removal,
+  (de)dispersion, scrunching, template fitting), written once over a numpy /
+  jax.numpy module handle.
+- :mod:`iterative_cleaner_tpu.stats`     — the "surgical scrub" detection
+  statistics; a faithful ``np.ma`` oracle and a mask-explicit JAX version.
+- :mod:`iterative_cleaner_tpu.engine`    — the iteration engine
+  (``lax.while_loop`` on the JAX path).
+- :mod:`iterative_cleaner_tpu.backends`  — backend selection (numpy oracle /
+  jax TPU path) behind one interface.
+- :mod:`iterative_cleaner_tpu.parallel`  — device-mesh sharding, batched
+  cleaning, streaming subint-chunked mode.
+- :mod:`iterative_cleaner_tpu.cli`       — the reference CLI surface
+  (flags, naming, log, zap plot) plus ``--backend``.
+"""
+
+__version__ = "0.1.0"
+
+from iterative_cleaner_tpu.archive import Archive  # noqa: F401
+from iterative_cleaner_tpu.config import CleanConfig  # noqa: F401

@@ -1,0 +1,8 @@
+"""``python -m iterative_cleaner_tpu archive...`` — the CLI entry point
+(the reference's ``__main__`` block, ``/root/reference/iterative_cleaner.py:338-340``)."""
+
+import sys
+
+from iterative_cleaner_tpu.cli import main
+
+sys.exit(main())

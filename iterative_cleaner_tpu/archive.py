@@ -1,0 +1,124 @@
+"""Host-side archive data model.
+
+The reference drives everything through PSRCHIVE ``Archive`` objects (C++;
+``/root/reference/iterative_cleaner.py:13`` and the ~20 API points catalogued
+in SURVEY.md section 2.2).  This framework instead moves the archive into a
+plain dataclass of numpy arrays at the host boundary: everything downstream
+(both backends, the JAX engine, the parallel layer) consumes the
+``(nsub, npol, nchan, nbin)`` cube, the ``(nsub, nchan)`` weight matrix, and a
+small metadata record.  The PSRCHIVE surface that the reference relies on
+(clone/pscrunch/get_weights/set_weight/...) is mirrored here as cheap array
+methods so engine code reads naturally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+# Dispersion constant: delay(s) = KDM_S * DM * f_MHz^-2, DM in pc cm^-3.
+KDM_S = 4.148808e3
+
+# Polarisation states.  "Intensity" = already total-intensity (npol==1).
+# "Stokes" = (I, Q, U, V): total intensity is component 0.
+# "Coherence" = (AA, BB, Re, Im): total intensity is AA + BB.
+POL_STATES = ("Intensity", "Stokes", "Coherence")
+
+
+@dataclasses.dataclass
+class Archive:
+    """A pulsar fold-mode archive held as host numpy arrays.
+
+    Mirrors the slice of PSRCHIVE state the reference consumes
+    (``/root/reference/iterative_cleaner.py:47,66,94,111`` etc.).
+    """
+
+    data: np.ndarray           # (nsub, npol, nchan, nbin) float
+    weights: np.ndarray        # (nsub, nchan) float
+    freqs_mhz: np.ndarray      # (nchan,) sky frequency of each channel
+    period_s: float            # folding period
+    dm: float                  # dispersion measure, pc cm^-3
+    centre_freq_mhz: float
+    source: str = "synthetic"
+    mjd_start: float = 60000.0
+    mjd_end: float = 60000.01
+    filename: str = ""
+    pol_state: str = "Intensity"
+    dedispersed: bool = False  # True once channel delays have been removed
+
+    def __post_init__(self) -> None:
+        if self.data.ndim != 4:
+            raise ValueError(f"data must be 4-D (nsub,npol,nchan,nbin), got {self.data.shape}")
+        if self.weights.shape != (self.data.shape[0], self.data.shape[2]):
+            raise ValueError(
+                f"weights shape {self.weights.shape} does not match data {self.data.shape}"
+            )
+        if self.freqs_mhz.shape != (self.data.shape[2],):
+            raise ValueError("freqs_mhz must have one entry per channel")
+        if self.pol_state not in POL_STATES:
+            raise ValueError(f"pol_state must be one of {POL_STATES}")
+
+    # -- shape accessors (PSRCHIVE get_nsubint/get_nchan/get_nbin analogues) --
+    @property
+    def nsub(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def npol(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def nchan(self) -> int:
+        return self.data.shape[2]
+
+    @property
+    def nbin(self) -> int:
+        return self.data.shape[3]
+
+    @property
+    def mjd_mid(self) -> float:
+        return 0.5 * (self.mjd_start + self.mjd_end)
+
+    # -- PSRCHIVE-surface analogues ------------------------------------------
+    def clone(self) -> "Archive":
+        """Deep copy (PSRCHIVE ``Archive::clone``, reference :71,:97,:124)."""
+        return dataclasses.replace(
+            self, data=self.data.copy(), weights=self.weights.copy(),
+            freqs_mhz=self.freqs_mhz.copy(),
+        )
+
+    def pscrunch(self) -> None:
+        """Collapse to total intensity in place (reference :70,:89,:98).
+
+        Idempotent, like PSRCHIVE's (the reference deliberately calls it
+        twice, see SURVEY.md section 2.4 quirk 11).
+        """
+        if self.npol == 1:
+            self.pol_state = "Intensity"
+            return
+        if self.pol_state == "Coherence":
+            total = self.data[:, 0:1] + self.data[:, 1:2]
+        else:  # Stokes: I is the first component
+            total = self.data[:, 0:1]
+        self.data = np.ascontiguousarray(total)
+        self.pol_state = "Intensity"
+
+    def get_weights(self) -> np.ndarray:
+        """Copy of the (nsub, nchan) weight matrix (reference :66,:79,:128)."""
+        return self.weights.copy()
+
+    def set_weight(self, isub: int, ichan: int, value: float) -> None:
+        """Per-cell weight write (reference :304-305)."""
+        self.weights[isub, ichan] = value
+
+    def total_intensity(self) -> np.ndarray:
+        """The (nsub, nchan, nbin) total-intensity cube without mutating."""
+        if self.pol_state == "Coherence" and self.npol > 1:
+            return self.data[:, 0] + self.data[:, 1]
+        return self.data[:, 0]
+
+    def display_name(self) -> str:
+        """Base name used in output naming / logs (reference :49,:72)."""
+        return os.path.basename(self.filename) if self.filename else self.source

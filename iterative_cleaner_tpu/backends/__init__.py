@@ -1,0 +1,43 @@
+"""Backend selection: numpy oracle vs compiled JAX/TPU path.
+
+Both backends implement ``clean_archive(archive, config) -> CleanResult``
+with identical observable semantics (the reference algorithm,
+``/root/reference/iterative_cleaner.py:65-178``); the numpy one is the
+float64 semantics oracle, the jax one is the production TPU path.
+"""
+
+from iterative_cleaner_tpu.backends.base import CleanResult, sweep_bad_lines  # noqa: F401
+
+
+def get_backend(name: str):
+    """Return the backend module for ``name`` ('numpy' or 'jax')."""
+    if name == "numpy":
+        from iterative_cleaner_tpu.backends import numpy_backend
+
+        return numpy_backend
+    if name == "jax":
+        from iterative_cleaner_tpu.backends import jax_backend
+
+        return jax_backend
+    raise ValueError(f"unknown backend {name!r}")
+
+
+def clean_archive(archive, config):
+    """Clean one archive with the backend selected in ``config.backend``.
+
+    Shared wrapper around the per-backend ``clean_cube``: extracts the
+    total-intensity cube, runs the iteration loop, then applies the optional
+    whole-line sweep (gated exactly as the reference does at :156)."""
+    backend = get_backend(config.backend)
+    result = backend.clean_cube(
+        archive.total_intensity(), archive.weights, archive.freqs_mhz,
+        archive.dm, archive.centre_freq_mhz, archive.period_s, config,
+    )
+    if config.bad_chan != 1 or config.bad_subint != 1:
+        swept, nbs, nbc = sweep_bad_lines(
+            result.final_weights, config.bad_subint, config.bad_chan
+        )
+        result.final_weights = swept
+        result.n_bad_subints = nbs
+        result.n_bad_channels = nbc
+    return result

@@ -1,0 +1,54 @@
+"""Shared backend types and post-loop host-side steps."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class CleanResult:
+    """Everything the reference's ``clean()`` makes observable."""
+
+    final_weights: np.ndarray        # (nsub, nchan) cleaned weight matrix
+    scores: np.ndarray               # last iteration's zap scores (ref avg_test_results)
+    loops: int                       # iterations actually run (ref :139/:146)
+    converged: bool
+    residual: Optional[np.ndarray] = None  # (nsub, nchan, nbin) pulse-free cube
+    n_bad_subints: int = 0           # whole-line removals by the bad-parts sweep
+    n_bad_channels: int = 0
+    # per-loop operator telemetry (reference :129-134): entries [0:loops]
+    loop_diffs: Optional[np.ndarray] = None      # cells changed vs previous loop
+    loop_rfi_frac: Optional[np.ndarray] = None   # zero-weight fraction
+
+    @property
+    def rfi_fraction(self) -> float:
+        """Fraction of zero-weight cells (reference :130)."""
+        w = self.final_weights
+        return float((w.size - np.count_nonzero(w)) / w.size)
+
+    def zap_mask(self) -> np.ndarray:
+        """(nsub, nchan) bool: True where the cell is zapped."""
+        return self.final_weights == 0
+
+
+def sweep_bad_lines(weights: np.ndarray, bad_subint: float, bad_chan: float):
+    """Whole-subint/channel removal (reference ``find_bad_parts``, :308-335).
+
+    Fractions are computed once on the weights as passed (the reference reads
+    ``get_weights()`` a single time at :311, before either sweep), and the
+    comparisons are strict ``>`` — so the default thresholds of 1.0 disable
+    the sweep entirely (quirk 10).  Returns (new_weights, n_bad_subints,
+    n_bad_channels).
+    """
+    nsub, nchan = weights.shape
+    subint_frac = 1.0 - np.count_nonzero(weights, axis=1) / float(nchan)
+    chan_frac = 1.0 - np.count_nonzero(weights, axis=0) / float(nsub)
+    bad_rows = subint_frac > bad_subint
+    bad_cols = chan_frac > bad_chan
+    out = weights.copy()
+    out[bad_rows, :] = 0.0
+    out[:, bad_cols] = 0.0
+    return out, int(bad_rows.sum()), int(bad_cols.sum())

@@ -1,0 +1,93 @@
+"""The compiled JAX/TPU backend.
+
+One H2D transfer of the cube, one jit-compiled program containing the whole
+preamble + iteration ``lax.while_loop``, one D2H of the (nsub, nchan) mask,
+scores and loop count (SURVEY.md section 7, "host/device boundary
+discipline").  Compiled programs are cached per static-config + shape/dtype
+combination (jit's own cache); bucketed padding for shape reuse lives in the
+parallel layer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from iterative_cleaner_tpu.backends.base import CleanResult
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.engine.loop import (
+    clean_dedispersed_jax,
+    prepare_cube_jax,
+)
+from iterative_cleaner_tpu.ops.dsp import (
+    fit_template_amplitudes,
+    rotate_bins,
+    template_residuals,
+    weighted_template,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
+                   pulse_scale, pulse_active, rotation, baseline_duty,
+                   unload_res):
+    """Build (and cache) the jitted whole-archive cleaning program for one
+    static configuration."""
+
+    def run(cube, weights, freqs_mhz, dm, ref_freq_mhz, period_s):
+        ded, shifts = prepare_cube_jax(
+            cube, freqs_mhz, dm, ref_freq_mhz, period_s,
+            baseline_duty=baseline_duty, rotation=rotation,
+        )
+        outs = clean_dedispersed_jax(
+            ded, weights, shifts,
+            max_iter=max_iter, chanthresh=chanthresh,
+            subintthresh=subintthresh, pulse_slice=pulse_slice,
+            pulse_scale=pulse_scale, pulse_active=pulse_active,
+            rotation=rotation,
+        )
+        if not unload_res:
+            return outs, None
+        # Reconstruct the last iteration's pulse-free residual (the reference
+        # clones it mid-loop at :106-108); one extra template+fit pass.
+        template = weighted_template(ded, outs.template_weights, jnp) * 10000.0
+        amps = fit_template_amplitudes(ded, template, jnp)
+        resid = template_residuals(
+            ded, template, amps, pulse_slice, pulse_scale, jnp, pulse_active
+        )
+        resid = rotate_bins(resid, shifts, jnp, method=rotation)
+        return outs, resid
+
+    return jax.jit(run)
+
+
+def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
+               config: CleanConfig) -> CleanResult:
+    """Clean a total-intensity (nsub, nchan, nbin) cube on the default device."""
+    dtype = jnp.dtype(config.dtype)
+    fn = build_clean_fn(
+        config.max_iter, config.chanthresh, config.subintthresh,
+        config.pulse_slice, config.pulse_scale, config.pulse_region_active,
+        config.rotation, config.baseline_duty, config.unload_res,
+    )
+    outs, resid = fn(
+        jnp.asarray(cube, dtype=dtype),
+        jnp.asarray(orig_weights, dtype=dtype),
+        jnp.asarray(freqs_mhz, dtype=dtype),
+        jnp.asarray(dm, dtype=dtype),
+        jnp.asarray(ref_freq_mhz, dtype=dtype),
+        jnp.asarray(period_s, dtype=dtype),
+    )
+    loops = int(outs.loops)
+    return CleanResult(
+        final_weights=np.asarray(outs.final_weights),
+        scores=np.asarray(outs.scores),
+        loops=loops,
+        converged=bool(outs.converged),
+        residual=None if resid is None else np.asarray(resid),
+        loop_diffs=np.asarray(outs.loop_diffs)[:loops],
+        loop_rfi_frac=np.asarray(outs.loop_rfi_frac)[:loops],
+    )

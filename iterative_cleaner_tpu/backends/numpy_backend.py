@@ -1,0 +1,94 @@
+"""The numpy oracle backend.
+
+A faithful, vectorised float64 re-expression of the reference engine
+(``/root/reference/iterative_cleaner.py:65-178``), sharing the framework's
+DSP ops with the JAX path and using the ``numpy.ma``-native statistics
+oracle.  This backend is both the semantics reference every JAX change is
+parity-tested against and the CPU denominator for the benchmark speedup
+(BASELINE.md).
+
+The per-cell MINPACK fit of the reference (:278) is replaced by the exact
+closed-form amplitude (the model is linear in its one parameter); equivalence
+is validated against ``scipy.optimize.leastsq`` in tests/test_fit.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from iterative_cleaner_tpu.backends.base import CleanResult
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.ops.dsp import (
+    dispersion_shift_bins,
+    fit_template_amplitudes,
+    remove_baseline,
+    rotate_bins,
+    template_residuals,
+    weighted_template,
+)
+from iterative_cleaner_tpu.stats.masked_numpy import surgical_scores_numpy
+
+
+def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
+               config: CleanConfig) -> CleanResult:
+    """Clean a total-intensity (nsub, nchan, nbin) cube; pure numpy."""
+    cube = np.asarray(cube, dtype=np.float64)
+    orig_weights = np.asarray(orig_weights, dtype=np.float64)
+    nbin = cube.shape[-1]
+
+    shifts = dispersion_shift_bins(
+        np.asarray(freqs_mhz, dtype=np.float64), dm, ref_freq_mhz, period_s,
+        nbin, np,
+    )
+    # Iteration-invariant preamble (reference recomputes at :97-100 from
+    # identical clones; hoisted here).
+    ded = rotate_bins(remove_baseline(cube, np, duty=config.baseline_duty),
+                      -shifts, np, method=config.rotation)
+
+    cell_mask = orig_weights == 0  # ref :115
+    history = [orig_weights.copy()]  # pre-loop seed, ref :78-79
+    weights = orig_weights
+    scores = np.zeros_like(orig_weights)
+    residual = None
+    converged = False
+    loops = config.max_iter
+    loop_diffs = []
+    loop_rfi_frac = []
+
+    for x in range(1, config.max_iter + 1):
+        template = weighted_template(ded, weights, np) * 10000.0  # ref :94
+        amps = fit_template_amplitudes(ded, template, np)
+        resid = template_residuals(
+            ded, template, amps, config.pulse_slice, config.pulse_scale, np,
+            config.pulse_region_active,
+        )
+        resid = rotate_bins(resid, shifts, np, method=config.rotation)  # ref :104
+        if config.unload_res:
+            residual = resid
+        weighted = resid * orig_weights[:, :, None]  # ref :291-297
+        scores = surgical_scores_numpy(
+            weighted, cell_mask, config.chanthresh, config.subintthresh
+        )
+        new_weights = np.where(scores >= 1.0, 0.0, orig_weights)  # ref :300-305
+        loop_diffs.append(int(np.sum(new_weights != weights)))
+        loop_rfi_frac.append(float(np.mean(new_weights == 0)))
+
+        # cycle detection against every earlier weight matrix (ref :135-141)
+        if any(np.array_equal(new_weights, old) for old in history):
+            converged = True
+            loops = x
+            weights = new_weights
+            history.append(new_weights)
+            break
+        history.append(new_weights)
+        weights = new_weights
+
+    return CleanResult(
+        final_weights=weights,
+        scores=scores,
+        loops=loops,
+        converged=converged,
+        residual=residual,
+        loop_diffs=np.asarray(loop_diffs),
+        loop_rfi_frac=np.asarray(loop_rfi_frac),
+    )

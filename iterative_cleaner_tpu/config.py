@@ -1,0 +1,59 @@
+"""Cleaning configuration.
+
+A backend-neutral record of every knob the reference exposes through argparse
+(``/root/reference/iterative_cleaner.py:16-42``; flag table in SURVEY.md
+section 2.1) plus the framework-only knobs (backend choice, rotation method,
+precision).  The CLI constructs one of these from the parsed namespace; tests
+and library users construct it directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class CleanConfig:
+    # --- reference-surface parameters (defaults match reference :19-40) ---
+    chanthresh: float = 5.0      # -c  (reference :19-22)
+    subintthresh: float = 5.0    # -s  (reference :23-26)
+    max_iter: int = 5            # -m  (reference :27)
+    # -r: the reference's help says (start, end, factor) but the code uses
+    # [0] as the scale factor and [1],[2] as start/end (reference :280-283;
+    # SURVEY.md 2.4 quirk 3).  We store it exactly as the code consumes it.
+    pulse_region: Tuple[float, float, float] = (0.0, 0.0, 1.0)
+    bad_chan: float = 1.0        # --bad_chan (reference :39)
+    bad_subint: float = 1.0      # --bad_subint (reference :40)
+
+    # --- framework-only parameters ---
+    backend: str = "jax"         # {"numpy", "jax"}
+    rotation: str = "fourier"    # {"fourier", "roll"} dedispersion rotation
+    baseline_duty: float = 0.15  # off-pulse window fraction for baseline find
+    dtype: str = "float32"       # compute dtype on the jax path
+    unload_res: bool = False     # -u: also produce the pulse-free residual
+
+    @property
+    def pulse_region_active(self) -> bool:
+        """The reference skips the window scaling when -r is exactly the
+        default [0, 0, 1] (list equality at reference :280)."""
+        return tuple(self.pulse_region) != (0.0, 0.0, 1.0)
+
+    @property
+    def pulse_slice(self) -> Tuple[int, int]:
+        """(start, end) bin indices of the suppressed window (reference
+        :281-283: indices come from pulse_region[1], pulse_region[2])."""
+        return int(self.pulse_region[1]), int(self.pulse_region[2])
+
+    @property
+    def pulse_scale(self) -> float:
+        """Suppression factor (reference :283 uses pulse_region[0])."""
+        return float(self.pulse_region[0])
+
+    def __post_init__(self) -> None:
+        if self.backend not in ("numpy", "jax"):
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if self.rotation not in ("fourier", "roll"):
+            raise ValueError(f"unknown rotation method {self.rotation!r}")
+        if self.max_iter < 1:
+            raise ValueError("max_iter must be >= 1")

@@ -1,0 +1,5 @@
+"""The iteration engine: the reference's ``clean()`` while-loop
+(``/root/reference/iterative_cleaner.py:65-178``) as a single compiled
+``lax.while_loop`` on the JAX path."""
+
+from iterative_cleaner_tpu.engine.loop import CleanOutputs, clean_dedispersed_jax  # noqa: F401

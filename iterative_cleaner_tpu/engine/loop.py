@@ -1,0 +1,171 @@
+"""The cleaning iteration as one jit-compiled ``lax.while_loop``.
+
+Semantics mirror the reference engine (``/root/reference/iterative_cleaner.py:65-153``):
+
+- Each iteration rebuilds the template from the *original* data under the
+  previous iteration's weights (the reference re-clones the archive at :97
+  and :124, so zaps are re-derived from scratch each round — a cell can be
+  un-zapped; SURVEY.md 2.4 quirk 1).
+- The baseline-removed, dedispersed cube is iteration-invariant (the
+  reference recomputes it from identical clones every round, :97-100); here
+  it is computed once and stays in HBM.
+- Convergence is cycle detection against *every* earlier weight matrix
+  (reference :135-141), implemented as an equality scan over a fixed
+  (max_iter+1)-deep history buffer seeded with the original weights (:78-79).
+- The final mask applies the last iteration's scores to the original
+  weights (reference :153 acts on a fresh archive).
+
+Everything is static-shaped; the dynamic trip count lives in the while_loop
+condition.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from iterative_cleaner_tpu.ops.dsp import (
+    dispersion_shift_bins,
+    fit_template_amplitudes,
+    remove_baseline,
+    rotate_bins,
+    template_residuals,
+    weighted_template,
+)
+from iterative_cleaner_tpu.stats.masked_jax import surgical_scores_jax
+
+
+class CleanOutputs(NamedTuple):
+    final_weights: jax.Array   # (nsub, nchan) — the cleaned weight matrix
+    loops: jax.Array           # scalar int32 — iterations actually run
+    converged: jax.Array       # scalar bool
+    scores: jax.Array          # (nsub, nchan) — last iteration's zap scores
+    template_weights: jax.Array  # weights the last template was built from
+    loop_diffs: jax.Array      # (max_iter,) cells changed vs previous weights
+    loop_rfi_frac: jax.Array   # (max_iter,) zero-weight fraction per loop
+
+
+class _Carry(NamedTuple):
+    x: jax.Array
+    weights: jax.Array
+    history: jax.Array
+    count: jax.Array
+    converged: jax.Array
+    loops: jax.Array
+    scores: jax.Array
+    template_weights: jax.Array
+    loop_diffs: jax.Array
+    loop_rfi_frac: jax.Array
+
+
+def iteration_step(ded_cube, weights, orig_weights, cell_mask, back_shifts, *,
+                   chanthresh, subintthresh, pulse_slice, pulse_scale,
+                   pulse_active, rotation):
+    """One cleaning iteration: template -> fit -> residual stats -> new weights.
+
+    ``weights`` are the previous iteration's (template) weights;
+    ``orig_weights``/``cell_mask`` never change (reference :112,:115-117).
+    Returns (new_weights, scores).
+    """
+    template = weighted_template(ded_cube, weights, jnp) * 10000.0  # ref :94
+    amps = fit_template_amplitudes(ded_cube, template, jnp)
+    resid = template_residuals(
+        ded_cube, template, amps, pulse_slice, pulse_scale, jnp, pulse_active
+    )
+    # back to the dispersed frame before statistics (reference :104)
+    resid = rotate_bins(resid, back_shifts, jnp, method=rotation)
+    weighted = resid * orig_weights[:, :, None]  # apply_weights, ref :291-297
+    scores = surgical_scores_jax(weighted, cell_mask, chanthresh, subintthresh)
+    new_weights = jnp.where(scores >= 1.0, 0.0, orig_weights)  # ref :300-305
+    return new_weights, scores
+
+
+def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
+                          max_iter, chanthresh, subintthresh,
+                          pulse_slice, pulse_scale, pulse_active,
+                          rotation) -> CleanOutputs:
+    """Run the full iteration loop on an already-prepared cube.
+
+    ``ded_cube``: baseline-removed, dedispersed (nsub, nchan, nbin) cube.
+    ``back_shifts``: per-channel bin shifts that restore the dispersed frame.
+    Keyword arguments are static (compiled in).
+    """
+    nsub, nchan, _ = ded_cube.shape
+    wdtype = orig_weights.dtype
+    cell_mask = orig_weights == 0  # ref :115 (mask where weight exactly 0)
+
+    history = jnp.zeros((max_iter + 1, nsub, nchan), dtype=wdtype)
+    history = history.at[0].set(orig_weights)  # pre-loop seed, ref :78-79
+
+    init = _Carry(
+        x=jnp.int32(0),
+        weights=orig_weights,
+        history=history,
+        count=jnp.int32(1),
+        converged=jnp.bool_(False),
+        loops=jnp.int32(max_iter),
+        scores=jnp.zeros((nsub, nchan), dtype=ded_cube.dtype),
+        template_weights=orig_weights,
+        loop_diffs=jnp.zeros((max_iter,), dtype=jnp.int32),
+        loop_rfi_frac=jnp.zeros((max_iter,), dtype=ded_cube.dtype),
+    )
+
+    def cond(c: _Carry):
+        return (c.x < max_iter) & ~c.converged
+
+    def body(c: _Carry) -> _Carry:
+        new_w, scores = iteration_step(
+            ded_cube, c.weights, orig_weights, cell_mask, back_shifts,
+            chanthresh=chanthresh, subintthresh=subintthresh,
+            pulse_slice=pulse_slice, pulse_scale=pulse_scale,
+            pulse_active=pulse_active, rotation=rotation,
+        )
+        seen = jnp.arange(max_iter + 1) < c.count
+        matches = jnp.all(c.history == new_w[None], axis=(1, 2)) & seen
+        conv = jnp.any(matches)  # exact repeat of any earlier matrix, ref :135-140
+        history = lax.dynamic_update_index_in_dim(c.history, new_w, c.count, 0)
+        # per-loop operator telemetry (reference :129-134)
+        diff = jnp.sum(new_w != c.weights).astype(jnp.int32)
+        frac = jnp.mean((new_w == 0).astype(ded_cube.dtype))
+        return _Carry(
+            x=c.x + 1,
+            weights=new_w,
+            history=history,
+            count=c.count + 1,
+            converged=conv,
+            loops=jnp.where(conv, c.x + 1, c.loops),  # ref :139 / :146
+            scores=scores,
+            template_weights=c.weights,
+            loop_diffs=c.loop_diffs.at[c.x].set(diff),
+            loop_rfi_frac=c.loop_rfi_frac.at[c.x].set(frac),
+        )
+
+    out = lax.while_loop(cond, body, init)
+    return CleanOutputs(
+        final_weights=out.weights,
+        loops=out.loops,
+        converged=out.converged,
+        scores=out.scores,
+        template_weights=out.template_weights,
+        loop_diffs=out.loop_diffs,
+        loop_rfi_frac=out.loop_rfi_frac,
+    )
+
+
+def prepare_cube_jax(cube, freqs_mhz, dm, ref_freq_mhz, period_s, *,
+                     baseline_duty, rotation):
+    """Host-free preamble: baseline removal + dedispersion (reference
+    :90-91/:99-100, identical across iterations so hoisted out of the loop).
+
+    Returns (ded_cube, back_shifts)."""
+    nbin = cube.shape[-1]
+    shifts = dispersion_shift_bins(
+        jnp.asarray(freqs_mhz, dtype=cube.dtype), dm, ref_freq_mhz, period_s,
+        nbin, jnp,
+    )
+    base = remove_baseline(cube, jnp, duty=baseline_duty)
+    ded = rotate_bins(base, -shifts, jnp, method=rotation)
+    return ded, shifts

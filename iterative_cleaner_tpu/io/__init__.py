@@ -1,0 +1,15 @@
+"""Archive I/O: the host boundary.
+
+The reference does all I/O through PSRCHIVE (``Archive_load``/``unload`` at
+``/root/reference/iterative_cleaner.py:47,60``).  Here the host boundary is a
+thin dispatch over:
+
+- ``.npz`` — the framework's portable container (always available),
+- ``.icar`` — a raw binary format with a native C++ mmap loader
+  (:mod:`iterative_cleaner_tpu.io.native`),
+- PSRCHIVE ``.ar`` files via the optional bridge when the ``psrchive``
+  Python module is importable (:mod:`iterative_cleaner_tpu.io.psrchive_bridge`).
+"""
+
+from iterative_cleaner_tpu.io.npz import load_archive, save_archive  # noqa: F401
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive  # noqa: F401

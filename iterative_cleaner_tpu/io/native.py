@@ -1,0 +1,174 @@
+"""The ``.icar`` raw binary archive format + native C++ loader bindings.
+
+``.icar`` is the framework's zero-copy on-disk layout: a fixed little-endian
+header followed by raw arrays, designed so the C++ loader (native/archive_io.cpp)
+can mmap the cube straight into pinned host memory for the device transfer.
+A pure-Python reader/writer (this module) defines the format; the native
+loader is used automatically when the shared library has been built
+(``make -C native``).
+
+Layout (all little-endian):
+  0   8   magic  b"ICAR\\x00\\x01\\x00\\x00" (version 1)
+  8   4*u32   nsub, npol, nchan, nbin
+  24  6*f64   period_s, dm, centre_freq_mhz, mjd_start, mjd_end, reserved
+  72  u32     flags (bit0: dedispersed), u32 pol_state enum
+  80  64s     source (utf-8, NUL padded)
+  144 f64[nchan]              freqs_mhz
+  ... f32[nsub,nchan]         weights
+  ... f32[nsub,npol,nchan,nbin] data
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+from iterative_cleaner_tpu.archive import POL_STATES, Archive
+
+MAGIC = b"ICAR\x00\x01\x00\x00"
+_HEADER = struct.Struct("<8s4I6d2I64s")
+assert _HEADER.size == 144
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native", "libicar.so")
+
+
+_lib = None
+
+
+def native_available() -> bool:
+    return os.path.exists(_lib_path())
+
+
+def _load_lib():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(_lib_path())
+        lib.icar_open.restype = ctypes.c_void_p
+        lib.icar_open.argtypes = [ctypes.c_char_p]
+        lib.icar_data_ptr.restype = ctypes.c_void_p
+        lib.icar_data_ptr.argtypes = [ctypes.c_void_p]
+        lib.icar_weights_ptr.restype = ctypes.c_void_p
+        lib.icar_weights_ptr.argtypes = [ctypes.c_void_p]
+        lib.icar_freqs_ptr.restype = ctypes.c_void_p
+        lib.icar_freqs_ptr.argtypes = [ctypes.c_void_p]
+        lib.icar_header_ptr.restype = ctypes.c_void_p
+        lib.icar_header_ptr.argtypes = [ctypes.c_void_p]
+        lib.icar_close.restype = None
+        lib.icar_close.argtypes = [ctypes.c_void_p]
+        lib.icar_write.restype = ctypes.c_int
+        lib.icar_write.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p,
+        ]
+        _lib = lib
+    return _lib
+
+
+def _pack_header(ar: Archive) -> bytes:
+    flags = 1 if ar.dedispersed else 0
+    return _HEADER.pack(
+        MAGIC, ar.nsub, ar.npol, ar.nchan, ar.nbin,
+        ar.period_s, ar.dm, ar.centre_freq_mhz, ar.mjd_start, ar.mjd_end, 0.0,
+        flags, POL_STATES.index(ar.pol_state),
+        ar.source.encode("utf-8")[:64],
+    )
+
+
+def _unpack_header(buf: bytes):
+    (magic, nsub, npol, nchan, nbin, period, dm, cfreq, mjd0, mjd1, _res,
+     flags, pol_idx, source) = _HEADER.unpack(buf[: _HEADER.size])
+    if magic != MAGIC:
+        raise ValueError("not an ICAR v1 file")
+    return dict(
+        nsub=nsub, npol=npol, nchan=nchan, nbin=nbin, period_s=period, dm=dm,
+        centre_freq_mhz=cfreq, mjd_start=mjd0, mjd_end=mjd1,
+        dedispersed=bool(flags & 1), pol_state=POL_STATES[pol_idx],
+        source=source.split(b"\x00", 1)[0].decode("utf-8"),
+    )
+
+
+def save_icar(ar: Archive, path: str) -> None:
+    header = _pack_header(ar)
+    freqs = np.ascontiguousarray(ar.freqs_mhz, dtype="<f8")
+    weights = np.ascontiguousarray(ar.weights, dtype="<f4")
+    data = np.ascontiguousarray(ar.data, dtype="<f4")
+    if native_available():
+        lib = _load_lib()
+        rc = lib.icar_write(
+            path.encode(), header,
+            freqs.ctypes.data_as(ctypes.c_char_p),
+            weights.ctypes.data_as(ctypes.c_char_p),
+            data.ctypes.data_as(ctypes.c_char_p),
+        )
+        if rc != 0:
+            raise OSError(f"native icar_write failed with code {rc}")
+        return
+    with open(path, "wb") as f:
+        f.write(header)
+        f.write(freqs.tobytes())
+        f.write(weights.tobytes())
+        f.write(data.tobytes())
+
+
+def load_icar(path: str) -> Archive:
+    if native_available():
+        return _load_icar_native(path)
+    with open(path, "rb") as f:
+        buf = f.read()
+    meta = _unpack_header(buf)
+    off = _HEADER.size
+    nsub, npol, nchan, nbin = meta["nsub"], meta["npol"], meta["nchan"], meta["nbin"]
+    freqs = np.frombuffer(buf, dtype="<f8", count=nchan, offset=off).copy()
+    off += nchan * 8
+    weights = np.frombuffer(buf, dtype="<f4", count=nsub * nchan, offset=off)
+    weights = weights.reshape(nsub, nchan).astype(np.float64)
+    off += nsub * nchan * 4
+    data = np.frombuffer(buf, dtype="<f4", count=nsub * npol * nchan * nbin,
+                         offset=off).reshape(nsub, npol, nchan, nbin)
+    return Archive(
+        data=data.astype(np.float64), weights=weights, freqs_mhz=freqs,
+        filename=path,
+        **{k: meta[k] for k in ("period_s", "dm", "centre_freq_mhz",
+                                "mjd_start", "mjd_end", "dedispersed",
+                                "pol_state", "source")},
+    )
+
+
+def _load_icar_native(path: str) -> Archive:
+    """mmap-backed load through the C++ library; arrays are copied out of the
+    mapping so the handle can be closed eagerly."""
+    lib = _load_lib()
+    handle = lib.icar_open(path.encode())
+    if not handle:
+        raise OSError(f"native icar_open failed for {path}")
+    try:
+        hdr = ctypes.string_at(lib.icar_header_ptr(handle), _HEADER.size)
+        meta = _unpack_header(hdr)
+        nsub, npol, nchan, nbin = (meta["nsub"], meta["npol"], meta["nchan"],
+                                   meta["nbin"])
+        freqs = np.ctypeslib.as_array(
+            ctypes.cast(lib.icar_freqs_ptr(handle),
+                        ctypes.POINTER(ctypes.c_double)), (nchan,)).copy()
+        weights = np.ctypeslib.as_array(
+            ctypes.cast(lib.icar_weights_ptr(handle),
+                        ctypes.POINTER(ctypes.c_float)), (nsub, nchan))
+        data = np.ctypeslib.as_array(
+            ctypes.cast(lib.icar_data_ptr(handle),
+                        ctypes.POINTER(ctypes.c_float)),
+            (nsub, npol, nchan, nbin))
+        ar = Archive(
+            data=data.astype(np.float64), weights=weights.astype(np.float64),
+            freqs_mhz=freqs, filename=path,
+            **{k: meta[k] for k in ("period_s", "dm", "centre_freq_mhz",
+                                    "mjd_start", "mjd_end", "dedispersed",
+                                    "pol_state", "source")},
+        )
+    finally:
+        lib.icar_close(handle)
+    return ar

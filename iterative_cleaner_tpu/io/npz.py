@@ -1,0 +1,76 @@
+"""Portable ``.npz`` archive container + format dispatch.
+
+Stands in for PSRCHIVE ``Archive_load``/``unload``
+(``/root/reference/iterative_cleaner.py:47,60,150,162``).  The ``.npz``
+container stores exactly the Archive dataclass fields; ``.icar`` delegates to
+the native C++ loader; ``.ar`` delegates to the PSRCHIVE bridge when present.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from iterative_cleaner_tpu.archive import Archive
+
+_META_KEYS = ("period_s", "dm", "centre_freq_mhz", "mjd_start", "mjd_end")
+
+
+def save_archive(ar: Archive, path: str) -> None:
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".icar":
+        from iterative_cleaner_tpu.io import native
+
+        native.save_icar(ar, path)
+        return
+    if ext == ".ar":
+        from iterative_cleaner_tpu.io import psrchive_bridge
+
+        psrchive_bridge.save_ar(ar, path)
+        return
+    # write through a file object so numpy cannot append '.npz' to a target
+    # name with a different extension (the reported path must be the real one)
+    with open(path, "wb") as f:
+        _write_npz(f, ar)
+
+
+def _write_npz(f, ar: Archive) -> None:
+    np.savez_compressed(
+        f,
+        data=ar.data,
+        weights=ar.weights,
+        freqs_mhz=ar.freqs_mhz,
+        period_s=ar.period_s,
+        dm=ar.dm,
+        centre_freq_mhz=ar.centre_freq_mhz,
+        mjd_start=ar.mjd_start,
+        mjd_end=ar.mjd_end,
+        source=np.array(ar.source),
+        pol_state=np.array(ar.pol_state),
+        dedispersed=np.array(ar.dedispersed),
+    )
+
+
+def load_archive(path: str) -> Archive:
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".icar":
+        from iterative_cleaner_tpu.io import native
+
+        return native.load_icar(path)
+    if ext == ".ar":
+        from iterative_cleaner_tpu.io import psrchive_bridge
+
+        return psrchive_bridge.load_ar(path)
+    with np.load(path, allow_pickle=False) as z:
+        kwargs = {k: float(z[k]) for k in _META_KEYS}
+        return Archive(
+            data=z["data"],
+            weights=z["weights"],
+            freqs_mhz=z["freqs_mhz"],
+            source=str(z["source"]),
+            pol_state=str(z["pol_state"]),
+            dedispersed=bool(z["dedispersed"]),
+            filename=path,
+            **kwargs,
+        )

@@ -1,0 +1,85 @@
+"""Optional PSRCHIVE bridge.
+
+When the ``psrchive`` Python bindings are importable, real ``.ar`` archives
+can be loaded into the framework's Archive model and cleaned weights written
+back (the reference's I/O boundary, ``/root/reference/iterative_cleaner.py:47,60``).
+The module degrades to a clear ImportError otherwise; nothing else in the
+framework depends on it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from iterative_cleaner_tpu.archive import Archive
+
+
+def _psrchive():
+    try:
+        import psrchive  # type: ignore
+    except ImportError as e:  # pragma: no cover - environment dependent
+        raise ImportError(
+            "Reading/writing PSRCHIVE .ar files requires the psrchive Python "
+            "bindings, which are not installed. Convert archives to the .npz "
+            "container instead (iterative_cleaner_tpu.io.save_archive)."
+        ) from e
+    return psrchive
+
+
+def _map_state(state: str, npol: int) -> str:
+    """Map a PSRCHIVE Signal::State onto the framework's pol_state.
+
+    Coherence-family states (AABBCRCI and the two-product PPQQ) need the
+    first two products summed for total intensity; Stokes keeps I; anything
+    already single-product is Intensity.
+    """
+    if npol == 1 or state == "Intensity":
+        return "Intensity"
+    if state in ("Coherence", "PPQQ"):
+        return "Coherence"
+    return "Stokes"
+
+
+def load_ar(path: str) -> Archive:  # pragma: no cover - needs psrchive
+    psr = _psrchive()
+    ar = psr.Archive_load(path)
+    nchan = ar.get_nchan()
+    freqs = np.array(
+        [ar.get_Integration(0).get_centre_frequency(c) for c in range(nchan)],
+        dtype=np.float64,
+    )
+    return Archive(
+        data=ar.get_data().astype(np.float64),
+        weights=ar.get_weights().astype(np.float64),
+        freqs_mhz=freqs,
+        period_s=float(ar.get_Integration(0).get_folding_period()),
+        dm=float(ar.get_dispersion_measure()),
+        centre_freq_mhz=float(ar.get_centre_frequency()),
+        source=str(ar.get_source()),
+        mjd_start=float(ar.start_time().in_days()),
+        mjd_end=float(ar.end_time().in_days()),
+        filename=path,
+        pol_state=_map_state(str(ar.get_state()), int(ar.get_npol())),
+        dedispersed=bool(ar.get_dedispersed()),
+    )
+
+
+def save_ar(archive: Archive, path: str) -> None:  # pragma: no cover
+    raise NotImplementedError(
+        "Writing .ar requires an original psrchive Archive to carry the full "
+        "header; use apply_weights_to_ar() to write cleaned weights back "
+        "into a loaded archive instead."
+    )
+
+
+def apply_weights_to_ar(ar_path: str, out_path: str,
+                        weights: np.ndarray) -> None:  # pragma: no cover
+    """Load ``ar_path`` with PSRCHIVE, overwrite its (nsub, nchan) weights,
+    and unload to ``out_path`` (reference :153,:60 combined)."""
+    psr = _psrchive()
+    ar = psr.Archive_load(ar_path)
+    for isub in range(ar.get_nsubint()):
+        integ = ar.get_Integration(isub)
+        for ichan in range(ar.get_nchan()):
+            integ.set_weight(ichan, float(weights[isub, ichan]))
+    ar.unload(out_path)

@@ -1,0 +1,164 @@
+"""Synthetic archive generator with ground-truth RFI masks.
+
+The reference ships no tests or fixtures (SURVEY.md section 4); this generator
+is the foundation of the framework's test strategy: a dispersed pulse of known
+shape plus injected RFI of the three morphologies the surgical-scrub detector
+targets (impulsive per-cell, narrowband per-channel, broadband per-subint),
+so the expected zap mask is known a priori.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from iterative_cleaner_tpu.archive import Archive
+from iterative_cleaner_tpu.ops.dsp import dedisperse_cube
+
+
+@dataclasses.dataclass
+class SyntheticTruth:
+    """Ground truth accompanying a synthetic archive."""
+
+    rfi_cells: np.ndarray      # (n, 2) injected impulsive (isub, ichan) pairs
+    rfi_channels: np.ndarray   # (k,) channels with persistent narrowband RFI
+    rfi_subints: np.ndarray    # (j,) subints with broadband RFI
+    pulse_phase: float         # pulse centre as phase [0, 1)
+    prezapped: np.ndarray      # (nsub, nchan) bool: weight 0 on input
+
+    def expected_zap(self, nsub: int, nchan: int) -> np.ndarray:
+        mask = np.zeros((nsub, nchan), dtype=bool)
+        if len(self.rfi_cells):
+            mask[self.rfi_cells[:, 0], self.rfi_cells[:, 1]] = True
+        mask[:, self.rfi_channels] = True
+        mask[self.rfi_subints, :] = True
+        mask |= self.prezapped
+        return mask
+
+
+def make_synthetic_archive(
+    nsub: int = 16,
+    nchan: int = 32,
+    nbin: int = 128,
+    npol: int = 1,
+    n_rfi_cells: int = 6,
+    n_rfi_channels: int = 1,
+    n_rfi_subints: int = 1,
+    n_prezapped: int = 0,
+    rfi_strength: float = 40.0,
+    pulse_snr: float = 30.0,
+    noise_sigma: float = 1.0,
+    dm: float = 26.76,
+    period_s: float = 0.714,
+    centre_freq_mhz: float = 1400.0,
+    bandwidth_mhz: float = 200.0,
+    baseline_level: float = 100.0,
+    seed: int = 0,
+    dtype=np.float64,
+):
+    """Build a dispersed, noisy archive with injected RFI.
+
+    Returns ``(Archive, SyntheticTruth)``.  The pulse is a Gaussian in phase,
+    with a smooth per-channel spectral index so fscrunching is non-trivial;
+    the cube is then dispersed with the archive's DM so the dedispersion op
+    has real work to do.
+    """
+    rng = np.random.default_rng(seed)
+    freqs = centre_freq_mhz + bandwidth_mhz * (np.arange(nchan) / nchan - 0.5)
+
+    phase = (np.arange(nbin) + 0.5) / nbin
+    pulse_phase = 0.3
+    width = 0.02
+    profile = np.exp(-0.5 * ((phase - pulse_phase) / width) ** 2)
+
+    # smooth spectrum: stronger at low frequency (typical pulsar)
+    spectrum = (freqs / centre_freq_mhz) ** -1.4
+    amp = pulse_snr * noise_sigma
+    clean = amp * spectrum[None, :, None] * profile[None, None, :]
+    clean = np.broadcast_to(clean, (nsub, nchan, nbin)).astype(dtype).copy()
+
+    noise = rng.normal(0.0, noise_sigma, size=(nsub, nchan, nbin))
+    cube = clean + noise + baseline_level
+
+    # Disperse: apply the channel delays the cleaner will have to remove.
+    cube = dedisperse_cube(
+        cube, freqs, dm, centre_freq_mhz, period_s, np, method="fourier",
+        forward=False,
+    )
+
+    # --- inject RFI (after dispersion: RFI is not dispersed) ---
+    all_cells = [(s, c) for s in range(nsub) for c in range(nchan)]
+    rng.shuffle(all_cells)
+    rfi_cells = []
+    for s, c in all_cells:
+        if len(rfi_cells) >= n_rfi_cells:
+            break
+        rfi_cells.append((s, c))
+        kind = rng.integers(3)
+        if kind == 0:  # impulsive spike in a few bins
+            bins = rng.integers(0, nbin, size=max(1, nbin // 16))
+            cube[s, c, bins] += rfi_strength * noise_sigma
+        elif kind == 1:  # broadband noise burst (a DC jump would be removed
+            # by baseline subtraction, here and in the reference alike)
+            cube[s, c, :] += rng.normal(
+                0.0, rfi_strength * noise_sigma / 4.0, nbin
+            )
+        else:  # strong sinusoid (caught by the rFFT diagnostic)
+            cube[s, c, :] += (
+                rfi_strength * noise_sigma * np.sin(2 * np.pi * 5 * phase)
+            )
+    rfi_cells = np.array(rfi_cells, dtype=np.int64).reshape(-1, 2)
+
+    taken_ch = set(rfi_cells[:, 1]) if len(rfi_cells) else set()
+    free_ch = [c for c in range(nchan) if c not in taken_ch]
+    n_ch = min(n_rfi_channels, len(free_ch))
+    rfi_channels = np.array(
+        sorted(rng.choice(free_ch, size=n_ch, replace=False)) if n_ch else [],
+        dtype=np.int64)
+    for c in rfi_channels:
+        cube[:, c, :] += rfi_strength * noise_sigma * rng.normal(1.0, 0.2, (nsub, 1))
+
+    taken_sub = set(rfi_cells[:, 0]) if len(rfi_cells) else set()
+    free_sub = [s for s in range(nsub) if s not in taken_sub]
+    n_sub = min(n_rfi_subints, len(free_sub))
+    rfi_subints = np.array(
+        sorted(rng.choice(free_sub, size=n_sub, replace=False)) if n_sub else [],
+        dtype=np.int64)
+    for s in rfi_subints:
+        cube[s, :, :] += rfi_strength * noise_sigma * np.abs(
+            np.sin(2 * np.pi * 11 * phase)
+        )
+
+    weights = np.ones((nsub, nchan), dtype=dtype)
+    prezapped = np.zeros((nsub, nchan), dtype=bool)
+    if n_prezapped:
+        flat = rng.choice(nsub * nchan, size=n_prezapped, replace=False)
+        prezapped[np.unravel_index(flat, (nsub, nchan))] = True
+        weights[prezapped] = 0.0
+
+    data = cube[:, None, :, :]
+    if npol > 1:
+        # pad extra pol channels with noise; pol 0 stays total intensity
+        extra = rng.normal(0.0, noise_sigma, size=(nsub, npol - 1, nchan, nbin))
+        data = np.concatenate([data, extra + baseline_level], axis=1)
+
+    ar = Archive(
+        data=data.astype(dtype),
+        weights=weights,
+        freqs_mhz=freqs.astype(dtype),
+        period_s=period_s,
+        dm=dm,
+        centre_freq_mhz=centre_freq_mhz,
+        source=f"FAKE{seed:04d}+{nchan:02d}",
+        pol_state="Intensity" if npol == 1 else "Stokes",
+        filename="",
+    )
+    truth = SyntheticTruth(
+        rfi_cells=rfi_cells,
+        rfi_channels=rfi_channels,
+        rfi_subints=rfi_subints,
+        pulse_phase=pulse_phase,
+        prezapped=prezapped,
+    )
+    return ar, truth

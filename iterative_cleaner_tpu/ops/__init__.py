@@ -1,0 +1,15 @@
+"""DSP primitives shared by both backends.
+
+Each op is written once over an ``xp`` array-module handle (``numpy`` for the
+float64 oracle, ``jax.numpy`` for the compiled TPU path), so the two backends
+share one semantic definition and parity reduces to floating-point precision.
+"""
+
+from iterative_cleaner_tpu.ops.dsp import (  # noqa: F401
+    baseline_offsets,
+    dispersion_shift_bins,
+    fit_template_amplitudes,
+    remove_baseline,
+    rotate_bins,
+    weighted_template,
+)

@@ -1,0 +1,9 @@
+"""Scale-out layer: device meshes, sharded cleaning, archive batching,
+streaming subint-chunked mode.
+
+The reference is strictly single-process (SURVEY.md section 2.3); this layer
+is the TPU-native replacement: ``jax.sharding.Mesh`` + NamedSharding/
+``shard_map`` over the (subint, channel) cell grid with XLA collectives over
+ICI, ``vmap`` batching of equal-shaped archives, and an online subint-chunked
+streaming mode for long observations.
+"""

@@ -1,0 +1,16 @@
+"""Surgical-scrub detection statistics.
+
+Two implementations of the same observable semantics (reference
+``comprehensive_stats``/``channel_scaler``/``subint_scaler`` at
+``/root/reference/iterative_cleaner.py:181-256``):
+
+- :mod:`iterative_cleaner_tpu.stats.masked_numpy` — the float64 oracle, built
+  directly on ``numpy.ma`` so every masked-array quirk of the reference
+  (SURVEY.md section 2.4, quirks 6-9) is inherited rather than re-derived.
+- :mod:`iterative_cleaner_tpu.stats.masked_jax` — the compiled path, with the
+  ``np.ma`` rules made explicit over (value, mask) pairs (empirically
+  verified: see tests/test_stats_parity.py).
+"""
+
+from iterative_cleaner_tpu.stats.masked_numpy import surgical_scores_numpy  # noqa: F401
+from iterative_cleaner_tpu.stats.masked_jax import surgical_scores_jax  # noqa: F401

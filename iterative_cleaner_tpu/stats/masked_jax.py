@@ -1,0 +1,112 @@
+"""Detection statistics, compiled path (JAX).
+
+The same observable semantics as :mod:`iterative_cleaner_tpu.stats.masked_numpy`
+(reference ``/root/reference/iterative_cleaner.py:181-256``), with the
+``numpy.ma`` behaviour made explicit over (value, mask) pairs.  The effective
+rules, established empirically against numpy and locked in by
+tests/test_stats_parity.py:
+
+1. Binary ops leave masked entries' ``.data`` untouched (pass-through);
+   unary ``abs`` computes on all data.
+2. A zero-MAD or empty line masks the whole line, leaving the centred
+   numerator as ``.data`` (undivided).
+3. The final ``/threshold`` does not touch masked entries' data.
+4. Fully-masked reductions leave ``.data`` 0 for std/mean and the ``np.ma``
+   float fill 1e20 for ptp.
+5. The rFFT diagnostic drops masks entirely: it is scaled on the *plain*
+   path where zero MAD produces IEEE inf/nan.
+6. The ``np.max`` stacking and the final 4-way median run on raw data.
+
+Masks here are always cell-uniform across pulse bins (they come from the
+(nsub, nchan) weight matrix, reference :115-117), which keeps the bin-axis
+reductions mask-free.
+
+The hot reductions are the masked medians over lines of the (nsub, nchan)
+diagnostic matrices; `masked_median` is sort-based (+inf padding, count
+indexing) which XLA maps well to TPU; a Pallas kernel can slot in behind the
+same signature.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# numpy.ma default float fill value, observable through quirk 4.
+MA_FILL = 1e20
+
+
+def masked_median(values, mask, axis):
+    """``np.ma.median`` semantics: median over unmasked entries along axis.
+
+    Even counts average the two middle order statistics.  Lines with no valid
+    entries return 0.0 — callers must handle them via the count (np.ma would
+    return ``masked``; the 0.0 placeholder is never observable because those
+    lines are fully masked downstream).  Keeps the reduced axis (keepdims).
+    """
+    sentinel = jnp.asarray(jnp.inf, dtype=values.dtype)
+    ordered = jnp.sort(jnp.where(mask, sentinel, values), axis=axis)
+    n = jnp.sum(~mask, axis=axis, keepdims=True)
+    size = values.shape[axis]
+    lo = jnp.take_along_axis(ordered, jnp.clip((n - 1) // 2, 0, size - 1), axis=axis)
+    hi = jnp.take_along_axis(ordered, jnp.clip(n // 2, 0, size - 1), axis=axis)
+    med = 0.5 * (lo + hi)
+    return jnp.where(n == 0, jnp.zeros_like(med), med)
+
+
+def scale_lines_masked(diag, mask, axis, thresh):
+    """Masked-path line normalisation, post |.|/threshold.
+
+    Returns the raw data that survives the mask-dropping ``np.max`` stacking:
+    ``|(x - med)/mad| / thresh`` for live entries, with masked entries
+    carrying their (undivided) pass-through data per rules 1-3.
+    """
+    n = jnp.sum(~mask, axis=axis, keepdims=True)
+    med = masked_median(diag, mask, axis)
+    centred = jnp.where(mask, diag, diag - med)
+    mad = masked_median(jnp.abs(centred), mask, axis)
+    line_dead = (mad == 0) | (n == 0)
+    safe_mad = jnp.where(line_dead, jnp.ones_like(mad), mad)
+    dead = mask | line_dead
+    scaled = jnp.where(dead, centred, centred / safe_mad)
+    mag = jnp.abs(scaled)
+    return jnp.where(dead, mag, mag / thresh)
+
+
+def scale_lines_plain(diag, axis, thresh):
+    """Plain-path normalisation (the rFFT diagnostic): IEEE semantics, no
+    masking — zero MAD yields inf/nan that flow onward (quirk 5)."""
+    med = jnp.median(diag, axis=axis, keepdims=True)
+    centred = diag - med
+    mad = jnp.median(jnp.abs(centred), axis=axis, keepdims=True)
+    return jnp.abs(centred / mad) / thresh
+
+
+def surgical_scores_jax(resid_weighted, cell_mask, chanthresh, subintthresh):
+    """Zap scores for every (subint, channel) cell; score >= 1 means zap.
+
+    Mirrors reference :202-226 under the explicit-mask rules above.  Since
+    the cell mask is bin-uniform and masked cells' data is exactly zero
+    (``apply_weights`` zeroed them, reference :296), bin-axis reductions are
+    computed plainly and patched per rule 4.
+    """
+    x = resid_weighted
+    m = cell_mask
+
+    mean_b = jnp.mean(x, axis=2)
+    d_std = jnp.where(m, 0.0, jnp.std(x, axis=2))
+    d_mean = jnp.where(m, 0.0, mean_b)
+    d_ptp = jnp.where(m, jnp.asarray(MA_FILL, x.dtype),
+                      jnp.max(x, axis=2) - jnp.min(x, axis=2))
+    centred = x - jnp.where(m, 0.0, mean_b)[..., None]
+    d_fft = jnp.max(jnp.abs(jnp.fft.rfft(centred, axis=2)), axis=2)
+
+    per_diag = []
+    for diag in (d_std, d_mean, d_ptp):
+        chan_side = scale_lines_masked(diag, m, 0, chanthresh)
+        subint_side = scale_lines_masked(diag, m, 1, subintthresh)
+        per_diag.append(jnp.maximum(chan_side, subint_side))
+    per_diag.append(
+        jnp.maximum(scale_lines_plain(d_fft, 0, chanthresh),
+                    scale_lines_plain(d_fft, 1, subintthresh))
+    )
+    return jnp.median(jnp.stack(per_diag), axis=0)

@@ -1,0 +1,69 @@
+"""Detection statistics oracle, built on ``numpy.ma``.
+
+Reproduces the observable semantics of the reference's detection layer
+(``/root/reference/iterative_cleaner.py:181-256``) in vectorised form.  Using
+``numpy.ma`` end-to-end means the masked-array corner cases the final mask
+depends on — mask-dropping at the stacking ``np.max`` (SURVEY.md 2.4 quirk 6),
+zero-MAD lines masked with the numerator left in ``.data`` (quirk 7), the
+mask-ignoring rFFT (quirk 9) — are inherited from numpy itself rather than
+re-implemented.  Vectorised-vs-per-line equivalence is covered by
+tests/test_stats_parity.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def robust_scale_lines(diag, axis):
+    """Median/MAD-normalise each line of a 2-D diagnostic along ``axis``.
+
+    ``axis=0`` normalises every channel across subints (the reference's
+    ``channel_scaler``, :229-241); ``axis=1`` normalises every subint across
+    channels (``subint_scaler``, :244-256).
+
+    The masked and plain input types deliberately take different code paths,
+    because the reference's single code path behaves differently for them:
+    with a masked diagnostic, a zero-MAD line comes back fully masked with
+    the centred numerator preserved in ``.data``; with a plain diagnostic
+    (the rFFT one, whose mask was dropped by ``np.fft.rfft``), zero MAD
+    produces IEEE inf/nan that flow onward.
+    """
+    with np.errstate(invalid="ignore", divide="ignore"):
+        if isinstance(diag, np.ma.MaskedArray):
+            med = np.ma.median(diag, axis=axis, keepdims=True)
+            centred = diag - med
+            mad = np.ma.median(np.abs(centred), axis=axis, keepdims=True)
+            return centred / mad
+        med = np.median(diag, axis=axis, keepdims=True)
+        centred = diag - med
+        mad = np.median(np.abs(centred), axis=axis, keepdims=True)
+        return centred / mad
+
+
+def surgical_scores_numpy(resid_weighted, cell_mask, chanthresh, subintthresh):
+    """Zap scores for every (subint, channel) cell; score >= 1 means zap.
+
+    Inputs: the weighted residual cube (already multiplied by the original
+    weights, reference :112) and the boolean cell mask (original weight == 0,
+    reference :115-117).  Implements reference :202-226.
+    """
+    mask3 = np.broadcast_to(cell_mask[:, :, None], resid_weighted.shape)
+    cube = np.ma.masked_array(resid_weighted, mask=mask3)
+
+    diagnostics = [
+        np.ma.std(cube, axis=2),
+        np.ma.mean(cube, axis=2),
+        np.ma.ptp(cube, axis=2),
+    ]
+    centred = cube - np.expand_dims(cube.mean(axis=2), axis=2)
+    # np.fft.rfft operates on .data and returns a plain ndarray (quirk 9).
+    diagnostics.append(np.max(np.abs(np.fft.rfft(centred, axis=2)), axis=2))
+
+    per_diag = []
+    for diag in diagnostics:
+        chan_side = np.abs(robust_scale_lines(diag, axis=0)) / chanthresh
+        subint_side = np.abs(robust_scale_lines(diag, axis=1)) / subintthresh
+        # Stacking through np.max drops masks; raw .data flows on (quirk 6).
+        per_diag.append(np.max((chan_side, subint_side), axis=0))
+    return np.median(per_diag, axis=0)

@@ -1,0 +1,15 @@
+"""The append-only ``clean.log`` (reference ``/root/reference/iterative_cleaner.py:174-177``)."""
+
+from __future__ import annotations
+
+import datetime
+
+
+def append_clean_log(ar_name: str, args_namespace, loops: int,
+                     log_path: str = "clean.log") -> None:
+    """One line per cleaned archive: timestamp, archive name, the full
+    argument namespace repr, and the loop count — the reference's exact
+    format."""
+    with open(log_path, "a") as f:
+        f.write("\n %s: Cleaned %s with %s, required loops=%s"
+                % (datetime.datetime.now(), ar_name, args_namespace, loops))

@@ -1,0 +1,27 @@
+"""Diagnostic zap plot (reference ``/root/reference/iterative_cleaner.py:165-171``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def save_zap_plot(scores: np.ndarray, ar_name: str, chanthresh: float,
+                  subintthresh: float) -> str:
+    """Imshow of the zap scores with the reference's exact presentation:
+    coolwarm, vmin/vmax pinched around the zap threshold so red = zapped and
+    blue = kept, y-axis inverted, threshold values in the title, saved to
+    ``<name>_<cthresh>_<sthresh>.png``."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.cm as cm
+    import matplotlib.pyplot as plt
+
+    plt.imshow(scores.T, vmin=0.999, vmax=1.001, aspect="auto",
+               interpolation="nearest", cmap=cm.coolwarm)
+    plt.gca().invert_yaxis()
+    plt.title("%s cthresh=%s sthresh=%s" % (ar_name, chanthresh, subintthresh))
+    out = "%s_%s_%s.png" % (ar_name, chanthresh, subintthresh)
+    plt.savefig(out, bbox_inches="tight")
+    plt.close()
+    return out

@@ -1,0 +1,26 @@
+"""Test session configuration.
+
+Must run before anything imports jax: forces the CPU platform with 8 virtual
+XLA host devices so sharding/multi-chip tests run without TPU hardware
+(SURVEY.md section 4, "multi-device tests without a cluster"), and enables
+x64 so exact-parity tests against the float64 numpy oracle are meaningful
+(the backends still cast to their configured dtypes explicitly).
+"""
+
+import os
+
+# XLA_FLAGS must be in the environment before the CPU client is created
+# (jax may already be imported by the environment's sitecustomize, but the
+# CPU backend itself initialises lazily).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# config.update (not env vars): sitecustomize may have imported jax already
+# with JAX_PLATFORMS pointing at a TPU tunnel.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

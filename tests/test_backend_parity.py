@@ -1,0 +1,121 @@
+"""End-to-end backend parity: the jax engine must reproduce the numpy
+oracle's final RFI mask bit-for-bit (the north star in BASELINE.md), plus
+detection-quality checks against the synthetic ground truth."""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.backends import clean_archive, get_backend
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+
+def _run_both(ar, **cfg_kwargs):
+    res_np = clean_archive(ar.clone(), CleanConfig(backend="numpy", **cfg_kwargs))
+    res_jx = clean_archive(ar.clone(), CleanConfig(backend="jax", **cfg_kwargs))
+    return res_np, res_jx
+
+
+@pytest.mark.parametrize("seed,kwargs", [
+    (0, dict()),
+    (1, dict(n_prezapped=8)),
+    (2, dict(nsub=8, nchan=16, nbin=64, n_rfi_cells=3)),
+    (3, dict(n_rfi_channels=2, n_rfi_subints=0)),
+])
+def test_final_mask_bit_identical(seed, kwargs):
+    ar, _ = make_synthetic_archive(seed=seed, **kwargs)
+    res_np, res_jx = _run_both(ar, dtype="float64")
+    np.testing.assert_array_equal(res_np.zap_mask(), res_jx.zap_mask())
+    assert res_np.loops == res_jx.loops
+    assert res_np.converged == res_jx.converged
+    np.testing.assert_array_equal(res_np.final_weights, res_jx.final_weights)
+
+
+def test_final_mask_float32_jax_path():
+    # the production dtype: mask parity still expected on well-separated RFI
+    ar, _ = make_synthetic_archive(seed=4, rfi_strength=60.0)
+    res_np, res_jx = _run_both(ar, dtype="float32")
+    np.testing.assert_array_equal(res_np.zap_mask(), res_jx.zap_mask())
+
+
+def test_detects_impulsive_cells_and_keeps_prezapped():
+    ar, truth = make_synthetic_archive(seed=5, n_prezapped=6, rfi_strength=80.0)
+    res = clean_archive(ar.clone(), CleanConfig(backend="jax"))
+    zap = res.zap_mask()
+    # every injected impulsive cell is zapped
+    for s, c in truth.rfi_cells:
+        assert zap[s, c], f"missed injected RFI at ({s},{c})"
+    # originally-zapped cells stay zapped (weights only ever go to zero)
+    assert zap[truth.prezapped].all()
+
+
+def test_clean_data_mostly_survives():
+    ar, truth = make_synthetic_archive(seed=6, n_rfi_cells=4,
+                                       n_rfi_channels=1, n_rfi_subints=1)
+    res = clean_archive(ar.clone(), CleanConfig(backend="jax"))
+    zap = res.zap_mask()
+    good = ~truth.expected_zap(ar.nsub, ar.nchan)
+    false_pos = (zap & good).sum() / good.sum()
+    assert false_pos < 0.05, f"false-positive rate {false_pos:.3f}"
+
+
+def test_loop_telemetry_shapes():
+    ar, _ = make_synthetic_archive(seed=7)
+    res = clean_archive(ar.clone(), CleanConfig(backend="jax"))
+    assert res.loop_diffs is not None and len(res.loop_diffs) == res.loops
+    assert res.loop_rfi_frac is not None and len(res.loop_rfi_frac) == res.loops
+    assert 0.0 <= res.rfi_fraction <= 1.0
+
+
+def test_residual_output():
+    ar, _ = make_synthetic_archive(seed=8)
+    cfg = CleanConfig(backend="jax", unload_res=True)
+    res = clean_archive(ar.clone(), cfg)
+    assert res.residual is not None
+    assert res.residual.shape == (ar.nsub, ar.nchan, ar.nbin)
+    res_np = clean_archive(ar.clone(), CleanConfig(backend="numpy",
+                                                   unload_res=True,
+                                                   dtype="float64"))
+    # residual is the pulse-free cube: pulse energy mostly removed
+    resid_power = np.abs(res.residual[res_np.final_weights > 0]).mean()
+    raw_power = np.abs(ar.total_intensity()[res_np.final_weights > 0]).mean()
+    assert resid_power < raw_power
+
+
+def test_nonbinary_weights_preserved():
+    # weights are values, not booleans: survivors keep their original weight
+    ar, _ = make_synthetic_archive(seed=9)
+    ar.weights[:] = 0.5
+    ar.weights[0, 0] = 0.0
+    res = clean_archive(ar.clone(), CleanConfig(backend="numpy", dtype="float64"))
+    kept = res.final_weights[~res.zap_mask()]
+    assert np.all(kept == 0.5)
+
+
+def test_max_iter_cap():
+    ar, _ = make_synthetic_archive(seed=10)
+    for backend in ("numpy", "jax"):
+        res = clean_archive(ar.clone(), CleanConfig(backend=backend, max_iter=1))
+        assert res.loops == 1
+
+
+def test_bad_parts_sweep():
+    from iterative_cleaner_tpu.backends.base import sweep_bad_lines
+
+    w = np.ones((4, 6))
+    w[1, :5] = 0.0   # 5/6 channels of subint 1 zapped
+    w[:3, 2] = 0.0   # 3/4 subints of channel 2 zapped
+    out, nbs, nbc = sweep_bad_lines(w, bad_subint=0.5, bad_chan=0.5)
+    assert nbs == 1 and nbc == 1
+    assert (out[1] == 0).all() and (out[:, 2] == 0).all()
+    # strict '>' with thresholds of 1.0 disables the sweep (quirk 10)
+    out2, nbs2, nbc2 = sweep_bad_lines(w, bad_subint=1.0, bad_chan=1.0)
+    assert nbs2 == 0 and nbc2 == 0
+    np.testing.assert_array_equal(out2, w)
+
+
+def test_backend_registry():
+    assert get_backend("numpy").__name__.endswith("numpy_backend")
+    assert get_backend("jax").__name__.endswith("jax_backend")
+    with pytest.raises(ValueError):
+        get_backend("torch")

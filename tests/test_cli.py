@@ -1,0 +1,119 @@
+"""CLI surface tests: flags, naming rules, log, plot, residual output
+(reference /root/reference/iterative_cleaner.py:16-62,148-177)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.cli import build_parser, clean_one, main
+from iterative_cleaner_tpu.io import load_archive, make_synthetic_archive, save_archive
+
+
+@pytest.fixture()
+def archive_file(tmp_path):
+    ar, _ = make_synthetic_archive(nsub=8, nchan=16, nbin=64, seed=0)
+    path = tmp_path / "obs.npz"
+    save_archive(ar, str(path))
+    return str(path)
+
+
+def test_flag_surface_defaults():
+    args = build_parser().parse_args(["x.npz"])
+    assert args.chanthresh == 5 and args.subintthresh == 5
+    assert args.max_iter == 5
+    assert args.pulse_region == [0, 0, 1]
+    assert args.output == ""
+    assert args.bad_chan == 1 and args.bad_subint == 1
+    assert not args.print_zap and not args.unload_res and not args.pscrunch
+    assert not args.quiet and not args.no_log and not args.memory
+    assert args.backend == "jax"
+
+
+def test_short_flags_parse():
+    args = build_parser().parse_args(
+        ["-c", "3", "-s", "4", "-m", "2", "-z", "-u", "-p", "-q", "-l",
+         "-r", "0.5", "10", "20", "-o", "out.npz", "a.npz", "b.npz"]
+    )
+    assert args.chanthresh == 3 and args.subintthresh == 4
+    assert args.max_iter == 2 and args.pulse_region == [0.5, 10, 20]
+    assert args.archive == ["a.npz", "b.npz"]
+
+
+def test_default_output_naming(archive_file, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    main(["-q", "--backend", "numpy", archive_file])
+    out = archive_file + "_cleaned.npz"
+    assert os.path.exists(out)
+    cleaned = load_archive(out)
+    assert cleaned.data.shape == load_archive(archive_file).data.shape
+
+
+def test_std_output_naming(archive_file, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    ar = load_archive(archive_file)
+    main(["-q", "-l", "--backend", "numpy", "-o", "std", archive_file])
+    expect = "%s.%.3f.%f%s" % (ar.source, ar.centre_freq_mhz, ar.mjd_mid, ".npz")
+    assert os.path.exists(os.path.join(str(tmp_path), expect))
+
+
+def test_explicit_output_and_log(archive_file, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    main(["-q", "--backend", "numpy", "-o", "c.npz", archive_file])
+    assert os.path.exists("c.npz")
+    assert os.path.exists("clean.log")
+    text = open("clean.log").read()
+    assert "Cleaned" in text and "required loops=" in text
+
+
+def test_no_log_flag(archive_file, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    main(["-q", "-l", "--backend", "numpy", archive_file])
+    assert not os.path.exists("clean.log")
+
+
+def test_zap_plot(archive_file, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    main(["-q", "-l", "-z", "--backend", "numpy", archive_file])
+    pngs = [f for f in os.listdir(".") if f.endswith(".png")]
+    assert len(pngs) == 1
+    # argparse leaves the untouched default as int 5, so the reference's
+    # "%s_%s_%s.png" pattern yields "_5_5.png"
+    assert pngs[0].endswith("_5_5.png")
+
+
+def test_residual_unload(archive_file, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    main(["-q", "-l", "-u", "--backend", "numpy", archive_file])
+    residuals = [f for f in os.listdir(".") if "_residual_" in f]
+    assert len(residuals) == 1
+    res = load_archive(residuals[0])
+    assert res.npol == 1
+
+
+def test_progress_output(archive_file, tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    main(["-l", "--backend", "numpy", archive_file])
+    out = capsys.readouterr().out
+    assert "Total number of profiles: 128" in out
+    assert "Loop: 1" in out
+    assert "Differences to previous weights:" in out
+    assert ("RFI removal stops after" in out
+            or "Cleaning was interrupted" in out)
+    assert "Cleaned archive:" in out
+
+
+def test_quiet_suppresses_output(archive_file, tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    main(["-q", "-l", "--backend", "numpy", archive_file])
+    assert capsys.readouterr().out == ""
+
+
+def test_weights_written_back(archive_file, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    main(["-q", "-l", "--backend", "jax", "-o", "j.npz", archive_file])
+    cleaned = load_archive("j.npz")
+    original = load_archive(archive_file)
+    # data unchanged, weights zapped somewhere
+    np.testing.assert_allclose(cleaned.data, original.data, rtol=1e-6)
+    assert (cleaned.weights == 0).sum() > 0

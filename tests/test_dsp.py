@@ -1,0 +1,134 @@
+"""DSP primitive tests: rotation, dispersion, baseline, scrunching, shared
+between the numpy and jax instantiations of ops/dsp.py."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.archive import KDM_S
+from iterative_cleaner_tpu.ops.dsp import (
+    baseline_offsets,
+    dedisperse_cube,
+    dispersion_shift_bins,
+    remove_baseline,
+    rotate_bins,
+    weighted_template,
+)
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+class TestRotate:
+    def test_integer_shift_matches_roll(self, xp):
+        rng = np.random.default_rng(0)
+        x = xp.asarray(rng.normal(size=(3, 4, 16)))
+        for s in (0, 1, 5, -3, 16, 21):
+            got = np.asarray(rotate_bins(x, float(s), xp, method="fourier"))
+            want = np.roll(np.asarray(x), s, axis=-1)
+            np.testing.assert_allclose(got, want, atol=1e-9)
+            got_roll = np.asarray(rotate_bins(x, float(s), xp, method="roll"))
+            np.testing.assert_allclose(got_roll, want, atol=0)
+
+    def test_per_channel_shifts(self, xp):
+        rng = np.random.default_rng(1)
+        x = xp.asarray(rng.normal(size=(2, 3, 32)))
+        shifts = xp.asarray([0.0, 4.0, -7.0])
+        got = np.asarray(rotate_bins(x, shifts, xp, method="roll"))
+        base = np.asarray(x)
+        for c, s in enumerate([0, 4, -7]):
+            np.testing.assert_array_equal(got[:, c], np.roll(base[:, c], s, axis=-1))
+
+    def test_fractional_rotation_invertible(self, xp):
+        # exact on band-limited profiles (the Nyquist bin of a fractionally
+        # rotated real signal attenuates by cos(pi*s); see rotate_bins)
+        rng = np.random.default_rng(2)
+        raw = rng.normal(size=(4, 64))
+        spec = np.fft.rfft(raw, axis=-1)
+        spec[..., -1] = 0.0
+        x = xp.asarray(np.fft.irfft(spec, n=64, axis=-1))
+        s = 2.37
+        back = rotate_bins(rotate_bins(x, s, xp), -s, xp)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=1e-9)
+
+    def test_fractional_rotation_nyquist_attenuation(self, xp):
+        nbin = 16
+        x = xp.asarray(np.cos(np.pi * np.arange(nbin))[None])  # pure Nyquist
+        s = 0.5
+        out = np.asarray(rotate_bins(x, s, xp))
+        np.testing.assert_allclose(
+            out, np.asarray(x) * np.cos(np.pi * s), atol=1e-9
+        )
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+class TestDispersion:
+    def test_shift_sign_and_magnitude(self, xp):
+        freqs = xp.asarray([1300.0, 1400.0, 1500.0])
+        nbin, period, dm = 256, 0.5, 30.0
+        shifts = np.asarray(
+            dispersion_shift_bins(freqs, dm, 1400.0, period, nbin, xp)
+        )
+        assert shifts[1] == pytest.approx(0.0)
+        assert shifts[0] > 0  # below the reference frequency arrives later
+        assert shifts[2] < 0
+        expect0 = KDM_S * dm * (1300.0 ** -2 - 1400.0 ** -2) / period * nbin
+        assert shifts[0] == pytest.approx(expect0)
+
+    def test_dedisperse_aligns_dispersed_pulse(self, xp):
+        nchan, nbin = 8, 128
+        freqs = np.linspace(1300.0, 1500.0, nchan)
+        period, dm = 0.7, 50.0
+        profile = np.exp(-0.5 * ((np.arange(nbin) - 40) / 3.0) ** 2)
+        cube = np.broadcast_to(profile, (2, nchan, nbin)).copy()
+        dispersed = dedisperse_cube(
+            xp.asarray(cube), xp.asarray(freqs), dm, 1400.0, period, xp,
+            forward=False,
+        )
+        restored = dedisperse_cube(
+            dispersed, xp.asarray(freqs), dm, 1400.0, period, xp, forward=True
+        )
+        np.testing.assert_allclose(np.asarray(restored), cube, atol=1e-8)
+        # and the dispersed cube really is misaligned across channels
+        peaks = np.argmax(np.asarray(dispersed)[0], axis=-1)
+        assert len(np.unique(peaks)) > 1
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+class TestBaseline:
+    def test_flat_profile_baseline_is_level(self, xp):
+        x = xp.asarray(np.full((3, 32), 7.5))
+        off = np.asarray(baseline_offsets(x, xp))
+        np.testing.assert_allclose(off, 7.5)
+
+    def test_pulse_ignored_by_min_window(self, xp):
+        nbin = 100
+        prof = np.full(nbin, 2.0)
+        prof[40:50] += 50.0  # pulse
+        off = float(np.asarray(baseline_offsets(xp.asarray(prof[None]), xp))[0])
+        assert off == pytest.approx(2.0)
+        removed = np.asarray(remove_baseline(xp.asarray(prof[None]), xp))[0]
+        assert removed[0] == pytest.approx(0.0)
+        assert removed[45] == pytest.approx(50.0)
+
+    def test_cyclic_window(self, xp):
+        # the quiet region wraps around the end of the profile
+        nbin = 64
+        prof = np.full(nbin, 1.0)
+        prof[10:58] += 100.0
+        off = float(np.asarray(baseline_offsets(xp.asarray(prof[None]), xp))[0])
+        assert off == pytest.approx(1.0)
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+def test_weighted_template(xp):
+    cube = np.zeros((2, 3, 4))
+    cube[0, 0] = [1, 2, 3, 4]
+    cube[1, 2] = [10, 20, 30, 40]
+    w = np.zeros((2, 3))
+    w[0, 0] = 1.0
+    w[1, 2] = 3.0
+    t = np.asarray(weighted_template(xp.asarray(cube), xp.asarray(w), xp))
+    want = (np.array([1, 2, 3, 4]) + 3 * np.array([10, 20, 30, 40])) / 4.0
+    np.testing.assert_allclose(t, want)
+    # all-zero weights must not divide by zero
+    t0 = np.asarray(weighted_template(xp.asarray(cube), xp.zeros((2, 3)), xp))
+    np.testing.assert_array_equal(t0, 0.0)

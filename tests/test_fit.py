@@ -1,0 +1,68 @@
+"""Closed-form template-amplitude fit vs the reference's MINPACK call.
+
+The reference fits err(amp) = amp*template - prof per cell with
+scipy.optimize.leastsq (/root/reference/iterative_cleaner.py:277-278); the
+model is linear, so the closed form <t,p>/<t,t> must agree to solver
+tolerance (SURVEY.md section 7, hard part 4)."""
+
+import numpy as np
+import pytest
+import scipy.optimize
+
+from iterative_cleaner_tpu.ops.dsp import (
+    fit_template_amplitudes,
+    template_residuals,
+)
+
+
+def minpack_amp(template, prof):
+    params, status = scipy.optimize.leastsq(
+        lambda amp: amp * template - prof, [1.0]
+    )
+    assert status in (1, 2, 3, 4)
+    return float(params[0])
+
+
+def test_matches_minpack_on_random_profiles():
+    rng = np.random.default_rng(7)
+    nbin = 64
+    template = np.exp(-0.5 * ((np.arange(nbin) - 20) / 4.0) ** 2) * 1e4
+    cube = rng.normal(size=(3, 5, nbin)) + 2.0 * np.exp(
+        -0.5 * ((np.arange(nbin) - 20) / 4.0) ** 2
+    )
+    amps = fit_template_amplitudes(cube, template, np)
+    for s in range(3):
+        for c in range(5):
+            assert amps[s, c] == pytest.approx(
+                minpack_amp(template, cube[s, c]), rel=1e-6, abs=1e-12
+            )
+
+
+def test_residual_sign_convention():
+    # stored residual is amp*template - profile (reference :277,:279)
+    template = np.array([0.0, 1.0, 0.0, 0.0])
+    cube = np.array([[[1.0, 3.0, 1.0, 1.0]]])
+    amps = fit_template_amplitudes(cube, template, np)
+    assert amps[0, 0] == pytest.approx(3.0)
+    resid = template_residuals(cube, template, amps, (0, 0), 1.0, np, False)
+    np.testing.assert_allclose(resid[0, 0], [-1.0, 0.0, -1.0, -1.0])
+
+
+def test_pulse_region_uses_reference_argument_order():
+    # -r FACTOR START END in effect (SURVEY.md 2.4 quirk 3): region bins are
+    # scaled by pulse_region[0] over [int(pr[1]), int(pr[2])).
+    template = np.zeros(8)
+    cube = np.ones((1, 1, 8))
+    amps = np.ones((1, 1))
+    resid = template_residuals(cube, template, amps, (2, 5), 0.5, np, True)
+    expect = -np.ones(8)
+    expect[2:5] *= 0.5
+    np.testing.assert_allclose(resid[0, 0], expect)
+
+
+def test_zero_template_returns_unit_amplitude():
+    # MINPACK returns the initial guess 1.0 on a flat objective; the closed
+    # form reproduces that instead of 0/0.
+    cube = np.ones((2, 2, 4))
+    amps = fit_template_amplitudes(cube, np.zeros(4), np)
+    np.testing.assert_array_equal(amps, 1.0)

