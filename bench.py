@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Benchmark: surgical-scrub cleaning throughput, jax/TPU vs the numpy oracle.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "cell-iters/s", "vs_baseline": N}
+
+- value: (nsub * nchan * loops) / wall-clock seconds for the compiled jax
+  path on the high-res config (BASELINE.md config 3: 1024 subints x 4096
+  channels), steady-state with the cube resident in HBM (the north star's
+  "load once into HBM" model; the one-off H2D transfer is reported on
+  stderr).
+- vs_baseline: that rate divided by the numpy oracle's rate, measured on a
+  proportionally smaller slice (the oracle is O(cells) throughout, so
+  per-cell-iteration rates are comparable; full-size oracle runs take tens
+  of minutes on one CPU core).
+
+Environment knobs: BENCH_SMALL=1 shrinks everything for a quick smoke run.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=3):
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import build_clean_fn
+    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+    ar, _ = make_synthetic_archive(
+        nsub=nsub, nchan=nchan, nbin=nbin,
+        n_rfi_cells=max(8, nsub * nchan // 2048),
+        n_rfi_channels=max(1, nchan // 512),
+        n_rfi_subints=max(1, nsub // 512),
+        seed=0, dtype=np.float32,
+    )
+    fn = build_clean_fn(max_iter, 5.0, 5.0, (0, 0), 1.0, False, "fourier",
+                        0.15, False, "fft")
+    dev = jax.devices()[0]
+    _log(f"jax device: {dev.platform} {getattr(dev, 'device_kind', '?')}")
+
+    t0 = time.perf_counter()
+    cube = jax.device_put(jnp.asarray(ar.total_intensity()), dev)
+    weights = jax.device_put(jnp.asarray(ar.weights), dev)
+    freqs = jax.device_put(jnp.asarray(ar.freqs_mhz), dev)
+    args = (cube, weights, freqs,
+            jnp.float32(ar.dm), jnp.float32(ar.centre_freq_mhz),
+            jnp.float32(ar.period_s))
+    cube.block_until_ready()
+    h2d = time.perf_counter() - t0
+    _log(f"H2D transfer of {cube.nbytes / 1e9:.2f} GB cube: {h2d:.3f}s")
+
+    t0 = time.perf_counter()
+    outs, _ = fn(*args)
+    outs.final_weights.block_until_ready()
+    compile_and_first = time.perf_counter() - t0
+    loops = int(outs.loops)
+    _log(f"compile+first run: {compile_and_first:.2f}s, loops={loops}, "
+         f"rfi_frac={float((np.asarray(outs.final_weights) == 0).mean()):.4f}")
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        outs, _ = fn(*args)
+        outs.final_weights.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    loops = int(outs.loops)
+    rate = nsub * nchan * loops / best
+    _log(f"jax steady-state: {best * 1e3:.1f} ms/clean ({loops} loops) "
+         f"-> {rate:.3e} cell-iters/s")
+    return rate
+
+
+def bench_numpy(nsub, nchan, nbin, max_iter=5):
+    from iterative_cleaner_tpu.backends.numpy_backend import clean_cube
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+    ar, _ = make_synthetic_archive(
+        nsub=nsub, nchan=nchan, nbin=nbin,
+        n_rfi_cells=max(8, nsub * nchan // 2048),
+        n_rfi_channels=max(1, nchan // 512),
+        n_rfi_subints=max(1, nsub // 512),
+        seed=0, dtype=np.float64,
+    )
+    cfg = CleanConfig(backend="numpy", max_iter=max_iter)
+    t0 = time.perf_counter()
+    res = clean_cube(ar.total_intensity(), ar.weights, ar.freqs_mhz, ar.dm,
+                     ar.centre_freq_mhz, ar.period_s, cfg)
+    dt = time.perf_counter() - t0
+    rate = nsub * nchan * res.loops / dt
+    _log(f"numpy oracle ({nsub}x{nchan}x{nbin}): {dt:.2f}s "
+         f"({res.loops} loops) -> {rate:.3e} cell-iters/s")
+    return rate
+
+
+def main():
+    small = os.environ.get("BENCH_SMALL") == "1"
+    if small:
+        jax_cfg = (64, 128, 64)
+        np_cfg = (32, 64, 64)
+    else:
+        jax_cfg = (1024, 4096, 128)   # BASELINE.md config 3
+        np_cfg = (256, 1024, 128)     # 1/16 of the cells, same math
+
+    np_rate = bench_numpy(*np_cfg)
+
+    jax_rate = None
+    for cfg in (jax_cfg, (512, 4096, 128), (512, 2048, 128)):
+        try:
+            jax_rate = bench_jax(*cfg)
+            jax_cfg = cfg
+            break
+        except Exception as e:  # OOM fallback ladder
+            _log(f"jax bench failed at {cfg}: {type(e).__name__}: {e}")
+    if jax_rate is None:
+        raise SystemExit("all jax bench configs failed")
+
+    print(json.dumps({
+        "metric": "cells_cleaned_per_sec_%dx%d" % (jax_cfg[0], jax_cfg[1]),
+        "value": round(jax_rate, 1),
+        "unit": "cell-iters/s",
+        "vs_baseline": round(jax_rate / np_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -33,7 +33,7 @@ from iterative_cleaner_tpu.ops.dsp import (
 @functools.lru_cache(maxsize=None)
 def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, baseline_duty,
-                   unload_res):
+                   unload_res, fft_mode="fft"):
     """Build (and cache) the jitted whole-archive cleaning program for one
     static configuration."""
 
@@ -47,7 +47,7 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             max_iter=max_iter, chanthresh=chanthresh,
             subintthresh=subintthresh, pulse_slice=pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
-            rotation=rotation,
+            rotation=rotation, fft_mode=fft_mode,
         )
         if not unload_res:
             return outs, None
@@ -72,6 +72,7 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
         config.rotation, config.baseline_duty, config.unload_res,
+        config.fft_mode,
     )
     outs, resid = fn(
         jnp.asarray(cube, dtype=dtype),

@@ -29,6 +29,7 @@ class CleanConfig:
     # --- framework-only parameters ---
     backend: str = "jax"         # {"numpy", "jax"}
     rotation: str = "fourier"    # {"fourier", "roll"} dedispersion rotation
+    fft_mode: str = "fft"        # {"fft", "dft"} rFFT diagnostic backend (jax path)
     baseline_duty: float = 0.15  # off-pulse window fraction for baseline find
     dtype: str = "float32"       # compute dtype on the jax path
     unload_res: bool = False     # -u: also produce the pulse-free residual
@@ -55,5 +56,7 @@ class CleanConfig:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.rotation not in ("fourier", "roll"):
             raise ValueError(f"unknown rotation method {self.rotation!r}")
+        if self.fft_mode not in ("fft", "dft"):
+            raise ValueError(f"unknown fft mode {self.fft_mode!r}")
         if self.max_iter < 1:
             raise ValueError("max_iter must be >= 1")

@@ -29,6 +29,7 @@ same signature.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 # numpy.ma default float fill value, observable through quirk 4.
@@ -81,7 +82,35 @@ def scale_lines_plain(diag, axis, thresh):
     return jnp.abs(centred / mad) / thresh
 
 
-def surgical_scores_jax(resid_weighted, cell_mask, chanthresh, subintthresh):
+def rfft_magnitudes(x, mode="fft"):
+    """|rfft| along the last axis.
+
+    mode="fft" uses the FFT; mode="dft" computes the same magnitudes with two
+    real matmuls against a cos/sin basis — mathematically identical, maps
+    onto the TPU MXU (where XLA's FFT is comparatively weak), and avoids the
+    XLA:CPU fft-thunk layout restriction under sharding.
+    """
+    if mode == "fft":
+        return jnp.abs(jnp.fft.rfft(x, axis=-1))
+    if mode != "dft":
+        raise ValueError(f"unknown fft mode {mode!r}")
+    nbin = x.shape[-1]
+    ang = (-2.0 * jnp.pi / nbin) * jnp.outer(
+        jnp.arange(nbin, dtype=x.dtype), jnp.arange(nbin // 2 + 1, dtype=x.dtype)
+    )
+    re = jax.lax.dot_general(
+        x, jnp.cos(ang), (((x.ndim - 1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    im = jax.lax.dot_general(
+        x, jnp.sin(ang), (((x.ndim - 1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return jnp.sqrt(re * re + im * im)
+
+
+def surgical_scores_jax(resid_weighted, cell_mask, chanthresh, subintthresh,
+                        fft_mode="fft"):
     """Zap scores for every (subint, channel) cell; score >= 1 means zap.
 
     Mirrors reference :202-226 under the explicit-mask rules above.  Since
@@ -98,7 +127,7 @@ def surgical_scores_jax(resid_weighted, cell_mask, chanthresh, subintthresh):
     d_ptp = jnp.where(m, jnp.asarray(MA_FILL, x.dtype),
                       jnp.max(x, axis=2) - jnp.min(x, axis=2))
     centred = x - jnp.where(m, 0.0, mean_b)[..., None]
-    d_fft = jnp.max(jnp.abs(jnp.fft.rfft(centred, axis=2)), axis=2)
+    d_fft = jnp.max(rfft_magnitudes(centred, fft_mode), axis=2)
 
     per_diag = []
     for diag in (d_std, d_mean, d_ptp):
