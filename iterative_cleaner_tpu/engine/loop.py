@@ -131,7 +131,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
         # per-loop operator telemetry (reference :129-134)
         diff = jnp.sum(new_w != c.weights).astype(jnp.int32)
         frac = jnp.mean((new_w == 0).astype(ded_cube.dtype))
-        return _Carry(
+        stepped = _Carry(
             x=c.x + 1,
             weights=new_w,
             history=history,
@@ -143,6 +143,12 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
             loop_diffs=c.loop_diffs.at[c.x].set(diff),
             loop_rfi_frac=c.loop_rfi_frac.at[c.x].set(frac),
         )
+        # Under vmap, while_loop keeps running the body until every batch
+        # element's cond is false; freeze already-finished elements so batched
+        # cleaning (parallel/batch.py) preserves single-archive semantics.
+        active = cond(c)
+        return jax.tree.map(lambda new, old: jnp.where(active, new, old),
+                            stepped, c)
 
     out = lax.while_loop(cond, body, init)
     return CleanOutputs(

@@ -7,3 +7,11 @@ is the TPU-native replacement: ``jax.sharding.Mesh`` + NamedSharding/
 ICI, ``vmap`` batching of equal-shaped archives, and an online subint-chunked
 streaming mode for long observations.
 """
+
+from iterative_cleaner_tpu.parallel.batch import clean_archives_batched  # noqa: F401
+from iterative_cleaner_tpu.parallel.mesh import batch_mesh, cell_mesh, factor_2d  # noqa: F401
+from iterative_cleaner_tpu.parallel.sharding import clean_archive_sharded  # noqa: F401
+from iterative_cleaner_tpu.parallel.streaming import (  # noqa: F401
+    StreamingCleaner,
+    clean_streaming,
+)

@@ -1,0 +1,141 @@
+"""Batched archive cleaning: vmap over equal-shaped archives, optionally
+sharded over a 'batch' mesh axis.
+
+Replaces the reference's sequential per-archive loop
+(``/root/reference/iterative_cleaner.py:46``) with a single compiled program
+cleaning B archives at once (BASELINE.md config 4).  Archive cleaning is
+embarrassingly parallel — the only cross-device communication under batch
+sharding is the final result gather.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from iterative_cleaner_tpu.archive import Archive
+from iterative_cleaner_tpu.backends.base import CleanResult, sweep_bad_lines
+from iterative_cleaner_tpu.config import CleanConfig
+
+
+@functools.lru_cache(maxsize=None)
+def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
+                           pulse_scale, pulse_active, rotation, baseline_duty,
+                           fft_mode):
+    """Jitted batched cleaner: every per-archive input gains a leading batch
+    axis; scalars (dm, period, ref freq) are per-archive vectors."""
+    import jax
+
+    from iterative_cleaner_tpu.engine.loop import (
+        clean_dedispersed_jax,
+        prepare_cube_jax,
+    )
+
+    def one(cube, weights, freqs, dm, ref, period):
+        ded, shifts = prepare_cube_jax(
+            cube, freqs, dm, ref, period,
+            baseline_duty=baseline_duty, rotation=rotation,
+        )
+        return clean_dedispersed_jax(
+            ded, weights, shifts, max_iter=max_iter, chanthresh=chanthresh,
+            subintthresh=subintthresh, pulse_slice=pulse_slice,
+            pulse_scale=pulse_scale, pulse_active=pulse_active,
+            rotation=rotation, fft_mode=fft_mode,
+        )
+
+    return jax.jit(jax.vmap(one))
+
+
+def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
+                           mesh=None) -> List[CleanResult]:
+    """Clean a batch of equal-shaped archives in one compiled call.
+
+    With ``mesh`` (a 1-D ('batch',) mesh from
+    :func:`iterative_cleaner_tpu.parallel.mesh.batch_mesh`), inputs are
+    sharded across devices along the batch axis; the batch is zero-weight
+    padded up to a multiple of the device count (padded archives clean
+    trivially and are dropped from the results).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not archives:
+        return []
+    shapes = {(a.nsub, a.nchan, a.nbin) for a in archives}
+    if len(shapes) != 1:
+        raise ValueError(
+            f"batched cleaning needs equal-shaped archives, got {shapes}; "
+            "bucket by shape first (parallel.streaming handles ragged time "
+            "axes)"
+        )
+    dtype = jnp.dtype(config.dtype)
+    n = len(archives)
+    pad = 0
+    if mesh is not None:
+        per = int(np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
+        pad = (-n) % per
+
+    def stack(get, pad_like=None):
+        arrs = [np.asarray(get(a)) for a in archives]
+        if pad:
+            filler = np.zeros_like(arrs[0]) if pad_like is None else pad_like
+            arrs = arrs + [filler] * pad
+        return jnp.asarray(np.stack(arrs), dtype=dtype)
+
+    cubes = stack(lambda a: a.total_intensity())
+    weights = stack(lambda a: a.weights)
+    # pad freqs/ref/period away from zero so the padded archives' dispersion
+    # delays are 0/1 = finite (dm pads to 0, so shifts are exactly zero)
+    freqs = stack(lambda a: a.freqs_mhz,
+                  pad_like=np.ones_like(np.asarray(archives[0].freqs_mhz)))
+    dms = stack(lambda a: a.dm)
+    refs = stack(lambda a: a.centre_freq_mhz, pad_like=np.float64(1.0))
+    periods = stack(lambda a: a.period_s, pad_like=np.float64(1.0))
+
+    fn = build_batched_clean_fn(
+        config.max_iter, config.chanthresh, config.subintthresh,
+        config.pulse_slice, config.pulse_scale, config.pulse_region_active,
+        config.rotation, config.baseline_duty, config.fft_mode,
+    )
+    args = (cubes, weights, freqs, dms, refs, periods)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def shard(x):
+            spec = P("batch", *([None] * (x.ndim - 1)))
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        args = tuple(shard(x) for x in args)
+        with mesh:
+            outs = fn(*args)
+    else:
+        outs = fn(*args)
+
+    results: List[CleanResult] = []
+    final_w = np.asarray(outs.final_weights)
+    scores = np.asarray(outs.scores)
+    loops_v = np.asarray(outs.loops)
+    conv_v = np.asarray(outs.converged)
+    diffs = np.asarray(outs.loop_diffs)
+    fracs = np.asarray(outs.loop_rfi_frac)
+    for i in range(n):
+        loops = int(loops_v[i])
+        result = CleanResult(
+            final_weights=final_w[i],
+            scores=scores[i],
+            loops=loops,
+            converged=bool(conv_v[i]),
+            loop_diffs=diffs[i][:loops],
+            loop_rfi_frac=fracs[i][:loops],
+        )
+        if config.bad_chan != 1 or config.bad_subint != 1:
+            swept, nbs, nbc = sweep_bad_lines(
+                result.final_weights, config.bad_subint, config.bad_chan
+            )
+            result.final_weights = swept
+            result.n_bad_subints = nbs
+            result.n_bad_channels = nbc
+        results.append(result)
+    return results

@@ -1,0 +1,41 @@
+"""Device-mesh construction helpers."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def factor_2d(n: int) -> tuple[int, int]:
+    """Factor n devices into the most-square (a, b) grid with a*b == n."""
+    for a in range(int(math.isqrt(n)), 0, -1):
+        if n % a == 0:
+            return a, n // a
+    return 1, n
+
+
+def cell_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None):
+    """2-D ('sub', 'chan') mesh over the (subint, channel) cell grid —
+    the production sharding for one large archive (SURVEY.md section 2.3)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    a, b = factor_2d(len(devs))
+    return Mesh(np.array(devs).reshape(a, b), ("sub", "chan"))
+
+
+def batch_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None):
+    """1-D ('batch',) mesh: embarrassingly-parallel archive batching
+    (BASELINE.md config 4 — no collectives cross archives)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), ("batch",))

@@ -1,0 +1,102 @@
+"""Sharded single-archive cleaning over a 2-D ('sub', 'chan') mesh.
+
+The GSPMD path: the cube and weight matrix are sharded over the (subint,
+channel) cell grid with NamedSharding; XLA inserts the collectives — the
+channel-scaler medians reduce across the 'sub' mesh axis and the
+subint-scaler medians across 'chan', plus a global psum for the template
+(SURVEY.md section 2.3).  All collectives ride ICI on a real slice.
+
+Shard-level mask equality against the single-device engine is covered by
+tests/test_parallel.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from iterative_cleaner_tpu.archive import Archive
+from iterative_cleaner_tpu.backends.base import CleanResult, sweep_bad_lines
+from iterative_cleaner_tpu.config import CleanConfig
+
+
+@functools.lru_cache(maxsize=None)
+def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
+                           pulse_slice, pulse_scale, pulse_active, rotation,
+                           baseline_duty, fft_mode):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from iterative_cleaner_tpu.engine.loop import (
+        clean_dedispersed_jax,
+        prepare_cube_jax,
+    )
+
+    mesh = mesh_ref
+    cube_sh = NamedSharding(mesh, P("sub", "chan", None))
+    w_sh = NamedSharding(mesh, P("sub", "chan"))
+    rep = NamedSharding(mesh, P())
+
+    def run(cube, weights, freqs, dm, ref, period):
+        ded, shifts = prepare_cube_jax(
+            cube, freqs, dm, ref, period, baseline_duty=baseline_duty,
+            rotation=rotation,
+        )
+        return clean_dedispersed_jax(
+            ded, weights, shifts, max_iter=max_iter, chanthresh=chanthresh,
+            subintthresh=subintthresh, pulse_slice=pulse_slice,
+            pulse_scale=pulse_scale, pulse_active=pulse_active,
+            rotation=rotation, fft_mode=fft_mode,
+        )
+
+    fn = jax.jit(
+        run,
+        in_shardings=(cube_sh, w_sh, rep, rep, rep, rep),
+        out_shardings=None,  # let GSPMD place outputs
+    )
+    return fn, cube_sh, w_sh, rep
+
+
+def clean_archive_sharded(archive: Archive, config: CleanConfig,
+                          mesh) -> CleanResult:
+    """Clean one (large) archive sharded over ``mesh`` (axes 'sub', 'chan').
+
+    Note: on XLA:CPU test meshes use ``rotation='roll'`` + ``fft_mode='dft'``
+    (the CPU fft thunk rejects sharded layouts); on TPU all modes work.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+    fn, cube_sh, w_sh, rep = build_sharded_clean_fn(
+        mesh, config.max_iter, config.chanthresh, config.subintthresh,
+        config.pulse_slice, config.pulse_scale, config.pulse_region_active,
+        config.rotation, config.baseline_duty, config.fft_mode,
+    )
+    with mesh:
+        outs = fn(
+            jax.device_put(jnp.asarray(archive.total_intensity(), dtype), cube_sh),
+            jax.device_put(jnp.asarray(archive.weights, dtype), w_sh),
+            jax.device_put(jnp.asarray(archive.freqs_mhz, dtype), rep),
+            jnp.asarray(archive.dm, dtype),
+            jnp.asarray(archive.centre_freq_mhz, dtype),
+            jnp.asarray(archive.period_s, dtype),
+        )
+    loops = int(outs.loops)
+    result = CleanResult(
+        final_weights=np.asarray(outs.final_weights),
+        scores=np.asarray(outs.scores),
+        loops=loops,
+        converged=bool(outs.converged),
+        loop_diffs=np.asarray(outs.loop_diffs)[:loops],
+        loop_rfi_frac=np.asarray(outs.loop_rfi_frac)[:loops],
+    )
+    if config.bad_chan != 1 or config.bad_subint != 1:
+        swept, nbs, nbc = sweep_bad_lines(
+            result.final_weights, config.bad_subint, config.bad_chan
+        )
+        result.final_weights = swept
+        result.n_bad_subints = nbs
+        result.n_bad_channels = nbc
+    return result

@@ -1,0 +1,145 @@
+"""Online subint-chunked cleaning for long observations.
+
+BASELINE.md config 5: an 8-hour observation arrives (or is too large to hold)
+as a stream of subints; the cleaner processes fixed-size subint tiles with a
+single compiled program (one jit cache entry for all tiles), emitting the
+cleaned weight tile as each fills.  The reference has no counterpart — it
+loads whole archives into RAM (``/root/reference/iterative_cleaner.py:47,111``).
+
+Semantics per tile are exactly the single-archive engine on that tile.  A
+final partial tile is padded with zero-weight subints: zero weight excludes
+the padding from every statistic (mask semantics of the engine), so a
+partial tile cleans identically to the same subints alone, modulo the
+subint-scaler median population.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from iterative_cleaner_tpu.archive import Archive
+from iterative_cleaner_tpu.backends.base import CleanResult
+from iterative_cleaner_tpu.config import CleanConfig
+
+
+@dataclasses.dataclass
+class StreamTileResult:
+    """Cleaning result for one subint tile."""
+
+    start_subint: int
+    n_valid: int              # valid (non-padding) subints in this tile
+    result: CleanResult
+
+    @property
+    def weights(self) -> np.ndarray:
+        return self.result.final_weights[: self.n_valid]
+
+
+class StreamingCleaner:
+    """Accumulates subints and cleans in fixed-size tiles.
+
+    >>> sc = StreamingCleaner(chunk_nsub=256, config=cfg, freqs_mhz=f,
+    ...                       dm=d, centre_freq_mhz=cf, period_s=p)
+    >>> for block in observation:           # (k, nchan, nbin) pieces
+    ...     for tile in sc.push(block):
+    ...         use(tile.weights)
+    >>> for tile in sc.finish():            # flush the padded final tile
+    ...     use(tile.weights)
+    """
+
+    def __init__(self, chunk_nsub: int, config: CleanConfig, freqs_mhz,
+                 dm: float, centre_freq_mhz: float, period_s: float):
+        self.chunk_nsub = int(chunk_nsub)
+        self.config = config
+        self.freqs_mhz = np.asarray(freqs_mhz)
+        self.dm = float(dm)
+        self.centre_freq_mhz = float(centre_freq_mhz)
+        self.period_s = float(period_s)
+        self._buf: List[np.ndarray] = []       # pending (k, nchan, nbin)
+        self._wbuf: List[np.ndarray] = []      # pending (k, nchan)
+        self._pending = 0
+        self._emitted = 0
+
+    def push(self, data: np.ndarray,
+             weights: Optional[np.ndarray] = None) -> Iterator[StreamTileResult]:
+        """Feed (k, nchan, nbin) subints; yields results for each tile that
+        fills."""
+        data = np.asarray(data)
+        if data.ndim != 3:
+            raise ValueError("push expects (k, nchan, nbin) subint blocks")
+        if weights is None:
+            weights = np.ones(data.shape[:2], dtype=data.dtype)
+        self._buf.append(data)
+        self._wbuf.append(np.asarray(weights))
+        self._pending += data.shape[0]
+        while self._pending >= self.chunk_nsub:
+            yield self._clean_tile(self._take(self.chunk_nsub))
+
+    def finish(self) -> Iterator[StreamTileResult]:
+        """Flush the remaining subints as a zero-weight-padded tile."""
+        if self._pending:
+            yield self._clean_tile(self._take(self._pending))
+
+    # -- internals -----------------------------------------------------------
+    def _take(self, k: int):
+        data = np.concatenate(self._buf, axis=0)
+        weights = np.concatenate(self._wbuf, axis=0)
+        out = (data[:k], weights[:k])
+        rest_d, rest_w = data[k:], weights[k:]
+        self._buf = [rest_d] if rest_d.size else []
+        self._wbuf = [rest_w] if rest_w.size else []
+        self._pending -= k
+        return out
+
+    def _clean_tile(self, taken) -> StreamTileResult:
+        from iterative_cleaner_tpu.backends import get_backend
+
+        data, weights = taken
+        n_valid = data.shape[0]
+        if n_valid < self.chunk_nsub:  # pad the final partial tile
+            pad = self.chunk_nsub - n_valid
+            data = np.concatenate(
+                [data, np.zeros((pad,) + data.shape[1:], data.dtype)], axis=0
+            )
+            weights = np.concatenate(
+                [weights, np.zeros((pad,) + weights.shape[1:], weights.dtype)],
+                axis=0,
+            )
+        backend = get_backend(self.config.backend)
+        result = backend.clean_cube(
+            data, weights, self.freqs_mhz, self.dm, self.centre_freq_mhz,
+            self.period_s, self.config,
+        )
+        tile = StreamTileResult(
+            start_subint=self._emitted, n_valid=n_valid, result=result
+        )
+        self._emitted += n_valid
+        return tile
+
+
+def clean_streaming(archive: Archive, chunk_nsub: int,
+                    config: CleanConfig) -> CleanResult:
+    """Clean a whole archive through the streaming path (tile at a time) and
+    reassemble a full-archive CleanResult.  Used for testing and for archives
+    too large to clean in one device footprint."""
+    sc = StreamingCleaner(
+        chunk_nsub, config, archive.freqs_mhz, archive.dm,
+        archive.centre_freq_mhz, archive.period_s,
+    )
+    cube = archive.total_intensity()
+    tiles: List[StreamTileResult] = []
+    tiles.extend(sc.push(cube, archive.weights))
+    tiles.extend(sc.finish())
+    final_w = np.concatenate([t.weights for t in tiles], axis=0)
+    scores = np.concatenate(
+        [t.result.scores[: t.n_valid] for t in tiles], axis=0
+    )
+    return CleanResult(
+        final_weights=final_w,
+        scores=scores,
+        loops=max(t.result.loops for t in tiles),
+        converged=all(t.result.converged for t in tiles),
+    )

@@ -85,3 +85,119 @@ def test_sharded_matches_single_device():
         ))
     np.testing.assert_array_equal(single == 0, sharded == 0)
     np.testing.assert_allclose(single, sharded, rtol=1e-12)
+
+
+# --- batched / sharded / streaming library paths ---------------------------
+
+def _mk(seed, **kw):
+    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+    params = dict(nsub=8, nchan=16, nbin=32)
+    params.update(kw)
+    ar, _ = make_synthetic_archive(seed=seed, **params)
+    return ar
+
+
+def _roll_cfg(**kw):
+    from iterative_cleaner_tpu.config import CleanConfig
+
+    return CleanConfig(rotation="roll", fft_mode="dft", dtype="float64", **kw)
+
+
+def test_batched_matches_individual():
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.parallel import clean_archives_batched
+
+    cfg = _roll_cfg()
+    archives = [_mk(s) for s in range(4)]
+    batched = clean_archives_batched(archives, cfg)
+    for ar, b in zip(archives, batched):
+        single = clean_archive(ar.clone(), cfg)
+        np.testing.assert_array_equal(single.final_weights, b.final_weights)
+        assert single.loops == b.loops
+        assert single.converged == b.converged
+        np.testing.assert_array_equal(single.loop_diffs, b.loop_diffs)
+
+
+def test_batched_sharded_with_padding():
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.parallel import batch_mesh, clean_archives_batched
+
+    cfg = _roll_cfg()
+    archives = [_mk(10 + s) for s in range(5)]  # 5 archives on 8 devices
+    mesh = batch_mesh(8)
+    batched = clean_archives_batched(archives, cfg, mesh=mesh)
+    assert len(batched) == 5
+    for ar, b in zip(archives, batched):
+        single = clean_archive(ar.clone(), cfg)
+        np.testing.assert_array_equal(single.final_weights, b.final_weights)
+
+
+def test_batched_rejects_ragged_shapes():
+    from iterative_cleaner_tpu.parallel import clean_archives_batched
+
+    with pytest.raises(ValueError, match="equal-shaped"):
+        clean_archives_batched([_mk(0), _mk(1, nbin=64)], _roll_cfg())
+
+
+def test_sharded_library_path_matches_single():
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.parallel import cell_mesh, clean_archive_sharded
+
+    cfg = _roll_cfg()
+    ar = _mk(20)
+    single = clean_archive(ar.clone(), cfg)
+    sharded = clean_archive_sharded(ar.clone(), cfg, cell_mesh(8))
+    np.testing.assert_array_equal(single.final_weights, sharded.final_weights)
+    assert single.loops == sharded.loops
+
+
+def test_streaming_single_tile_matches_direct():
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.parallel import clean_streaming
+
+    cfg = _roll_cfg()
+    ar = _mk(30)
+    direct = clean_archive(ar.clone(), cfg)
+    streamed = clean_streaming(ar.clone(), chunk_nsub=ar.nsub, config=cfg)
+    np.testing.assert_array_equal(direct.final_weights, streamed.final_weights)
+
+
+def test_streaming_tiles_and_partial_padding():
+    from iterative_cleaner_tpu.parallel import StreamingCleaner
+
+    cfg = _roll_cfg()
+    ar = _mk(31)  # nsub=8
+    sc = StreamingCleaner(6, cfg, ar.freqs_mhz, ar.dm, ar.centre_freq_mhz,
+                          ar.period_s)
+    cube = ar.total_intensity()
+    tiles = list(sc.push(cube[:5], ar.weights[:5]))   # below one tile
+    assert tiles == []
+    tiles += list(sc.push(cube[5:], ar.weights[5:]))  # fills tile 1
+    assert len(tiles) == 1 and tiles[0].n_valid == 6
+    tiles += list(sc.finish())                        # padded final tile
+    assert len(tiles) == 2
+    assert tiles[1].n_valid == 2
+    assert tiles[1].weights.shape == (2, ar.nchan)
+    assert tiles[0].start_subint == 0 and tiles[1].start_subint == 6
+
+
+def test_streaming_incremental_equals_bulk():
+    from iterative_cleaner_tpu.parallel import StreamingCleaner
+
+    cfg = _roll_cfg()
+    ar = _mk(32)
+    cube = ar.total_intensity()
+
+    def run(pushes):
+        sc = StreamingCleaner(4, cfg, ar.freqs_mhz, ar.dm,
+                              ar.centre_freq_mhz, ar.period_s)
+        tiles = []
+        for lo, hi in pushes:
+            tiles += list(sc.push(cube[lo:hi], ar.weights[lo:hi]))
+        tiles += list(sc.finish())
+        return np.concatenate([t.weights for t in tiles])
+
+    one_shot = run([(0, 8)])
+    dribbled = run([(0, 1), (1, 3), (3, 8)])
+    np.testing.assert_array_equal(one_shot, dribbled)
