@@ -33,16 +33,61 @@ _HEADER = struct.Struct("<8s4I6d2I64s")
 assert _HEADER.size == 144
 
 
-def _lib_path() -> str:
+def _native_dir() -> str:
     return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), "native", "libicar.so")
+        os.path.abspath(__file__)))), "native")
+
+
+def _lib_path() -> str:
+    return os.path.join(_native_dir(), "libicar.so")
 
 
 _lib = None
+_build_attempted = False
+
+
+def build_native(timeout: float = 120.0) -> bool:
+    """Run ``make -C native libicar.so``; True iff the library loads after."""
+    import subprocess
+
+    try:
+        subprocess.run(
+            ["make", "-C", _native_dir(), "libicar.so"],
+            check=True, capture_output=True, timeout=timeout,
+        )
+    except Exception:
+        return False
+    return _load_lib_or_none() is not None
 
 
 def native_available() -> bool:
-    return os.path.exists(_lib_path())
+    """True when a loadable libicar.so is present; best-effort builds it once
+    per process unless ICAR_NO_NATIVE_BUILD=1 (checked per call)."""
+    global _build_attempted
+    if (not os.path.exists(_lib_path()) and not _build_attempted
+            and os.environ.get("ICAR_NO_NATIVE_BUILD") != "1"):
+        _build_attempted = True
+        build_native()
+    return _load_lib_or_none() is not None
+
+
+def _load_lib_or_none():
+    """Load-and-cache the library, validating it actually links; a corrupt
+    artifact (e.g. an interrupted build) is deleted so a later build can
+    retry, and callers fall back to the pure-Python path meanwhile."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_lib_path()):
+        return None
+    try:
+        return _load_lib()
+    except OSError:
+        try:
+            os.remove(_lib_path())
+        except OSError:
+            pass
+        return None
 
 
 def _load_lib():

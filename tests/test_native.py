@@ -1,0 +1,92 @@
+"""Native C++ ICAR loader (native/archive_io.cpp) vs the pure-Python path.
+
+Builds libicar.so on demand (skipped when no C++ toolchain is available) and
+checks byte-level roundtrip equality between the two implementations, plus
+rejection of corrupt files.
+"""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.io import native as native_mod
+from iterative_cleaner_tpu.io.native import load_icar, save_icar
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+@pytest.fixture(scope="module")
+def native_lib():
+    if not native_mod.build_native():
+        pytest.skip("C++ toolchain unavailable; native path untested")
+    assert native_mod.native_available()
+    return native_mod._load_lib()
+
+
+def _roundtrip(ar, path, use_native):
+    """save+load with the native path forced on or off."""
+    orig = native_mod.native_available
+    native_mod.native_available = lambda: use_native
+    try:
+        save_icar(ar, path)
+        return load_icar(path)
+    finally:
+        native_mod.native_available = orig
+
+
+def test_native_roundtrip_matches_python(native_lib, tmp_path):
+    ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, npol=2, seed=3)
+    p_native = str(tmp_path / "n.icar")
+    p_python = str(tmp_path / "p.icar")
+
+    back_n = _roundtrip(ar, p_native, use_native=True)
+    back_p = _roundtrip(ar, p_python, use_native=False)
+
+    # identical bytes on disk from both writers
+    with open(p_native, "rb") as f1, open(p_python, "rb") as f2:
+        assert f1.read() == f2.read()
+
+    for a, b in ((back_n, back_p), (back_n, ar)):
+        np.testing.assert_array_equal(a.data, np.asarray(b.data, np.float32))
+        np.testing.assert_array_equal(a.weights,
+                                      np.asarray(b.weights, np.float32))
+        np.testing.assert_array_equal(a.freqs_mhz, b.freqs_mhz)
+        assert a.source == b.source
+        assert a.period_s == b.period_s
+        assert a.dm == b.dm
+        assert a.pol_state == b.pol_state
+
+
+def test_native_cross_reader(native_lib, tmp_path):
+    """Python-written file read by the native loader and vice versa."""
+    ar, _ = make_synthetic_archive(nsub=4, nchan=8, nbin=16, seed=1)
+    path = str(tmp_path / "x.icar")
+    _roundtrip(ar, path, use_native=False)  # python writer
+    # read the python-written file through the native loader directly
+    back = native_mod._load_icar_native(path)
+    np.testing.assert_array_equal(back.data, np.asarray(ar.data, np.float32))
+    np.testing.assert_array_equal(back.weights,
+                                  np.asarray(ar.weights, np.float32))
+
+
+def test_native_rejects_corrupt(native_lib, tmp_path):
+    bad = tmp_path / "bad.icar"
+    bad.write_bytes(b"NOTICAR!" + b"\x00" * 200)
+    with pytest.raises(OSError):
+        native_mod._load_icar_native(str(bad))
+
+    trunc = tmp_path / "trunc.icar"
+    ar, _ = make_synthetic_archive(nsub=4, nchan=8, nbin=16, seed=1)
+    full = tmp_path / "full.icar"
+    save_icar(ar, str(full))
+    trunc.write_bytes(full.read_bytes()[:200])  # header ok, arrays missing
+    with pytest.raises(OSError):
+        native_mod._load_icar_native(str(trunc))
+
+
+def test_native_write_reports_errors(native_lib):
+    ar, _ = make_synthetic_archive(nsub=2, nchan=4, nbin=8, seed=0)
+    orig = native_mod.native_available
+    native_mod.native_available = lambda: True
+    try:
+        with pytest.raises(OSError):
+            save_icar(ar, "/nonexistent-dir/x.icar")
+    finally:
+        native_mod.native_available = orig
