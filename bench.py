@@ -33,7 +33,10 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=3):
     import jax
     import jax.numpy as jnp
 
-    from iterative_cleaner_tpu.backends.jax_backend import build_clean_fn
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        build_clean_fn,
+        resolve_median_impl,
+    )
     from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
 
     ar, _ = make_synthetic_archive(
@@ -43,8 +46,10 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=3):
         n_rfi_subints=max(1, nsub // 512),
         seed=0, dtype=np.float32,
     )
+    median_impl = resolve_median_impl("auto", jnp.float32)
+    _log(f"median impl: {median_impl}")
     fn = build_clean_fn(max_iter, 5.0, 5.0, (0, 0), 1.0, False, "fourier",
-                        0.15, False, "fft")
+                        0.15, False, "fft", median_impl)
     dev = jax.devices()[0]
     _log(f"jax device: {dev.platform} {getattr(dev, 'device_kind', '?')}")
 

@@ -30,10 +30,20 @@ from iterative_cleaner_tpu.ops.dsp import (
 )
 
 
+def resolve_median_impl(median_impl: str, dtype) -> str:
+    """'auto' picks the Pallas kernel on single-device TPU float32 runs and
+    the sort path everywhere else (CPU, float64 oracle comparisons, sharded
+    GSPMD programs where a pallas_call would force a gather)."""
+    if median_impl != "auto":
+        return median_impl
+    on_tpu = jax.devices()[0].platform == "tpu"
+    return "pallas" if on_tpu and jnp.dtype(dtype) == jnp.float32 else "sort"
+
+
 @functools.lru_cache(maxsize=None)
 def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, baseline_duty,
-                   unload_res, fft_mode="fft"):
+                   unload_res, fft_mode="fft", median_impl="sort"):
     """Build (and cache) the jitted whole-archive cleaning program for one
     static configuration."""
 
@@ -47,7 +57,7 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             max_iter=max_iter, chanthresh=chanthresh,
             subintthresh=subintthresh, pulse_slice=pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
-            rotation=rotation, fft_mode=fft_mode,
+            rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
         )
         if not unload_res:
             return outs, None
@@ -72,7 +82,7 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
         config.rotation, config.baseline_duty, config.unload_res,
-        config.fft_mode,
+        config.fft_mode, resolve_median_impl(config.median_impl, dtype),
     )
     outs, resid = fn(
         jnp.asarray(cube, dtype=dtype),

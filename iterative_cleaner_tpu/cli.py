@@ -75,6 +75,12 @@ def build_parser() -> argparse.ArgumentParser:
                         default="fourier",
                         help="Dedispersion rotation: exact fractional-bin "
                              "Fourier phase ramp, or nearest-bin roll.")
+    parser.add_argument("--median_impl", choices=("auto", "sort", "pallas"),
+                        default="auto",
+                        help="Masked-median implementation on the jax path: "
+                             "jnp.sort based, the Pallas TPU radix-bisection "
+                             "kernel, or auto (pallas on TPU float32). Both "
+                             "produce bit-identical masks.")
     return parser
 
 
@@ -92,6 +98,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         bad_subint=args.bad_subint,
         backend=args.backend,
         rotation=args.rotation,
+        median_impl=args.median_impl,
         unload_res=args.unload_res,
     )
 

@@ -30,6 +30,11 @@ class CleanConfig:
     backend: str = "jax"         # {"numpy", "jax"}
     rotation: str = "fourier"    # {"fourier", "roll"} dedispersion rotation
     fft_mode: str = "fft"        # {"fft", "dft"} rFFT diagnostic backend (jax path)
+    # masked-median implementation on the jax path: "sort" (jnp.sort based),
+    # "pallas" (radix-bisection TPU kernel, stats/pallas_kernels.py), or
+    # "auto" (pallas on single-device TPU float32, sort otherwise).  The two
+    # implementations agree bit-for-bit.
+    median_impl: str = "auto"
     baseline_duty: float = 0.15  # off-pulse window fraction for baseline find
     dtype: str = "float32"       # compute dtype on the jax path
     unload_res: bool = False     # -u: also produce the pulse-free residual
@@ -58,5 +63,11 @@ class CleanConfig:
             raise ValueError(f"unknown rotation method {self.rotation!r}")
         if self.fft_mode not in ("fft", "dft"):
             raise ValueError(f"unknown fft mode {self.fft_mode!r}")
+        if self.median_impl not in ("auto", "sort", "pallas"):
+            raise ValueError(f"unknown median impl {self.median_impl!r}")
+        if self.median_impl == "pallas" and self.dtype != "float32":
+            raise ValueError(
+                "median_impl='pallas' requires dtype='float32' (the kernel's "
+                "order-preserving key mapping is 32-bit)")
         if self.max_iter < 1:
             raise ValueError("max_iter must be >= 1")

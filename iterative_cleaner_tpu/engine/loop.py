@@ -63,7 +63,8 @@ class _Carry(NamedTuple):
 
 def iteration_step(ded_cube, weights, orig_weights, cell_mask, back_shifts, *,
                    chanthresh, subintthresh, pulse_slice, pulse_scale,
-                   pulse_active, rotation, fft_mode="fft"):
+                   pulse_active, rotation, fft_mode="fft",
+                   median_impl="sort"):
     """One cleaning iteration: template -> fit -> residual stats -> new weights.
 
     ``weights`` are the previous iteration's (template) weights;
@@ -79,7 +80,8 @@ def iteration_step(ded_cube, weights, orig_weights, cell_mask, back_shifts, *,
     resid = rotate_bins(resid, back_shifts, jnp, method=rotation)
     weighted = resid * orig_weights[:, :, None]  # apply_weights, ref :291-297
     scores = surgical_scores_jax(weighted, cell_mask, chanthresh,
-                                 subintthresh, fft_mode=fft_mode)
+                                 subintthresh, fft_mode=fft_mode,
+                                 median_impl=median_impl)
     new_weights = jnp.where(scores >= 1.0, 0.0, orig_weights)  # ref :300-305
     return new_weights, scores
 
@@ -87,7 +89,8 @@ def iteration_step(ded_cube, weights, orig_weights, cell_mask, back_shifts, *,
 def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
                           max_iter, chanthresh, subintthresh,
                           pulse_slice, pulse_scale, pulse_active,
-                          rotation, fft_mode="fft") -> CleanOutputs:
+                          rotation, fft_mode="fft",
+                          median_impl="sort") -> CleanOutputs:
     """Run the full iteration loop on an already-prepared cube.
 
     ``ded_cube``: baseline-removed, dedispersed (nsub, nchan, nbin) cube.
@@ -123,6 +126,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
             chanthresh=chanthresh, subintthresh=subintthresh,
             pulse_slice=pulse_slice, pulse_scale=pulse_scale,
             pulse_active=pulse_active, rotation=rotation, fft_mode=fft_mode,
+            median_impl=median_impl,
         )
         seen = jnp.arange(max_iter + 1) < c.count
         matches = jnp.all(c.history == new_w[None], axis=(1, 2)) & seen

@@ -82,7 +82,9 @@ def _load_lib_or_none():
         return None
     try:
         return _load_lib()
-    except OSError:
+    except (OSError, AttributeError):
+        # OSError: truncated/non-ELF artifact; AttributeError: a library that
+        # loads but lacks our symbols (stale or foreign ABI).
         try:
             os.remove(_lib_path())
         except OSError:

@@ -23,7 +23,7 @@ from iterative_cleaner_tpu.config import CleanConfig
 @functools.lru_cache(maxsize=None)
 def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                            pulse_scale, pulse_active, rotation, baseline_duty,
-                           fft_mode):
+                           fft_mode, median_impl="sort"):
     """Jitted batched cleaner: every per-archive input gains a leading batch
     axis; scalars (dm, period, ref freq) are per-archive vectors."""
     import jax
@@ -42,7 +42,7 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             ded, weights, shifts, max_iter=max_iter, chanthresh=chanthresh,
             subintthresh=subintthresh, pulse_slice=pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
-            rotation=rotation, fft_mode=fft_mode,
+            rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
         )
 
     return jax.jit(jax.vmap(one))
@@ -94,10 +94,13 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
     refs = stack(lambda a: a.centre_freq_mhz, pad_like=np.float64(1.0))
     periods = stack(lambda a: a.period_s, pad_like=np.float64(1.0))
 
+    # 'auto' stays on the sort path here: vmap batches a pallas_call by
+    # serialising over a grid axis, which forfeits the kernel's advantage.
+    median_impl = "sort" if config.median_impl == "auto" else config.median_impl
     fn = build_batched_clean_fn(
         config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
-        config.rotation, config.baseline_duty, config.fft_mode,
+        config.rotation, config.baseline_duty, config.fft_mode, median_impl,
     )
     args = (cubes, weights, freqs, dms, refs, periods)
     if mesh is not None:

@@ -24,7 +24,7 @@ from iterative_cleaner_tpu.config import CleanConfig
 @functools.lru_cache(maxsize=None)
 def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
                            pulse_slice, pulse_scale, pulse_active, rotation,
-                           baseline_duty, fft_mode):
+                           baseline_duty, fft_mode, median_impl="sort"):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -47,7 +47,7 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
             ded, weights, shifts, max_iter=max_iter, chanthresh=chanthresh,
             subintthresh=subintthresh, pulse_slice=pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
-            rotation=rotation, fft_mode=fft_mode,
+            rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
         )
 
     fn = jax.jit(
@@ -69,10 +69,13 @@ def clean_archive_sharded(archive: Archive, config: CleanConfig,
     import jax.numpy as jnp
 
     dtype = jnp.dtype(config.dtype)
+    # 'auto' stays on the sort path here: a pallas_call inside a GSPMD
+    # program forces the diagnostics to gather onto one device.
+    median_impl = "sort" if config.median_impl == "auto" else config.median_impl
     fn, cube_sh, w_sh, rep = build_sharded_clean_fn(
         mesh, config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
-        config.rotation, config.baseline_duty, config.fft_mode,
+        config.rotation, config.baseline_duty, config.fft_mode, median_impl,
     )
     with mesh:
         outs = fn(

@@ -36,14 +36,24 @@ import jax.numpy as jnp
 MA_FILL = 1e20
 
 
-def masked_median(values, mask, axis):
+def masked_median(values, mask, axis, impl="sort"):
     """``np.ma.median`` semantics: median over unmasked entries along axis.
 
     Even counts average the two middle order statistics.  Lines with no valid
     entries return 0.0 — callers must handle them via the count (np.ma would
     return ``masked``; the 0.0 placeholder is never observable because those
     lines are fully masked downstream).  Keeps the reduced axis (keepdims).
+
+    impl="pallas" routes to the radix-bisection TPU kernel
+    (:mod:`iterative_cleaner_tpu.stats.pallas_kernels`), which agrees with
+    the sort path bit-for-bit.
     """
+    if impl == "pallas":
+        from iterative_cleaner_tpu.stats.pallas_kernels import (
+            masked_median_pallas,
+        )
+
+        return masked_median_pallas(values, mask, axis)
     sentinel = jnp.asarray(jnp.inf, dtype=values.dtype)
     ordered = jnp.sort(jnp.where(mask, sentinel, values), axis=axis)
     n = jnp.sum(~mask, axis=axis, keepdims=True)
@@ -54,7 +64,7 @@ def masked_median(values, mask, axis):
     return jnp.where(n == 0, jnp.zeros_like(med), med)
 
 
-def scale_lines_masked(diag, mask, axis, thresh):
+def scale_lines_masked(diag, mask, axis, thresh, median_impl="sort"):
     """Masked-path line normalisation, post |.|/threshold.
 
     Returns the raw data that survives the mask-dropping ``np.max`` stacking:
@@ -62,9 +72,9 @@ def scale_lines_masked(diag, mask, axis, thresh):
     carrying their (undivided) pass-through data per rules 1-3.
     """
     n = jnp.sum(~mask, axis=axis, keepdims=True)
-    med = masked_median(diag, mask, axis)
+    med = masked_median(diag, mask, axis, impl=median_impl)
     centred = jnp.where(mask, diag, diag - med)
-    mad = masked_median(jnp.abs(centred), mask, axis)
+    mad = masked_median(jnp.abs(centred), mask, axis, impl=median_impl)
     line_dead = (mad == 0) | (n == 0)
     safe_mad = jnp.where(line_dead, jnp.ones_like(mad), mad)
     dead = mask | line_dead
@@ -110,7 +120,7 @@ def rfft_magnitudes(x, mode="fft"):
 
 
 def surgical_scores_jax(resid_weighted, cell_mask, chanthresh, subintthresh,
-                        fft_mode="fft"):
+                        fft_mode="fft", median_impl="sort"):
     """Zap scores for every (subint, channel) cell; score >= 1 means zap.
 
     Mirrors reference :202-226 under the explicit-mask rules above.  Since
@@ -131,8 +141,8 @@ def surgical_scores_jax(resid_weighted, cell_mask, chanthresh, subintthresh,
 
     per_diag = []
     for diag in (d_std, d_mean, d_ptp):
-        chan_side = scale_lines_masked(diag, m, 0, chanthresh)
-        subint_side = scale_lines_masked(diag, m, 1, subintthresh)
+        chan_side = scale_lines_masked(diag, m, 0, chanthresh, median_impl)
+        subint_side = scale_lines_masked(diag, m, 1, subintthresh, median_impl)
         per_diag.append(jnp.maximum(chan_side, subint_side))
     per_diag.append(
         jnp.maximum(scale_lines_plain(d_fft, 0, chanthresh),

@@ -1,0 +1,130 @@
+"""Pallas TPU kernel for the masked median — the hot reduction of the
+surgical-scrub scalers (reference ``/root/reference/iterative_cleaner.py:234-240,
+249-255``; SURVEY.md section 7 layer 4).
+
+Instead of sorting each line (XLA sort is O(n log^2 n) with poor lane
+utilisation on TPU), the kernel finds the two middle order statistics
+exactly by *radix bisection*: float32 values are mapped to an
+order-preserving int32 key, and 32 fixed count-passes binary-search the key
+domain for the k-th smallest element.  Every pass is a dense VPU
+compare-and-sum over the whole tile, so the kernel is pure vector work with
+no data-dependent shapes.
+
+Exactness: the bisection recovers the exact bit patterns of the two middle
+order statistics, and the final ``0.5 * (lo + hi)`` is the same float op the
+sort-based path performs — the two implementations agree bit-for-bit
+(locked in by tests/test_pallas_stats.py), so final-mask parity between
+``median_impl='sort'`` and ``'pallas'`` is exact.
+
+Semantics match :func:`iterative_cleaner_tpu.stats.masked_jax.masked_median`
+(``np.ma.median``): median over unmasked entries, even counts average the
+two middle values, fully-masked lines yield 0.0.  Only float32 is
+supported (the key mapping is 32-bit); callers fall back to the sort path
+for other dtypes.  Degenerate caveat shared with the sort path: a *valid*
+NaN payload of exactly 0x7fffffff collides with the mask sentinel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_INT32_MIN = np.int32(-2147483648)
+_INT32_MAX = np.int32(2147483647)
+
+# Lane tile over the line axis; the reduction axis stays whole in VMEM.
+_TILE_LINES = 128
+
+
+def _ordered_key(x):
+    """Map float32 bits to int32 keys whose signed order matches float order
+    (NaNs sort above +inf, mirroring XLA's total-order sort)."""
+    b = jax.lax.bitcast_convert_type(x, jnp.int32)
+    return b ^ ((b >> 31) & np.int32(0x7FFFFFFF))
+
+
+def _key_to_float(o):
+    # The transform is an involution.
+    b = o ^ ((o >> 31) & np.int32(0x7FFFFFFF))
+    return jax.lax.bitcast_convert_type(b, jnp.float32)
+
+
+def _select_kth(keys, k):
+    """Exact k-th (0-indexed) smallest int32 key per lane.
+
+    keys: (n, t) int32; k: (t,) int32 in [0, n).  32 bisection steps, each a
+    count of keys <= mid down the sublane axis.
+    """
+
+    def body(_, state):
+        lo, hi = state
+        # overflow-free signed midpoint, floor-rounded
+        mid = (lo >> 1) + (hi >> 1) + (lo & hi & 1)
+        cnt = jnp.sum((keys <= mid[None, :]).astype(jnp.int32), axis=0,
+                      dtype=jnp.int32)
+        go_low = cnt >= k + 1
+        return jnp.where(go_low, lo, mid + 1), jnp.where(go_low, mid, hi)
+
+    lo = jnp.full_like(k, _INT32_MIN)
+    hi = jnp.full_like(k, _INT32_MAX)
+    lo, _ = jax.lax.fori_loop(0, 32, body, (lo, hi))
+    return lo
+
+
+def _median_kernel(v_ref, m_ref, out_ref):
+    mask = m_ref[:]
+    keys = jnp.where(mask, _INT32_MAX, _ordered_key(v_ref[:]))
+    n_valid = jnp.sum((~mask).astype(jnp.int32), axis=0, dtype=jnp.int32)
+    k_lo = jnp.maximum(n_valid - 1, 0) // 2
+    k_hi = n_valid // 2
+    f_lo = _key_to_float(_select_kth(keys, k_lo))
+    f_hi = _key_to_float(_select_kth(keys, k_hi))
+    med = np.float32(0.5) * (f_lo + f_hi)
+    out_ref[0, :] = jnp.where(n_valid == 0, np.float32(0.0), med)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _median_axis0(values, mask, interpret):
+    n, m = values.shape
+    pad = (-m) % _TILE_LINES
+    if pad:
+        values = jnp.pad(values, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)), constant_values=True)
+    mp = m + pad
+    grid = mp // _TILE_LINES
+    out = pl.pallas_call(
+        _median_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, mp), jnp.float32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((n, _TILE_LINES), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, _TILE_LINES), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, _TILE_LINES), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(values, mask)
+    return out[:, :m]
+
+
+def masked_median_pallas(values, mask, axis):
+    """Drop-in for :func:`masked_jax.masked_median` (keepdims semantics),
+    float32 only.  axis 0 reduces down subints (channel scaler), axis 1 down
+    channels (subint scaler; handled by transposing the tile)."""
+    if values.dtype != jnp.float32:
+        raise TypeError("masked_median_pallas requires float32, got %s"
+                        % values.dtype)
+    interpret = jax.devices()[0].platform != "tpu"
+    if axis == 0:
+        return _median_axis0(values, mask, interpret)
+    if axis == 1:
+        out = _median_axis0(values.T, mask.T, interpret)
+        return out.T
+    raise ValueError("axis must be 0 or 1 for 2-D diagnostics")

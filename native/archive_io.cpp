@@ -57,9 +57,15 @@ bool parse_dims(const unsigned char* hdr, Dims* out) {
   std::memcpy(&out->nbin, hdr + 20, 4);
   if (out->nsub == 0 || out->npol == 0 || out->nchan == 0 || out->nbin == 0)
     return false;
-  // Reject dimension combinations that overflow size_t arithmetic.
-  const uint64_t cells = uint64_t(out->nsub) * out->npol * out->nchan;
-  if (cells > (uint64_t(1) << 48) || uint64_t(out->nbin) > (uint64_t(1) << 32))
+  // Reject dimension combinations whose byte counts overflow 64-bit
+  // arithmetic (a crafted header could otherwise wrap file_bytes() past the
+  // size validation and send readers beyond the mapping).
+  uint64_t cells = 0, elems = 0, bytes = 0;
+  if (__builtin_mul_overflow(uint64_t(out->nsub) * out->npol,
+                             uint64_t(out->nchan), &cells) ||
+      __builtin_mul_overflow(cells, uint64_t(out->nbin), &elems) ||
+      __builtin_mul_overflow(elems, uint64_t(4), &bytes) ||
+      bytes > (uint64_t(1) << 46))  // 64 TiB cap, far beyond any archive
     return false;
   return true;
 }
