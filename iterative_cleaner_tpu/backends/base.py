@@ -22,6 +22,10 @@ class CleanResult:
     # per-loop operator telemetry (reference :129-134): entries [0:loops]
     loop_diffs: Optional[np.ndarray] = None      # cells changed vs previous loop
     loop_rfi_frac: Optional[np.ndarray] = None   # zero-weight fraction
+    # (loops+1, nsub, nchan) per-iteration weight matrices (seed + each loop),
+    # populated when config.record_history — feeds checkpoint/resume and
+    # regression diffing (utils/checkpoint.py); no reference counterpart.
+    weight_history: Optional[np.ndarray] = None
 
     @property
     def rfi_fraction(self) -> float:

@@ -93,6 +93,9 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         jnp.asarray(period_s, dtype=dtype),
     )
     loops = int(outs.loops)
+    history = None
+    if config.record_history:
+        history = np.asarray(outs.history)[: int(outs.history_count)]
     return CleanResult(
         final_weights=np.asarray(outs.final_weights),
         scores=np.asarray(outs.scores),
@@ -101,4 +104,5 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         residual=None if resid is None else np.asarray(resid),
         loop_diffs=np.asarray(outs.loop_diffs)[:loops],
         loop_rfi_frac=np.asarray(outs.loop_rfi_frac)[:loops],
+        weight_history=history,
     )

@@ -91,4 +91,5 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         residual=residual,
         loop_diffs=np.asarray(loop_diffs),
         loop_rfi_frac=np.asarray(loop_rfi_frac),
+        weight_history=np.stack(history) if config.record_history else None,
     )

@@ -81,6 +81,25 @@ def build_parser() -> argparse.ArgumentParser:
                              "jnp.sort based, the Pallas TPU radix-bisection "
                              "kernel, or auto (pallas on TPU float32). Both "
                              "produce bit-identical masks.")
+    parser.add_argument("--checkpoint", type=str, default="",
+                        metavar="DIR",
+                        help="Checkpoint directory: each archive's cleaning "
+                             "state is saved there, and re-runs reuse "
+                             "checkpoints whose input content and config "
+                             "still match (batch resume).")
+    parser.add_argument("--record_history", action="store_true",
+                        help="Keep every iteration's weight matrix in the "
+                             "result/checkpoint (regression diffing).")
+    parser.add_argument("--trace", type=str, default="", metavar="DIR",
+                        help="Capture a jax.profiler device trace of the "
+                             "whole run into DIR (TensorBoard/Perfetto).")
+    parser.add_argument("--timing", action="store_true",
+                        help="Print per-archive load/clean/write wall-clock.")
+    parser.add_argument("--keep_going", action="store_true",
+                        help="Per-archive error isolation: report a failed "
+                             "archive and continue with the rest instead of "
+                             "aborting the batch (exit code 1 if any "
+                             "failed).")
     return parser
 
 
@@ -100,6 +119,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         rotation=args.rotation,
         median_impl=args.median_impl,
         unload_res=args.unload_res,
+        record_history=args.record_history,
     )
 
 
@@ -115,16 +135,40 @@ def output_name(ar, args: argparse.Namespace, in_path: str) -> str:
     return args.output
 
 
-def clean_one(in_path: str, args: argparse.Namespace) -> str:
+def clean_one(in_path: str, args: argparse.Namespace,
+              timer=None) -> str:
     """Load, clean, and write one archive; returns the output path."""
-    ar = ar_io.load_archive(in_path)
+    from iterative_cleaner_tpu.utils.tracing import PhaseTimer
+
+    timer = timer if timer is not None else PhaseTimer()
+    with timer.phase("load"):
+        ar = ar_io.load_archive(in_path)
     cfg = config_from_args(args)
     ar_name = ar.display_name() or os.path.basename(in_path)
 
     if not args.quiet:
         print("Total number of profiles: %s" % ar.weights.size)
 
-    result = clean_archive(ar, cfg)
+    result = None
+    resumed = False
+    if args.checkpoint:
+        from iterative_cleaner_tpu.utils import checkpoint as ckpt
+
+        result = ckpt.load_matching_checkpoint(args.checkpoint, in_path, ar,
+                                               cfg)
+        resumed = result is not None
+        if resumed and not args.quiet:
+            print("Resumed from checkpoint: %s"
+                  % ckpt.checkpoint_path(args.checkpoint, in_path))
+    if result is None:
+        with timer.phase("clean"):
+            result = clean_archive(ar, cfg)
+    if args.checkpoint and not resumed:
+        os.makedirs(args.checkpoint, exist_ok=True)
+        ckpt.save_clean_checkpoint(
+            ckpt.checkpoint_path(args.checkpoint, in_path), result, cfg,
+            ckpt.fingerprint_archive(ar),
+        )
 
     if not args.quiet:
         diffs = result.loop_diffs if result.loop_diffs is not None else []
@@ -151,7 +195,8 @@ def clean_one(in_path: str, args: argparse.Namespace) -> str:
         out.data = ar.data.copy()  # pscrunch mutates
         out.pscrunch()
     o_name = output_name(ar, args, in_path)
-    ar_io.save_archive(out, o_name)
+    with timer.phase("write"):
+        ar_io.save_archive(out, o_name)
 
     if args.unload_res and result.residual is not None:
         res_ar = dataclasses.replace(
@@ -176,13 +221,31 @@ def clean_one(in_path: str, args: argparse.Namespace) -> str:
 
     if not args.quiet:
         print("Cleaned archive: %s" % o_name)
+    if args.timing:
+        print(timer.report())
     return o_name
 
 
 def main(argv=None) -> int:
     args = parse_arguments(argv)
-    for in_path in args.archive:
-        clean_one(in_path, args)
+    from iterative_cleaner_tpu.utils.tracing import device_trace
+
+    failed = []
+    with device_trace(args.trace):
+        for in_path in args.archive:
+            try:
+                clean_one(in_path, args)
+            except Exception as exc:  # per-archive isolation (--keep_going)
+                if not args.keep_going:
+                    raise
+                failed.append(in_path)
+                print("ERROR cleaning %s: %s: %s"
+                      % (in_path, type(exc).__name__, exc), file=sys.stderr)
+    if failed:
+        print("Failed %d/%d archives: %s"
+              % (len(failed), len(args.archive), ", ".join(failed)),
+              file=sys.stderr)
+        return 1
     return 0
 
 

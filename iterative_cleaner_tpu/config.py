@@ -38,6 +38,10 @@ class CleanConfig:
     baseline_duty: float = 0.15  # off-pulse window fraction for baseline find
     dtype: str = "float32"       # compute dtype on the jax path
     unload_res: bool = False     # -u: also produce the pulse-free residual
+    # keep the per-iteration weight matrices in the result (checkpoint/
+    # regression-diff support, utils/checkpoint.py); costs one extra D2H of
+    # (loops+1, nsub, nchan) floats on the jax path
+    record_history: bool = False
 
     @property
     def pulse_region_active(self) -> bool:

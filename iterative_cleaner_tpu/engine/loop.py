@@ -46,6 +46,8 @@ class CleanOutputs(NamedTuple):
     template_weights: jax.Array  # weights the last template was built from
     loop_diffs: jax.Array      # (max_iter,) cells changed vs previous weights
     loop_rfi_frac: jax.Array   # (max_iter,) zero-weight fraction per loop
+    history: jax.Array         # (max_iter+1, nsub, nchan) weight matrices;
+    history_count: jax.Array   # entries [0:history_count] are populated
 
 
 class _Carry(NamedTuple):
@@ -163,6 +165,8 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
         template_weights=out.template_weights,
         loop_diffs=out.loop_diffs,
         loop_rfi_frac=out.loop_rfi_frac,
+        history=out.history,
+        history_count=out.count,
     )
 
 

@@ -18,10 +18,12 @@ sort-based path performs — the two implementations agree bit-for-bit
 
 Semantics match :func:`iterative_cleaner_tpu.stats.masked_jax.masked_median`
 (``np.ma.median``): median over unmasked entries, even counts average the
-two middle values, fully-masked lines yield 0.0.  Only float32 is
+two middle values, fully-masked lines yield 0.0.  Masked entries carry the
+key of +inf — the same sentinel the sort path pads with — so both
+implementations share one total order (reals < inf == masked < NaN) and
+agree bit-for-bit on every input, NaNs included.  Only float32 is
 supported (the key mapping is 32-bit); callers fall back to the sort path
-for other dtypes.  Degenerate caveat shared with the sort path: a *valid*
-NaN payload of exactly 0x7fffffff collides with the mask sentinel.
+for other dtypes.
 """
 
 from __future__ import annotations
@@ -36,6 +38,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 _INT32_MIN = np.int32(-2147483648)
 _INT32_MAX = np.int32(2147483647)
+# key of +inf: the masked sentinel, chosen to equal the sort path's +inf
+# padding so both implementations share one total order (reals < inf ==
+# masked < NaN) and stay bit-identical even for NaN-bearing inputs.
+_KEY_MASKED = np.int32(0x7F800000)
 
 # Lane tile over the line axis; the reduction axis stays whole in VMEM.
 _TILE_LINES = 128
@@ -78,7 +84,7 @@ def _select_kth(keys, k):
 
 def _median_kernel(v_ref, m_ref, out_ref):
     mask = m_ref[:]
-    keys = jnp.where(mask, _INT32_MAX, _ordered_key(v_ref[:]))
+    keys = jnp.where(mask, _KEY_MASKED, _ordered_key(v_ref[:]))
     n_valid = jnp.sum((~mask).astype(jnp.int32), axis=0, dtype=jnp.int32)
     k_lo = jnp.maximum(n_valid - 1, 0) // 2
     k_hi = n_valid // 2

@@ -1,0 +1,50 @@
+"""Profiling hooks.
+
+The reference's only observability is console prints and clean.log
+(SURVEY.md section 5 "Tracing / profiling" — absent).  This adds the TPU
+story: ``jax.profiler`` device traces viewable in TensorBoard/Perfetto and
+lightweight wall-clock phase timing, both zero-cost when disabled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler device trace into ``trace_dir`` (CLI --trace).
+    No-op when trace_dir is falsy."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class PhaseTimer:
+    """Accumulates wall-clock per named phase (load / clean / write)."""
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] = (self.seconds.get(name, 0.0)
+                                  + time.perf_counter() - t0)
+
+    def report(self) -> str:
+        total = sum(self.seconds.values())
+        parts = ["%s %.3fs" % (k, v) for k, v in self.seconds.items()]
+        return "Timing: %s (total %.3fs)" % (", ".join(parts), total)

@@ -1,0 +1,135 @@
+"""Checkpoint/resume + regression diffing (utils/checkpoint.py) and the
+per-iteration weight-history plumbing behind it."""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.backends import clean_archive
+from iterative_cleaner_tpu.cli import main as cli_main
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io import save_archive
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+from iterative_cleaner_tpu.utils import checkpoint as ckpt
+
+
+@pytest.fixture()
+def archive():
+    ar, _ = make_synthetic_archive(nsub=10, nchan=16, nbin=64, seed=7)
+    return ar
+
+
+def test_history_recorded_both_backends(archive):
+    for backend in ("numpy", "jax"):
+        cfg = CleanConfig(backend=backend, max_iter=3, record_history=True)
+        res = clean_archive(archive, cfg)
+        h = res.weight_history
+        assert h is not None
+        # seed + one entry per executed loop
+        assert h.shape[0] == res.loops + 1
+        np.testing.assert_array_equal(h[0], archive.weights)
+        np.testing.assert_array_equal(h[-1], res.final_weights)
+
+
+def test_history_off_by_default(archive):
+    res = clean_archive(archive, CleanConfig(backend="numpy", max_iter=2))
+    assert res.weight_history is None
+
+
+def test_roundtrip_and_staleness(archive, tmp_path):
+    cfg = CleanConfig(backend="numpy", max_iter=3, record_history=True)
+    res = clean_archive(archive, cfg)
+    fp = ckpt.fingerprint_archive(archive)
+    path = ckpt.checkpoint_path(str(tmp_path), "a")
+    ckpt.save_clean_checkpoint(path, res, cfg, fp)
+
+    back, fp2, cfg_id = ckpt.load_clean_checkpoint(path)
+    assert fp2 == fp and cfg_id == ckpt.config_identity(cfg)
+    np.testing.assert_array_equal(back.final_weights, res.final_weights)
+    np.testing.assert_array_equal(back.weight_history, res.weight_history)
+    assert back.loops == res.loops and back.converged == res.converged
+
+    # matching lookup hits (checkpoint_path('a') == a.ckpt.npz)...
+    hit = ckpt.load_matching_checkpoint(str(tmp_path), "a", archive, cfg)
+    assert hit is not None
+
+    # ...and goes stale when the config or the data changes
+    other_cfg = CleanConfig(backend="numpy", max_iter=4, record_history=True)
+    assert ckpt.load_matching_checkpoint(str(tmp_path), "a", archive,
+                                         other_cfg) is None
+    import dataclasses
+    mutated = dataclasses.replace(
+        archive, weights=np.where(archive.weights == 0, 0.0,
+                                  archive.weights * 2))
+    assert ckpt.load_matching_checkpoint(str(tmp_path), "a", mutated,
+                                         cfg) is None
+    # output-only flags are outside the config identity: asking for *less*
+    # than the checkpoint holds still matches (asking for more re-cleans;
+    # see test_resume_recleans_when_outputs_missing)
+    less_cfg = dataclasses.replace(cfg, record_history=False)
+    assert ckpt.load_matching_checkpoint(str(tmp_path), "a", archive,
+                                         less_cfg) is not None
+
+
+def test_checkpoint_path_distinguishes_directories(tmp_path):
+    a = ckpt.checkpoint_path(str(tmp_path), "x/obs.npz")
+    b = ckpt.checkpoint_path(str(tmp_path), "y/obs.npz")
+    assert a != b
+    assert ckpt.checkpoint_path(str(tmp_path), "x/obs.npz") == a
+
+
+def test_resume_recleans_when_outputs_missing(archive, tmp_path):
+    """A checkpoint saved without residual/history must not satisfy a later
+    run that asks for them."""
+    import dataclasses
+
+    cfg = CleanConfig(backend="numpy", max_iter=2)
+    res = clean_archive(archive, cfg)
+    path = ckpt.checkpoint_path(str(tmp_path), "a")
+    ckpt.save_clean_checkpoint(path, res, cfg, ckpt.fingerprint_archive(archive))
+
+    assert ckpt.load_matching_checkpoint(str(tmp_path), "a", archive,
+                                         cfg) is not None
+    want_res = dataclasses.replace(cfg, unload_res=True)
+    assert ckpt.load_matching_checkpoint(str(tmp_path), "a", archive,
+                                         want_res) is None
+    want_hist = dataclasses.replace(cfg, record_history=True)
+    assert ckpt.load_matching_checkpoint(str(tmp_path), "a", archive,
+                                         want_hist) is None
+
+
+def test_diff_masks_and_checkpoints(archive, tmp_path):
+    cfg = CleanConfig(backend="numpy", max_iter=3, record_history=True)
+    res = clean_archive(archive, cfg)
+    fp = ckpt.fingerprint_archive(archive)
+    pa, pb = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+    ckpt.save_clean_checkpoint(pa, res, cfg, fp)
+
+    import dataclasses
+    altered = dataclasses.replace(
+        res, final_weights=np.where(res.final_weights == 0, 1.0,
+                                    res.final_weights))
+    ckpt.save_clean_checkpoint(pb, altered, cfg, fp)
+
+    d = ckpt.diff_checkpoints(pa, pb)
+    n_zap = int((res.final_weights == 0).sum())
+    assert d["changed"] == n_zap and d["unzapped"] == n_zap
+    assert d["newly_zapped"] == 0
+    assert d["same_input"] is True
+    assert "per_iteration_changed" in d
+
+
+def test_cli_checkpoint_resume(archive, tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    save_archive(archive, "obs.npz")
+    args = ["--backend", "numpy", "-l", "--checkpoint", "ckpts", "obs.npz"]
+    cli_main(args)
+    first = capsys.readouterr().out
+    assert "Resumed" not in first
+
+    cli_main(args)
+    second = capsys.readouterr().out
+    assert "Resumed from checkpoint" in second
+
+    import iterative_cleaner_tpu.io as ar_io
+    a = ar_io.load_archive("obs.npz_cleaned.npz")
+    assert (a.weights == 0).any()

@@ -61,6 +61,21 @@ def test_pallas_adversarial_values(axis):
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.parametrize("axis", [0, 1])
+def test_pallas_nan_parity(axis):
+    """Valid NaNs mixed with masked cells: both implementations share the
+    total order reals < inf == masked-sentinel < NaN, so results stay
+    bit-identical (including inf/NaN medians)."""
+    rng = np.random.default_rng(3)
+    v = rng.standard_normal((12, 20)).astype(np.float32)
+    m = rng.random(v.shape) < 0.3
+    v[1, :] = np.nan         # a valid NaN in most lines
+    v[:, 1] = np.nan
+    m[1, ::2] = True         # and NaNs under the mask
+    a, b = _both(v, m, axis)
+    np.testing.assert_array_equal(a, b)
+
+
 @pytest.mark.parametrize("n", [1, 2, 3, 4, 7, 8])
 def test_pallas_matches_numpy_ma(n):
     """Direct np.ma.median check over odd/even valid counts."""

@@ -1,0 +1,59 @@
+"""CLI observability & failure isolation: --timing, --keep_going, --trace."""
+
+import numpy as np
+
+from iterative_cleaner_tpu.cli import main as cli_main
+from iterative_cleaner_tpu.io import save_archive
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+from iterative_cleaner_tpu.utils.tracing import PhaseTimer
+
+
+def _write_obs(path):
+    ar, _ = make_synthetic_archive(nsub=8, nchan=12, nbin=32, seed=3)
+    save_archive(ar, path)
+
+
+def test_phase_timer_accumulates():
+    t = PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    with t.phase("b"):
+        pass
+    assert set(t.seconds) == {"a", "b"}
+    assert "Timing:" in t.report() and "total" in t.report()
+
+
+def test_timing_flag_prints(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    _write_obs("obs.npz")
+    assert cli_main(["--backend", "numpy", "-l", "-q", "--timing",
+                     "obs.npz"]) == 0
+    out = capsys.readouterr().out
+    assert "Timing:" in out and "clean" in out
+
+
+def test_keep_going_isolates_failures(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    _write_obs("good.npz")
+    (tmp_path / "bad.npz").write_bytes(b"not an archive")
+
+    # default: reference-like fail-fast
+    try:
+        cli_main(["--backend", "numpy", "-l", "-q", "bad.npz", "good.npz"])
+        raised = False
+    except Exception:
+        raised = True
+    assert raised
+
+    # --keep_going: bad archive reported, good archive still cleaned
+    rc = cli_main(["--backend", "numpy", "-l", "-q", "--keep_going",
+                   "bad.npz", "good.npz"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "ERROR cleaning bad.npz" in err and "Failed 1/2" in err
+
+    from iterative_cleaner_tpu.io import load_archive
+    cleaned = load_archive("good.npz_cleaned.npz")
+    assert (np.asarray(cleaned.weights) == 0).any()
