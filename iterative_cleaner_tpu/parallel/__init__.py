@@ -9,6 +9,12 @@ streaming mode for long observations.
 """
 
 from iterative_cleaner_tpu.parallel.batch import clean_archives_batched  # noqa: F401
+from iterative_cleaner_tpu.parallel.distributed import (  # noqa: F401
+    DistributedContext,
+    clean_archives_hybrid,
+    hybrid_batch_cell_mesh,
+    initialize,
+)
 from iterative_cleaner_tpu.parallel.mesh import batch_mesh, cell_mesh, factor_2d  # noqa: F401
 from iterative_cleaner_tpu.parallel.sharding import clean_archive_sharded  # noqa: F401
 from iterative_cleaner_tpu.parallel.streaming import (  # noqa: F401

@@ -48,21 +48,7 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
     return jax.jit(jax.vmap(one))
 
 
-def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
-                           mesh=None) -> List[CleanResult]:
-    """Clean a batch of equal-shaped archives in one compiled call.
-
-    With ``mesh`` (a 1-D ('batch',) mesh from
-    :func:`iterative_cleaner_tpu.parallel.mesh.batch_mesh`), inputs are
-    sharded across devices along the batch axis; the batch is zero-weight
-    padded up to a multiple of the device count (padded archives clean
-    trivially and are dropped from the results).
-    """
-    import jax
-    import jax.numpy as jnp
-
-    if not archives:
-        return []
+def check_equal_shapes(archives: Sequence[Archive]) -> None:
     shapes = {(a.nsub, a.nchan, a.nbin) for a in archives}
     if len(shapes) != 1:
         raise ValueError(
@@ -70,12 +56,15 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
             "bucket by shape first (parallel.streaming handles ragged time "
             "axes)"
         )
-    dtype = jnp.dtype(config.dtype)
-    n = len(archives)
-    pad = 0
-    if mesh is not None:
-        per = int(np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
-        pad = (-n) % per
+
+
+def stack_archive_batch(archives: Sequence[Archive], pad: int, dtype):
+    """Stack per-archive inputs along a leading batch axis, zero-weight
+    padding `pad` trailing slots.  freqs/ref/period pad away from zero so
+    the padded archives' dispersion delays stay finite (dm pads to 0, so
+    their shifts are exactly zero); padded archives clean trivially.
+    Returns (cubes, weights, freqs, dms, refs, periods)."""
+    import jax.numpy as jnp
 
     def stack(get, pad_like=None):
         arrs = [np.asarray(get(a)) for a in archives]
@@ -84,38 +73,21 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
             arrs = arrs + [filler] * pad
         return jnp.asarray(np.stack(arrs), dtype=dtype)
 
-    cubes = stack(lambda a: a.total_intensity())
-    weights = stack(lambda a: a.weights)
-    # pad freqs/ref/period away from zero so the padded archives' dispersion
-    # delays are 0/1 = finite (dm pads to 0, so shifts are exactly zero)
-    freqs = stack(lambda a: a.freqs_mhz,
-                  pad_like=np.ones_like(np.asarray(archives[0].freqs_mhz)))
-    dms = stack(lambda a: a.dm)
-    refs = stack(lambda a: a.centre_freq_mhz, pad_like=np.float64(1.0))
-    periods = stack(lambda a: a.period_s, pad_like=np.float64(1.0))
-
-    # 'auto' stays on the sort path here: vmap batches a pallas_call by
-    # serialising over a grid axis, which forfeits the kernel's advantage.
-    median_impl = "sort" if config.median_impl == "auto" else config.median_impl
-    fn = build_batched_clean_fn(
-        config.max_iter, config.chanthresh, config.subintthresh,
-        config.pulse_slice, config.pulse_scale, config.pulse_region_active,
-        config.rotation, config.baseline_duty, config.fft_mode, median_impl,
+    return (
+        stack(lambda a: a.total_intensity()),
+        stack(lambda a: a.weights),
+        stack(lambda a: a.freqs_mhz,
+              pad_like=np.ones_like(np.asarray(archives[0].freqs_mhz))),
+        stack(lambda a: a.dm),
+        stack(lambda a: a.centre_freq_mhz, pad_like=np.float64(1.0)),
+        stack(lambda a: a.period_s, pad_like=np.float64(1.0)),
     )
-    args = (cubes, weights, freqs, dms, refs, periods)
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        def shard(x):
-            spec = P("batch", *([None] * (x.ndim - 1)))
-            return jax.device_put(x, NamedSharding(mesh, spec))
 
-        args = tuple(shard(x) for x in args)
-        with mesh:
-            outs = fn(*args)
-    else:
-        outs = fn(*args)
-
+def unpack_batch_results(outs, n: int,
+                         config: CleanConfig) -> List[CleanResult]:
+    """Per-archive CleanResults from batched CleanOutputs (padding slots
+    beyond `n` dropped), with the host-side bad-parts sweep applied."""
     results: List[CleanResult] = []
     final_w = np.asarray(outs.final_weights)
     scores = np.asarray(outs.scores)
@@ -142,3 +114,50 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
             result.n_bad_channels = nbc
         results.append(result)
     return results
+
+
+def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
+                           mesh=None) -> List[CleanResult]:
+    """Clean a batch of equal-shaped archives in one compiled call.
+
+    With ``mesh`` (a 1-D ('batch',) mesh from
+    :func:`iterative_cleaner_tpu.parallel.mesh.batch_mesh`), inputs are
+    sharded across devices along the batch axis; the batch is zero-weight
+    padded up to a multiple of the device count (padded archives clean
+    trivially and are dropped from the results).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not archives:
+        return []
+    check_equal_shapes(archives)
+    n = len(archives)
+    pad = 0
+    if mesh is not None:
+        per = int(np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
+        pad = (-n) % per
+    args = stack_archive_batch(archives, pad, jnp.dtype(config.dtype))
+
+    # 'auto' stays on the sort path here: vmap batches a pallas_call by
+    # serialising over a grid axis, which forfeits the kernel's advantage.
+    median_impl = "sort" if config.median_impl == "auto" else config.median_impl
+    fn = build_batched_clean_fn(
+        config.max_iter, config.chanthresh, config.subintthresh,
+        config.pulse_slice, config.pulse_scale, config.pulse_region_active,
+        config.rotation, config.baseline_duty, config.fft_mode, median_impl,
+    )
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def shard(x):
+            spec = P("batch", *([None] * (x.ndim - 1)))
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        args = tuple(shard(x) for x in args)
+        with mesh:
+            outs = fn(*args)
+    else:
+        outs = fn(*args)
+
+    return unpack_batch_results(outs, n, config)
