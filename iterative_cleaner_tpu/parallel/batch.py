@@ -117,14 +117,19 @@ def unpack_batch_results(outs, n: int,
 
 
 def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
-                           mesh=None) -> List[CleanResult]:
+                           mesh=None, specs=None) -> List[CleanResult]:
     """Clean a batch of equal-shaped archives in one compiled call.
 
     With ``mesh`` (a 1-D ('batch',) mesh from
     :func:`iterative_cleaner_tpu.parallel.mesh.batch_mesh`), inputs are
     sharded across devices along the batch axis; the batch is zero-weight
     padded up to a multiple of the device count (padded archives clean
-    trivially and are dropped from the results).
+    trivially and are dropped from the results).  ``specs`` overrides the
+    per-input PartitionSpecs (one per stacked input, in
+    :func:`stack_archive_batch` order) for meshes with extra axes — e.g. the
+    hybrid ('batch', 'sub', 'chan') mesh of
+    :func:`iterative_cleaner_tpu.parallel.distributed.clean_archives_hybrid`;
+    the batch then pads to a multiple of the mesh's 'batch' axis only.
     """
     import jax
     import jax.numpy as jnp
@@ -135,7 +140,10 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
     n = len(archives)
     pad = 0
     if mesh is not None:
-        per = int(np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
+        if "batch" in mesh.axis_names:
+            per = int(mesh.shape["batch"])
+        else:
+            per = int(np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
         pad = (-n) % per
     args = stack_archive_batch(archives, pad, jnp.dtype(config.dtype))
 
@@ -150,11 +158,17 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        def shard(x):
-            spec = P("batch", *([None] * (x.ndim - 1)))
-            return jax.device_put(x, NamedSharding(mesh, spec))
-
-        args = tuple(shard(x) for x in args)
+        if specs is None:
+            specs = tuple(P("batch", *([None] * (x.ndim - 1))) for x in args)
+        if len(specs) != len(args):
+            raise ValueError(
+                f"specs must have {len(args)} entries (one per stacked "
+                f"input), got {len(specs)}"
+            )
+        args = tuple(
+            jax.device_put(x, NamedSharding(mesh, spec))
+            for x, spec in zip(args, specs)
+        )
         with mesh:
             outs = fn(*args)
     else:

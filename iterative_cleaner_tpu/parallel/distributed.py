@@ -60,6 +60,13 @@ def initialize(coordinator_address: Optional[str] = None,
             ("COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS"))
         or "," in workers
     )
+    if not (explicit or env_managed) and (num_processes is not None
+                                          or process_id is not None):
+        raise ValueError(
+            "num_processes/process_id given but no coordinator_address and "
+            "no cluster environment detected — refusing to degrade to a "
+            "single-process run"
+        )
     if explicit or env_managed:
         try:
             jax.distributed.initialize(
@@ -69,9 +76,12 @@ def initialize(coordinator_address: Optional[str] = None,
             )
         except RuntimeError as exc:
             # idempotent bootstrap: only the double-initialise case is
-            # benign; real failures (unreachable coordinator, timeout) must
-            # surface, not degrade to a silent single-process run
-            if "already" not in str(exc).lower():
+            # benign ("should only be called once" / "already initialized",
+            # wording varies across jax versions); real failures
+            # (unreachable coordinator, timeout) must surface, not degrade
+            # to a silent single-process run
+            msg = str(exc).lower()
+            if "already" not in msg and "once" not in msg:
                 raise
     return DistributedContext(
         process_index=jax.process_index(),
@@ -120,43 +130,18 @@ def clean_archives_hybrid(archives, config, mesh):
     padded archives fill the last group (they clean trivially and are
     dropped, mirroring parallel.batch).
     """
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
-    from iterative_cleaner_tpu.parallel.batch import (
-        build_batched_clean_fn,
-        check_equal_shapes,
-        stack_archive_batch,
-        unpack_batch_results,
+    from iterative_cleaner_tpu.parallel.batch import clean_archives_batched
+
+    return clean_archives_batched(
+        archives, config, mesh,
+        specs=(
+            P("batch", "sub", "chan", None),  # cubes
+            P("batch", "sub", "chan"),        # weights
+            P("batch"),                       # freqs (replicated over chan)
+            P("batch"),                       # dms
+            P("batch"),                       # refs
+            P("batch"),                       # periods
+        ),
     )
-
-    if not archives:
-        return []
-    check_equal_shapes(archives)
-    n = len(archives)
-    pad = (-n) % mesh.shape["batch"]
-    cubes, weights, freqs, dms, refs, periods = stack_archive_batch(
-        archives, pad, jnp.dtype(config.dtype))
-
-    median_impl = "sort" if config.median_impl == "auto" else config.median_impl
-    fn = build_batched_clean_fn(
-        config.max_iter, config.chanthresh, config.subintthresh,
-        config.pulse_slice, config.pulse_scale, config.pulse_region_active,
-        config.rotation, config.baseline_duty, config.fft_mode, median_impl,
-    )
-
-    def shard(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
-
-    with mesh:
-        outs = fn(
-            shard(cubes, P("batch", "sub", "chan", None)),
-            shard(weights, P("batch", "sub", "chan")),
-            shard(freqs, P("batch")),
-            shard(dms, P("batch")),
-            shard(refs, P("batch")),
-            shard(periods, P("batch")),
-        )
-
-    return unpack_batch_results(outs, n, config)
