@@ -27,15 +27,46 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+import numpy as np
+
 from iterative_cleaner_tpu.ops.dsp import (
     dispersion_shift_bins,
     fit_template_amplitudes,
     remove_baseline,
     rotate_bins,
-    template_residuals,
     weighted_template,
 )
 from iterative_cleaner_tpu.stats.masked_jax import surgical_scores_jax
+
+
+def _pulse_window(nbin, pulse_slice, pulse_scale, pulse_active, dtype):
+    """(nbin,) multiplier the reference applies to the residual's on-pulse
+    bins (reference :280-283): 1 everywhere, ``pulse_scale`` on
+    [start, end).  None when inactive."""
+    if not pulse_active:
+        return None
+    m = np.ones(nbin, dtype=np.float64)
+    start, end = pulse_slice
+    m[start:end] = pulse_scale
+    return jnp.asarray(m, dtype=dtype)
+
+
+def dispersed_residual_base(ded_cube, back_shifts, *, pulse_slice,
+                            pulse_scale, pulse_active, rotation):
+    """Iteration-invariant part of the dispersed-frame residual.
+
+    The residual the statistics consume is ``rot(amps*t∘m - ded∘m)`` (the
+    reference computes ``amps*template - prof`` per cell, scales the on-pulse
+    window, then dededisperses, :101-104,:280-283).  Rotation is linear, so
+    this splits into ``amps * rot_c(t∘m) - rot(ded∘m)``: the second term
+    never changes across iterations and is computed here once, keeping the
+    per-iteration rotation down to the (nbin,) template instead of the full
+    cube."""
+    nbin = ded_cube.shape[-1]
+    m = _pulse_window(nbin, pulse_slice, pulse_scale, pulse_active,
+                      ded_cube.dtype)
+    masked = ded_cube if m is None else ded_cube * m
+    return rotate_bins(masked, back_shifts, jnp, method=rotation)
 
 
 class CleanOutputs(NamedTuple):
@@ -63,23 +94,31 @@ class _Carry(NamedTuple):
     loop_rfi_frac: jax.Array
 
 
-def iteration_step(ded_cube, weights, orig_weights, cell_mask, back_shifts, *,
-                   chanthresh, subintthresh, pulse_slice, pulse_scale,
-                   pulse_active, rotation, fft_mode="fft",
+def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
+                   back_shifts, *, chanthresh, subintthresh, pulse_slice,
+                   pulse_scale, pulse_active, rotation, fft_mode="fft",
                    median_impl="sort"):
     """One cleaning iteration: template -> fit -> residual stats -> new weights.
 
     ``weights`` are the previous iteration's (template) weights;
     ``orig_weights``/``cell_mask`` never change (reference :112,:115-117).
-    Returns (new_weights, scores).
+    ``disp_base`` is :func:`dispersed_residual_base` of the cube: the
+    per-iteration work touches the full cube only in the two template
+    einsums and the fused statistics pass — no cube-sized rotation and no
+    materialised residual.  Returns (new_weights, scores).
     """
+    nsub, nchan, nbin = ded_cube.shape
     template = weighted_template(ded_cube, weights, jnp) * 10000.0  # ref :94
     amps = fit_template_amplitudes(ded_cube, template, jnp)
-    resid = template_residuals(
-        ded_cube, template, amps, pulse_slice, pulse_scale, jnp, pulse_active
-    )
-    # back to the dispersed frame before statistics (reference :104)
-    resid = rotate_bins(resid, back_shifts, jnp, method=rotation)
+    m = _pulse_window(nbin, pulse_slice, pulse_scale, pulse_active,
+                      ded_cube.dtype)
+    t = template if m is None else template * m
+    # per-channel rotation of the (nbin,) template back to the dispersed
+    # frame (reference :104 rotates the whole residual cube; linearity lets
+    # the cube part live in disp_base)
+    rot_t = rotate_bins(jnp.broadcast_to(t, (nchan, nbin)), back_shifts, jnp,
+                        method=rotation)
+    resid = amps[:, :, None] * rot_t[None] - disp_base  # ref :277-279 sign
     weighted = resid * orig_weights[:, :, None]  # apply_weights, ref :291-297
     scores = surgical_scores_jax(weighted, cell_mask, chanthresh,
                                  subintthresh, fft_mode=fft_mode,
@@ -102,6 +141,10 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
     nsub, nchan, _ = ded_cube.shape
     wdtype = orig_weights.dtype
     cell_mask = orig_weights == 0  # ref :115 (mask where weight exactly 0)
+    disp_base = dispersed_residual_base(
+        ded_cube, back_shifts, pulse_slice=pulse_slice,
+        pulse_scale=pulse_scale, pulse_active=pulse_active, rotation=rotation,
+    )
 
     history = jnp.zeros((max_iter + 1, nsub, nchan), dtype=wdtype)
     history = history.at[0].set(orig_weights)  # pre-loop seed, ref :78-79
@@ -124,7 +167,8 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
 
     def body(c: _Carry) -> _Carry:
         new_w, scores = iteration_step(
-            ded_cube, c.weights, orig_weights, cell_mask, back_shifts,
+            ded_cube, disp_base, c.weights, orig_weights, cell_mask,
+            back_shifts,
             chanthresh=chanthresh, subintthresh=subintthresh,
             pulse_slice=pulse_slice, pulse_scale=pulse_scale,
             pulse_active=pulse_active, rotation=rotation, fft_mode=fft_mode,

@@ -21,6 +21,27 @@ def test_initialize_single_process_noop():
     assert ctx.global_devices == len(jax.devices())
 
 
+def test_initialize_rejects_cluster_args_without_coordinator():
+    """num_processes/process_id without a coordinator must error, not
+    silently degrade to a 1-process run (duplicate-work hazard)."""
+    with pytest.raises(ValueError, match="coordinator"):
+        distributed.initialize(num_processes=4, process_id=2)
+
+
+def test_batched_specs_length_checked():
+    from jax.sharding import PartitionSpec as P
+
+    from iterative_cleaner_tpu.parallel.batch import clean_archives_batched
+    from iterative_cleaner_tpu.parallel.mesh import batch_mesh
+
+    archives = [make_synthetic_archive(nsub=4, nchan=8, nbin=16, seed=0)[0]]
+    with pytest.raises(ValueError, match="specs"):
+        clean_archives_batched(
+            archives, CleanConfig(backend="jax", max_iter=1),
+            batch_mesh(2), specs=(P("batch"),),
+        )
+
+
 @pytest.mark.parametrize("batch,shape", [(2, (2, 2)), (4, (1, 2)), (1, (2, 4))])
 def test_hybrid_mesh_shapes(batch, shape):
     mesh = distributed.hybrid_batch_cell_mesh(batch=batch)

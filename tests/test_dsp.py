@@ -37,6 +37,22 @@ class TestRotate:
         for c, s in enumerate([0, 4, -7]):
             np.testing.assert_array_equal(got[:, c], np.roll(base[:, c], s, axis=-1))
 
+    def test_roll_jax_matmul_bitexact_vs_numpy_gather(self, xp):
+        """The jax roll path (one-hot permutation matmul, MXU-shaped) must be
+        bit-identical to the numpy gather path for every dtype/shift shape."""
+        if xp is np:
+            pytest.skip("cross-path comparison, driven from the jax id")
+        rng = np.random.default_rng(7)
+        for dtype in (np.float32, np.float64):
+            x = rng.normal(size=(5, 9, 32)).astype(dtype)
+            for shifts in (np.float64(3.0), np.float64(-11.0),
+                           rng.normal(scale=10, size=9)):
+                want = rotate_bins(x, shifts, np, method="roll")
+                got = np.asarray(rotate_bins(
+                    jnp.asarray(x), jnp.asarray(shifts), jnp, method="roll"))
+                assert got.dtype == want.dtype
+                np.testing.assert_array_equal(got, want)
+
     def test_fractional_rotation_invertible(self, xp):
         # exact on band-limited profiles (the Nyquist bin of a fractionally
         # rotated real signal attenuates by cos(pi*s); see rotate_bins)
