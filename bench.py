@@ -35,7 +35,9 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=3):
 
     from iterative_cleaner_tpu.backends.jax_backend import (
         build_clean_fn,
+        resolve_fft_mode,
         resolve_median_impl,
+        resolve_stats_impl,
     )
     from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
 
@@ -47,9 +49,12 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=3):
         seed=0, dtype=np.float32,
     )
     median_impl = resolve_median_impl("auto", jnp.float32)
-    _log(f"median impl: {median_impl}")
+    fft_mode = resolve_fft_mode("auto", jnp.float32)
+    stats_impl = resolve_stats_impl("auto", jnp.float32, nbin, fft_mode)
+    _log(f"median impl: {median_impl}, fft mode: {fft_mode}, "
+         f"stats impl: {stats_impl}")
     fn = build_clean_fn(max_iter, 5.0, 5.0, (0, 0), 1.0, False, "fourier",
-                        0.15, False, "fft", median_impl)
+                        0.15, False, fft_mode, median_impl, stats_impl)
     dev = jax.devices()[0]
     _log(f"jax device: {dev.platform} {getattr(dev, 'device_kind', '?')}")
 

@@ -40,10 +40,38 @@ def resolve_median_impl(median_impl: str, dtype) -> str:
     return "pallas" if on_tpu and jnp.dtype(dtype) == jnp.float32 else "sort"
 
 
+def resolve_fft_mode(fft_mode: str, dtype) -> str:
+    """'auto' picks the MXU matmul DFT on TPU float32 (XLA's TPU fft
+    lowering is slow at profile sizes) and the XLA fft op elsewhere."""
+    if fft_mode != "auto":
+        return fft_mode
+    on_tpu = jax.devices()[0].platform == "tpu"
+    return "dft" if on_tpu and jnp.dtype(dtype) == jnp.float32 else "fft"
+
+
+def resolve_stats_impl(stats_impl: str, dtype, nbin: int,
+                       fft_mode_resolved: str) -> str:
+    """'auto' picks the fused Pallas diagnostics kernel on single-device TPU
+    float32 runs (same rationale as :func:`resolve_median_impl`) when its
+    constraints hold: DFT-flavoured rFFT magnitudes and an nbin that fits
+    the kernel's VMEM budget."""
+    if stats_impl != "auto":
+        return stats_impl
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        FUSED_STATS_MAX_NBIN,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    ok = (on_tpu and jnp.dtype(dtype) == jnp.float32
+          and fft_mode_resolved == "dft" and nbin <= FUSED_STATS_MAX_NBIN)
+    return "fused" if ok else "xla"
+
+
 @functools.lru_cache(maxsize=None)
 def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, baseline_duty,
-                   unload_res, fft_mode="fft", median_impl="sort"):
+                   unload_res, fft_mode="fft", median_impl="sort",
+                   stats_impl="xla"):
     """Build (and cache) the jitted whole-archive cleaning program for one
     static configuration."""
 
@@ -58,6 +86,7 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             subintthresh=subintthresh, pulse_slice=pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
+            stats_impl=stats_impl,
         )
         if not unload_res:
             return outs, None
@@ -78,11 +107,14 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
                config: CleanConfig) -> CleanResult:
     """Clean a total-intensity (nsub, nchan, nbin) cube on the default device."""
     dtype = jnp.dtype(config.dtype)
+    fft_mode = resolve_fft_mode(config.fft_mode, dtype)
     fn = build_clean_fn(
         config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
         config.rotation, config.baseline_duty, config.unload_res,
-        config.fft_mode, resolve_median_impl(config.median_impl, dtype),
+        fft_mode, resolve_median_impl(config.median_impl, dtype),
+        resolve_stats_impl(config.stats_impl, dtype, cube.shape[-1],
+                           fft_mode),
     )
     outs, resid = fn(
         jnp.asarray(cube, dtype=dtype),

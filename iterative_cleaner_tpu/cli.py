@@ -81,6 +81,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "jnp.sort based, the Pallas TPU radix-bisection "
                              "kernel, or auto (pallas on TPU float32). Both "
                              "produce bit-identical masks.")
+    parser.add_argument("--stats_impl", choices=("auto", "xla", "fused"),
+                        default="auto",
+                        help="Per-cell diagnostics on the jax path: XLA "
+                             "fusion, the fused Pallas TPU kernel (fit + "
+                             "residual + all four diagnostics in one pass), "
+                             "or auto (fused on TPU float32).")
     parser.add_argument("--checkpoint", type=str, default="",
                         metavar="DIR",
                         help="Checkpoint directory: each archive's cleaning "
@@ -118,6 +124,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         backend=args.backend,
         rotation=args.rotation,
         median_impl=args.median_impl,
+        stats_impl=args.stats_impl,
         unload_res=args.unload_res,
         record_history=args.record_history,
     )

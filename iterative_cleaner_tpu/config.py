@@ -29,12 +29,21 @@ class CleanConfig:
     # --- framework-only parameters ---
     backend: str = "jax"         # {"numpy", "jax"}
     rotation: str = "fourier"    # {"fourier", "roll"} dedispersion rotation
-    fft_mode: str = "fft"        # {"fft", "dft"} rFFT diagnostic backend (jax path)
+    # rFFT diagnostic backend on the jax path: "fft" (XLA fft op), "dft"
+    # (two MXU matmuls against cos/sin bases — same magnitudes, TPU-fast),
+    # or "auto" (dft on TPU float32, fft otherwise)
+    fft_mode: str = "auto"
     # masked-median implementation on the jax path: "sort" (jnp.sort based),
     # "pallas" (radix-bisection TPU kernel, stats/pallas_kernels.py), or
     # "auto" (pallas on single-device TPU float32, sort otherwise).  The two
     # implementations agree bit-for-bit.
     median_impl: str = "auto"
+    # per-cell diagnostics implementation on the jax path: "xla" (fused by
+    # the compiler), "fused" (single Pallas kernel: fit + residual +
+    # weighting + all four diagnostics in two cube reads; DFT-flavoured
+    # rFFT magnitudes), or "auto" (fused on single-device TPU float32,
+    # xla otherwise)
+    stats_impl: str = "auto"
     baseline_duty: float = 0.15  # off-pulse window fraction for baseline find
     dtype: str = "float32"       # compute dtype on the jax path
     unload_res: bool = False     # -u: also produce the pulse-free residual
@@ -65,10 +74,19 @@ class CleanConfig:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.rotation not in ("fourier", "roll"):
             raise ValueError(f"unknown rotation method {self.rotation!r}")
-        if self.fft_mode not in ("fft", "dft"):
+        if self.fft_mode not in ("auto", "fft", "dft"):
             raise ValueError(f"unknown fft mode {self.fft_mode!r}")
         if self.median_impl not in ("auto", "sort", "pallas"):
             raise ValueError(f"unknown median impl {self.median_impl!r}")
+        if self.stats_impl not in ("auto", "xla", "fused"):
+            raise ValueError(f"unknown stats impl {self.stats_impl!r}")
+        if self.stats_impl == "fused" and self.dtype != "float32":
+            raise ValueError("stats_impl='fused' requires dtype='float32'")
+        if self.stats_impl == "fused" and self.fft_mode == "fft":
+            raise ValueError(
+                "stats_impl='fused' computes DFT-flavoured rFFT magnitudes "
+                "and cannot honour fft_mode='fft'; use fft_mode='dft' or "
+                "'auto'")
         if self.median_impl == "pallas" and self.dtype != "float32":
             raise ValueError(
                 "median_impl='pallas' requires dtype='float32' (the kernel's "

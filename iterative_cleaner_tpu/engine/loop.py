@@ -36,7 +36,10 @@ from iterative_cleaner_tpu.ops.dsp import (
     rotate_bins,
     weighted_template,
 )
-from iterative_cleaner_tpu.stats.masked_jax import surgical_scores_jax
+from iterative_cleaner_tpu.stats.masked_jax import (
+    cell_diagnostics_jax,
+    scale_and_combine,
+)
 
 
 def _pulse_window(nbin, pulse_slice, pulse_scale, pulse_active, dtype):
@@ -97,19 +100,24 @@ class _Carry(NamedTuple):
 def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
                    back_shifts, *, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, fft_mode="fft",
-                   median_impl="sort"):
+                   median_impl="sort", stats_impl="xla"):
     """One cleaning iteration: template -> fit -> residual stats -> new weights.
 
     ``weights`` are the previous iteration's (template) weights;
     ``orig_weights``/``cell_mask`` never change (reference :112,:115-117).
     ``disp_base`` is :func:`dispersed_residual_base` of the cube: the
-    per-iteration work touches the full cube only in the two template
-    einsums and the fused statistics pass — no cube-sized rotation and no
-    materialised residual.  Returns (new_weights, scores).
+    per-iteration work touches the full cube only in the template einsum and
+    the per-cell statistics — no cube-sized rotation and no materialised
+    residual.  With ``stats_impl='fused'`` the whole per-cell half (fit,
+    residual, weighting, four diagnostics) runs as one Pallas kernel in two
+    cube reads.  Returns (new_weights, scores).
     """
+    if stats_impl == "fused" and fft_mode == "fft":
+        raise ValueError(
+            "stats_impl='fused' computes DFT-flavoured rFFT magnitudes; "
+            "pass fft_mode='dft'")
     nsub, nchan, nbin = ded_cube.shape
     template = weighted_template(ded_cube, weights, jnp) * 10000.0  # ref :94
-    amps = fit_template_amplitudes(ded_cube, template, jnp)
     m = _pulse_window(nbin, pulse_slice, pulse_scale, pulse_active,
                       ded_cube.dtype)
     t = template if m is None else template * m
@@ -118,11 +126,20 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
     # the cube part live in disp_base)
     rot_t = rotate_bins(jnp.broadcast_to(t, (nchan, nbin)), back_shifts, jnp,
                         method=rotation)
-    resid = amps[:, :, None] * rot_t[None] - disp_base  # ref :277-279 sign
-    weighted = resid * orig_weights[:, :, None]  # apply_weights, ref :291-297
-    scores = surgical_scores_jax(weighted, cell_mask, chanthresh,
-                                 subintthresh, fft_mode=fft_mode,
-                                 median_impl=median_impl)
+    if stats_impl == "fused":
+        from iterative_cleaner_tpu.stats.pallas_kernels import (
+            cell_diagnostics_pallas,
+        )
+
+        diags = cell_diagnostics_pallas(ded_cube, disp_base, rot_t, template,
+                                        orig_weights, cell_mask)
+    else:
+        amps = fit_template_amplitudes(ded_cube, template, jnp)
+        resid = amps[:, :, None] * rot_t[None] - disp_base  # ref :277-279
+        weighted = resid * orig_weights[:, :, None]  # apply_weights, :291-297
+        diags = cell_diagnostics_jax(weighted, cell_mask, fft_mode)
+    scores = scale_and_combine(diags, cell_mask, chanthresh, subintthresh,
+                               median_impl)
     new_weights = jnp.where(scores >= 1.0, 0.0, orig_weights)  # ref :300-305
     return new_weights, scores
 
@@ -131,7 +148,8 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
                           max_iter, chanthresh, subintthresh,
                           pulse_slice, pulse_scale, pulse_active,
                           rotation, fft_mode="fft",
-                          median_impl="sort") -> CleanOutputs:
+                          median_impl="sort",
+                          stats_impl="xla") -> CleanOutputs:
     """Run the full iteration loop on an already-prepared cube.
 
     ``ded_cube``: baseline-removed, dedispersed (nsub, nchan, nbin) cube.
@@ -172,7 +190,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
             chanthresh=chanthresh, subintthresh=subintthresh,
             pulse_slice=pulse_slice, pulse_scale=pulse_scale,
             pulse_active=pulse_active, rotation=rotation, fft_mode=fft_mode,
-            median_impl=median_impl,
+            median_impl=median_impl, stats_impl=stats_impl,
         )
         seen = jnp.arange(max_iter + 1) < c.count
         matches = jnp.all(c.history == new_w[None], axis=(1, 2)) & seen

@@ -147,13 +147,17 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
         pad = (-n) % per
     args = stack_archive_batch(archives, pad, jnp.dtype(config.dtype))
 
+    from iterative_cleaner_tpu.backends.jax_backend import resolve_fft_mode
+
     # 'auto' stays on the sort path here: vmap batches a pallas_call by
     # serialising over a grid axis, which forfeits the kernel's advantage.
     median_impl = "sort" if config.median_impl == "auto" else config.median_impl
     fn = build_batched_clean_fn(
         config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
-        config.rotation, config.baseline_duty, config.fft_mode, median_impl,
+        config.rotation, config.baseline_duty,
+        resolve_fft_mode(config.fft_mode, jnp.dtype(config.dtype)),
+        median_impl,
     )
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -68,6 +68,8 @@ def clean_archive_sharded(archive: Archive, config: CleanConfig,
     import jax
     import jax.numpy as jnp
 
+    from iterative_cleaner_tpu.backends.jax_backend import resolve_fft_mode
+
     dtype = jnp.dtype(config.dtype)
     # 'auto' stays on the sort path here: a pallas_call inside a GSPMD
     # program forces the diagnostics to gather onto one device.
@@ -75,7 +77,8 @@ def clean_archive_sharded(archive: Archive, config: CleanConfig,
     fn, cube_sh, w_sh, rep = build_sharded_clean_fn(
         mesh, config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
-        config.rotation, config.baseline_duty, config.fft_mode, median_impl,
+        config.rotation, config.baseline_duty,
+        resolve_fft_mode(config.fft_mode, dtype), median_impl,
     )
     with mesh:
         outs = fn(

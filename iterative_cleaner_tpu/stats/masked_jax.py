@@ -83,12 +83,29 @@ def scale_lines_masked(diag, mask, axis, thresh, median_impl="sort"):
     return jnp.where(dead, mag, mag / thresh)
 
 
-def scale_lines_plain(diag, axis, thresh):
+def _plain_median(diag, axis, median_impl):
+    """``jnp.median`` (keepdims), optionally via the Pallas kernel with an
+    all-false mask — the two share XLA's sort total order, so non-NaN lines
+    agree bit-for-bit (verified in tests), and NaN-bearing lines are patched
+    to NaN to match ``jnp.median``'s propagation; the kernel avoids two full
+    sorts per scaler."""
+    if median_impl == "pallas":
+        from iterative_cleaner_tpu.stats.pallas_kernels import (
+            masked_median_pallas,
+        )
+
+        med = masked_median_pallas(diag, jnp.zeros(diag.shape, bool), axis)
+        has_nan = jnp.any(jnp.isnan(diag), axis=axis, keepdims=True)
+        return jnp.where(has_nan, jnp.nan, med)
+    return jnp.median(diag, axis=axis, keepdims=True)
+
+
+def scale_lines_plain(diag, axis, thresh, median_impl="sort"):
     """Plain-path normalisation (the rFFT diagnostic): IEEE semantics, no
     masking — zero MAD yields inf/nan that flow onward (quirk 5)."""
-    med = jnp.median(diag, axis=axis, keepdims=True)
+    med = _plain_median(diag, axis, median_impl)
     centred = diag - med
-    mad = jnp.median(jnp.abs(centred), axis=axis, keepdims=True)
+    mad = _plain_median(jnp.abs(centred), axis, median_impl)
     return jnp.abs(centred / mad) / thresh
 
 
@@ -119,40 +136,61 @@ def rfft_magnitudes(x, mode="fft"):
     return jnp.sqrt(re * re + im * im)
 
 
-def surgical_scores_jax(resid_weighted, cell_mask, chanthresh, subintthresh,
-                        fft_mode="fft", median_impl="sort"):
-    """Zap scores for every (subint, channel) cell; score >= 1 means zap.
+def cell_diagnostics_jax(resid_weighted, cell_mask, fft_mode="fft"):
+    """The four per-cell diagnostics of reference :206-212 as (nsub, nchan)
+    matrices: (d_std, d_mean, d_ptp, d_fft).
 
-    Mirrors reference :202-226 under the explicit-mask rules above.  Since
-    the cell mask is bin-uniform and masked cells' data is exactly zero
-    (``apply_weights`` zeroed them, reference :296), bin-axis reductions are
-    computed plainly and patched per rule 4.
+    Since the cell mask is bin-uniform and masked cells' data is exactly
+    zero (``apply_weights`` zeroed them, reference :296), bin-axis
+    reductions are computed plainly and patched per rule 4.
     """
     x = resid_weighted
     m = cell_mask
 
-    # single-pass moments: sum/sumsq/max/min fuse into one read of the cube
-    # (jnp.std's two-pass mean-then-deviations form costs a second read;
-    # the variance identity is safe here because residual profiles are
-    # near-zero-mean, so no catastrophic cancellation)
+    # two passes over the cube: a mean pass, then one fused pass computing
+    # the centred moments and the rFFT magnitudes off the shared ``centred``
+    # (jnp.std's stable two-pass variance — the single-pass identity
+    # catastrophically cancels for |mean| >> std cells).  Masked cells'
+    # centring skew is irrelevant: their std is patched to 0.
     n = x.shape[2]
     mean_b = jnp.sum(x, axis=2) / n
-    sumsq = jnp.sum(x * x, axis=2)
-    var = jnp.maximum(sumsq / n - mean_b * mean_b, 0.0)
-    d_std = jnp.where(m, 0.0, jnp.sqrt(var))
     d_mean = jnp.where(m, 0.0, mean_b)
+    centred = x - jnp.where(m, 0.0, mean_b)[..., None]
+    var = jnp.sum(centred * centred, axis=2) / n
+    d_std = jnp.where(m, 0.0, jnp.sqrt(var))
     d_ptp = jnp.where(m, jnp.asarray(MA_FILL, x.dtype),
                       jnp.max(x, axis=2) - jnp.min(x, axis=2))
-    centred = x - jnp.where(m, 0.0, mean_b)[..., None]
     d_fft = jnp.max(rfft_magnitudes(centred, fft_mode), axis=2)
+    return d_std, d_mean, d_ptp, d_fft
 
+
+def scale_and_combine(diagnostics, cell_mask, chanthresh, subintthresh,
+                      median_impl="sort"):
+    """Channel/subint scaling + 4-way median (reference :220-226) over
+    precomputed diagnostics (from :func:`cell_diagnostics_jax` or the fused
+    Pallas kernel)."""
+    d_std, d_mean, d_ptp, d_fft = diagnostics
+    m = cell_mask
     per_diag = []
     for diag in (d_std, d_mean, d_ptp):
         chan_side = scale_lines_masked(diag, m, 0, chanthresh, median_impl)
         subint_side = scale_lines_masked(diag, m, 1, subintthresh, median_impl)
         per_diag.append(jnp.maximum(chan_side, subint_side))
+    fft_impl = median_impl if d_fft.dtype == jnp.float32 else "sort"
     per_diag.append(
-        jnp.maximum(scale_lines_plain(d_fft, 0, chanthresh),
-                    scale_lines_plain(d_fft, 1, subintthresh))
+        jnp.maximum(scale_lines_plain(d_fft, 0, chanthresh, fft_impl),
+                    scale_lines_plain(d_fft, 1, subintthresh, fft_impl))
     )
     return jnp.median(jnp.stack(per_diag), axis=0)
+
+
+def surgical_scores_jax(resid_weighted, cell_mask, chanthresh, subintthresh,
+                        fft_mode="fft", median_impl="sort"):
+    """Zap scores for every (subint, channel) cell; score >= 1 means zap.
+
+    Mirrors reference :202-226 under the explicit-mask rules above.
+    """
+    return scale_and_combine(
+        cell_diagnostics_jax(resid_weighted, cell_mask, fft_mode),
+        cell_mask, chanthresh, subintthresh, median_impl,
+    )

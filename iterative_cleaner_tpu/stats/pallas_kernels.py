@@ -120,6 +120,152 @@ def _median_axis0(values, mask, interpret):
     return out[:, :m]
 
 
+# ---------------------------------------------------------------------------
+# Fused per-cell diagnostics
+# ---------------------------------------------------------------------------
+#
+# One kernel for the whole per-cell half of an iteration (reference
+# :275-296 + :206-212): template-amplitude fit, residual construction,
+# weighting, and all four surgical-scrub diagnostics.  Everything after the
+# global template is row-local to a (subint, channel) cell, so a single
+# VMEM-resident pass over ded/disp_base replaces what XLA schedules as ~6
+# separate HBM passes (fit reduce, moment reduces, and two cube-sized DFT
+# spectra materialisations); the rFFT magnitudes ride the MXU against
+# cos/sin bases and their max never leaves VMEM.
+
+_S_BLK = 8      # subints per block (sublane-friendly)
+_C_BLK = 128    # channels per block (lane width)
+
+# np.ma's float fill value (masked ptp, quirk 4), shared with the XLA path.
+from iterative_cleaner_tpu.stats.masked_jax import MA_FILL  # noqa: E402
+
+_MA_FILL_F32 = np.float32(MA_FILL)
+
+# The kernel keeps two (S, C, nbin) cube blocks, the DFT tables, and the
+# (S*C, nbin)/(S*C, nk) intermediates in VMEM; past this nbin the ~16 MB
+# VMEM budget is at risk, so callers fall back to the XLA path.
+FUSED_STATS_MAX_NBIN = 256
+
+
+def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
+                       cos_ref, sin_ref, tt_ref,
+                       std_ref, mean_ref, ptp_ref, fft_ref):
+    nbin = ded_ref.shape[-1]
+    t = t_ref[0]                                    # (B,)
+    tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
+    ded = ded_ref[:]                                # (S, C, B)
+    # closed-form fit (dsp.fit_template_amplitudes, same ops/order)
+    tp = jnp.sum(ded * t[None, None, :], axis=2)
+    amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
+    resid = amp[:, :, None] * rott_ref[:][None] - disp_ref[:]
+    wres = resid * w_ref[:][:, :, None]             # apply_weights
+    mask = m_ref[:]                                 # (S, C) bool
+
+    inv_n = np.float32(1.0 / nbin)
+    mean = jnp.sum(wres, axis=2) * inv_n
+    mean_ref[:] = jnp.where(mask, np.float32(0.0), mean)
+    ptp = jnp.max(wres, axis=2) - jnp.min(wres, axis=2)
+    ptp_ref[:] = jnp.where(mask, _MA_FILL_F32, ptp)
+
+    # mask-aware mean subtraction (reference :210-211); the tile is
+    # VMEM-resident, so the two-pass centred variance (jnp.std's stable
+    # form — no cancellation for |mean| >> std cells) costs no extra HBM
+    # traffic.  Masked cells' centring skew is irrelevant: their std is
+    # patched to 0.
+    centred = wres - jnp.where(mask, np.float32(0.0), mean)[:, :, None]
+    var = jnp.sum(centred * centred, axis=2) * inv_n
+    std_ref[:] = jnp.where(mask, np.float32(0.0), jnp.sqrt(var))
+    flat = centred.reshape(-1, nbin)                # (S*C, B)
+    re = jax.lax.dot_general(flat, cos_ref[:], (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=jax.lax.Precision.HIGHEST)
+    im = jax.lax.dot_general(flat, sin_ref[:], (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=jax.lax.Precision.HIGHEST)
+    mag2 = re * re + im * im                        # (S*C, K)
+    fft_ref[:] = jnp.sqrt(jnp.max(mag2, axis=1)).reshape(ptp_ref.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _cell_stats_call(ded, disp_base, rot_t, template, tt_info, weights,
+                     cell_mask, cos_t, sin_t, interpret):
+    nsub, nchan, nbin = ded.shape
+    pad_s = (-nsub) % _S_BLK
+    pad_c = (-nchan) % _C_BLK
+    if pad_s or pad_c:
+        ded = jnp.pad(ded, ((0, pad_s), (0, pad_c), (0, 0)))
+        disp_base = jnp.pad(disp_base, ((0, pad_s), (0, pad_c), (0, 0)))
+        rot_t = jnp.pad(rot_t, ((0, pad_c), (0, 0)))
+        weights = jnp.pad(weights, ((0, pad_s), (0, pad_c)))
+        cell_mask = jnp.pad(cell_mask, ((0, pad_s), (0, pad_c)),
+                            constant_values=True)
+    ns, nc = nsub + pad_s, nchan + pad_c
+    grid = (ns // _S_BLK, nc // _C_BLK)
+    cell_spec = pl.BlockSpec((_S_BLK, _C_BLK), lambda i, j: (i, j),
+                             memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        _cell_stats_kernel,
+        out_shape=[jax.ShapeDtypeStruct((ns, nc), jnp.float32)] * 4,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_S_BLK, _C_BLK, nbin), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_S_BLK, _C_BLK, nbin), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_C_BLK, nbin), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nbin), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            cell_spec,
+            cell_spec,
+            pl.BlockSpec(cos_t.shape, lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(sin_t.shape, lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2), lambda i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=[cell_spec] * 4,
+        interpret=interpret,
+    )(ded, disp_base, rot_t, template[None, :], weights, cell_mask,
+      cos_t, sin_t, tt_info)
+    return tuple(o[:nsub, :nchan] for o in outs)
+
+
+def cell_diagnostics_pallas(ded, disp_base, rot_t, template, weights,
+                            cell_mask):
+    """Fused fit + residual + diagnostics (float32, TPU; interpreted
+    elsewhere).  Returns (d_std, d_mean, d_ptp, d_fft), each (nsub, nchan),
+    with the same masked-cell patches as the XLA path
+    (:func:`masked_jax.surgical_scores_jax`) and DFT-flavoured rFFT
+    magnitudes (:func:`masked_jax.rfft_magnitudes` mode='dft')."""
+    if ded.dtype != jnp.float32:
+        raise TypeError("cell_diagnostics_pallas requires float32, got %s"
+                        % ded.dtype)
+    nbin = ded.shape[-1]
+    if nbin > FUSED_STATS_MAX_NBIN:
+        raise ValueError(
+            f"cell_diagnostics_pallas supports nbin <= {FUSED_STATS_MAX_NBIN} "
+            f"(VMEM budget), got {nbin}; use stats_impl='xla' (or 'auto', "
+            "which checks this)")
+    nk = nbin // 2 + 1
+    pad_k = (-nk) % 128  # zero columns: magnitude 0, never the max
+    b = jnp.arange(nbin, dtype=jnp.float32)
+    k = jnp.arange(nk, dtype=jnp.float32)
+    ang = (-2.0 * np.pi / nbin) * jnp.outer(b, k)
+    cos_t = jnp.pad(jnp.cos(ang), ((0, 0), (0, pad_k)))
+    sin_t = jnp.pad(jnp.sin(ang), ((0, 0), (0, pad_k)))
+    tt = jnp.sum(template * template)
+    tt_info = jnp.stack(
+        [jnp.where(tt == 0, jnp.float32(1.0), tt),
+         (tt == 0).astype(jnp.float32)]
+    )[None, :]
+    interpret = jax.devices()[0].platform != "tpu"
+    return _cell_stats_call(ded, disp_base, rot_t, template, tt_info,
+                            weights.astype(jnp.float32),
+                            cell_mask, cos_t, sin_t, interpret)
+
+
 def masked_median_pallas(values, mask, axis):
     """Drop-in for :func:`masked_jax.masked_median` (keepdims semantics),
     float32 only.  axis 0 reduces down subints (channel scaler), axis 1 down

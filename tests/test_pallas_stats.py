@@ -115,3 +115,96 @@ def test_full_clean_parity_sort_vs_pallas():
                                   res["pallas"].final_weights)
     np.testing.assert_array_equal(res["sort"].scores, res["pallas"].scores)
     assert res["sort"].loops == res["pallas"].loops
+
+
+class TestFusedCellDiagnostics:
+    """The fused Pallas diagnostics kernel vs the XLA path: same masked-cell
+    patches, near-identical floats (MXU DFT vs jnp reductions), and —
+    through the engine — identical final masks."""
+
+    def _setup(self, nsub=12, nchan=20, nbin=32, seed=5):
+        from iterative_cleaner_tpu.engine.loop import (
+            dispersed_residual_base, prepare_cube_jax)
+        from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+        ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                       n_prezapped=7, seed=seed,
+                                       dtype=np.float64)
+        cube = jnp.asarray(ar.total_intensity(), dtype=jnp.float32)
+        weights = jnp.asarray(ar.weights, dtype=jnp.float32)
+        freqs = jnp.asarray(ar.freqs_mhz, dtype=jnp.float32)
+        ded, shifts = prepare_cube_jax(
+            cube, freqs, ar.dm, ar.centre_freq_mhz, ar.period_s,
+            baseline_duty=0.15, rotation="fourier")
+        base = dispersed_residual_base(
+            ded, shifts, pulse_slice=(0, 0), pulse_scale=1.0,
+            pulse_active=False, rotation="fourier")
+        return ded, base, weights, shifts
+
+    def test_fused_matches_xla_diagnostics(self):
+        from iterative_cleaner_tpu.ops.dsp import (
+            fit_template_amplitudes, rotate_bins, weighted_template)
+        from iterative_cleaner_tpu.stats.masked_jax import cell_diagnostics_jax
+        from iterative_cleaner_tpu.stats.pallas_kernels import (
+            cell_diagnostics_pallas)
+
+        ded, base, weights, shifts = self._setup()
+        nchan, nbin = ded.shape[1], ded.shape[2]
+        cell_mask = weights == 0
+        template = weighted_template(ded, weights, jnp) * 10000.0
+        rot_t = rotate_bins(jnp.broadcast_to(template, (nchan, nbin)), shifts,
+                            jnp, method="fourier")
+        amps = fit_template_amplitudes(ded, template, jnp)
+        weighted = (amps[:, :, None] * rot_t[None] - base) * weights[:, :, None]
+        want = cell_diagnostics_jax(weighted, cell_mask, fft_mode="dft")
+        got = cell_diagnostics_pallas(ded, base, rot_t, template, weights,
+                                      cell_mask)
+        for g, w, name in zip(got, want, ("std", "mean", "ptp", "fft")):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-5, atol=2e-4, err_msg=name)
+        # masked-cell patches exact
+        m = np.asarray(cell_mask)
+        assert (np.asarray(got[0])[m] == 0).all()
+        assert (np.asarray(got[1])[m] == 0).all()
+        assert (np.asarray(got[2])[m] == np.float32(1e20)).all()
+
+    def test_fused_engine_masks_match_xla_engine(self):
+        from iterative_cleaner_tpu.engine.loop import clean_dedispersed_jax
+
+        ded, base, weights, shifts = self._setup(nsub=16, nchan=24, nbin=64)
+        kw = dict(max_iter=4, chanthresh=5.0, subintthresh=5.0,
+                  pulse_slice=(0, 0), pulse_scale=1.0, pulse_active=False,
+                  rotation="fourier", fft_mode="dft", median_impl="sort")
+        a = clean_dedispersed_jax(ded, weights, shifts, stats_impl="xla", **kw)
+        b = clean_dedispersed_jax(ded, weights, shifts, stats_impl="fused",
+                                  **kw)
+        np.testing.assert_array_equal(np.asarray(a.final_weights),
+                                      np.asarray(b.final_weights))
+        assert int(a.loops) == int(b.loops)
+
+    def test_fused_rejects_float64(self):
+        from iterative_cleaner_tpu.stats.pallas_kernels import (
+            cell_diagnostics_pallas)
+
+        x = jnp.zeros((4, 4, 8), dtype=jnp.float64)
+        w = jnp.ones((4, 4), dtype=jnp.float64)
+        with pytest.raises(TypeError):
+            cell_diagnostics_pallas(x, x, jnp.zeros((4, 8)), jnp.zeros(8), w,
+                                    w == 0)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_plain_median_pallas_matches_jnp_median(axis):
+    """scale_lines_plain's pallas routing: bit-identical to jnp.median,
+    including NaN propagation and +-inf ordering."""
+    from iterative_cleaner_tpu.stats.masked_jax import _plain_median
+
+    rng = np.random.default_rng(3)
+    v = rng.standard_normal((33, 18)).astype(np.float32)
+    v[0, 0] = np.nan
+    v[1, 1] = np.inf
+    v[2, 2] = -np.inf
+    v[3, :] = 2.5  # exact ties
+    a = np.asarray(_plain_median(jnp.asarray(v), axis, "pallas"))
+    b = np.asarray(_plain_median(jnp.asarray(v), axis, "sort"))
+    np.testing.assert_array_equal(a, b)
