@@ -46,7 +46,7 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=3):
         n_rfi_cells=max(8, nsub * nchan // 2048),
         n_rfi_channels=max(1, nchan // 512),
         n_rfi_subints=max(1, nsub // 512),
-        seed=0, dtype=np.float32,
+        seed=0, dtype=np.float32, disperse=False,
     )
     median_impl = resolve_median_impl("auto", jnp.float32)
     fft_mode = resolve_fft_mode("auto", jnp.float32)

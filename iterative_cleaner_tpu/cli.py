@@ -106,6 +106,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "archive and continue with the rest instead of "
                              "aborting the batch (exit code 1 if any "
                              "failed).")
+    parser.add_argument("--prefetch", type=int, default=0, metavar="N",
+                        help="Pipeline batch runs: load up to N archives "
+                             "ahead on a background thread while the device "
+                             "cleans the current one (costs N extra "
+                             "archives of host RAM; 0 = sequential).")
     return parser
 
 
@@ -143,13 +148,19 @@ def output_name(ar, args: argparse.Namespace, in_path: str) -> str:
 
 
 def clean_one(in_path: str, args: argparse.Namespace,
-              timer=None) -> str:
-    """Load, clean, and write one archive; returns the output path."""
+              timer=None, preloaded=None) -> str:
+    """Load (unless ``preloaded``), clean, and write one archive; returns
+    the output path."""
     from iterative_cleaner_tpu.utils.tracing import PhaseTimer
 
     timer = timer if timer is not None else PhaseTimer()
     with timer.phase("load"):
-        ar = ar_io.load_archive(in_path)
+        if preloaded is None:
+            ar = ar_io.load_archive(in_path)
+        elif hasattr(preloaded, "result"):  # a prefetch future: the phase
+            ar = preloaded.result()         # measures the stall, not the IO
+        else:
+            ar = preloaded
     cfg = config_from_args(args)
     ar_name = ar.display_name() or os.path.basename(in_path)
 
@@ -233,15 +244,50 @@ def clean_one(in_path: str, args: argparse.Namespace,
     return o_name
 
 
+def _iter_archives(paths, prefetch: int):
+    """Yield (path, load_future_or_None) pairs; with ``prefetch`` > 0 a
+    background thread stays up to that many loads ahead of the consumer
+    (host IO overlaps device compute).  The consumer resolves the future
+    inside its 'load' timing phase, so --timing reports the pipeline stall
+    actually paid; load errors raise at the failing archive's turn,
+    preserving sequential semantics for --keep_going."""
+    if prefetch <= 0 or len(paths) < 2:
+        for p in paths:
+            yield p, None
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        pending = [(p, pool.submit(ar_io.load_archive, p))
+                   for p in paths[: prefetch + 1]]
+        next_i = len(pending)
+        while pending:
+            yield pending.pop(0)
+            if next_i < len(paths):
+                pending.append(
+                    (paths[next_i], pool.submit(ar_io.load_archive,
+                                                paths[next_i])))
+                next_i += 1
+
+
 def main(argv=None) -> int:
     args = parse_arguments(argv)
+    # ICLEAN_PLATFORM=cpu forces the jax platform before any backend
+    # initialises — the escape hatch when the default device is absent or
+    # unreachable (a sitecustomize-pinned TPU tunnel ignores JAX_PLATFORMS).
+    platform = os.environ.get("ICLEAN_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     from iterative_cleaner_tpu.utils.tracing import device_trace
 
     failed = []
     with device_trace(args.trace):
-        for in_path in args.archive:
+        for in_path, preloaded in _iter_archives(list(args.archive),
+                                                 args.prefetch):
             try:
-                clean_one(in_path, args)
+                clean_one(in_path, args, preloaded=preloaded)
             except Exception as exc:  # per-archive isolation (--keep_going)
                 if not args.keep_going:
                     raise

@@ -47,7 +47,10 @@ def _use_matmul_rotation(x, shift_bins, xp):
     if xp is np or xp.ndim(shift_bins) > 1 or x.ndim < 2:
         return False
     nchan, nbin = x.shape[-2], x.shape[-1]
-    return nchan * nbin * nbin <= _ROT_MATMUL_MAX_ELEMS
+    # bound both the (nchan, nbin, nbin) operator tensor and the fourier
+    # path's (nbin//2+1, nbin, nbin) cos/sin tables
+    table = (nbin // 2 + 1) * nbin * nbin
+    return max(nchan * nbin * nbin, table) <= _ROT_MATMUL_MAX_ELEMS
 
 
 def rotate_bins(x, shift_bins, xp, method="fourier"):
@@ -100,8 +103,6 @@ def rotate_bins(x, shift_bins, xp, method="fourier"):
         # equivalent matmul at pulse-profile sizes (nbin <= a few hundred).
         import jax
 
-        cdtype = ("complex64" if np.dtype(x.dtype) == np.float32
-                  else "complex128")
         s_chan = xp.broadcast_to(
             xp.asarray(shift_bins, dtype=x.dtype), x.shape[-2:-1]
         )
@@ -109,12 +110,23 @@ def rotate_bins(x, shift_bins, xp, method="fourier"):
         b = xp.arange(nbin, dtype=x.dtype)
         # irfft reconstruction weights: DC and (even-n) Nyquist count once
         w = xp.where((k == 0) | (k == nbin // 2) & (nbin % 2 == 0), 1.0, 2.0)
-        W = xp.exp((-2j * np.pi / nbin) * xp.outer(kf, b)).astype(cdtype)
-        V = (w / nbin) * xp.exp(
-            (2j * np.pi / nbin) * xp.outer(b, kf)
-        ).astype(cdtype)
-        phase = xp.exp((-2j * np.pi / nbin) * xp.outer(s_chan, kf)).astype(cdtype)
-        rot = xp.einsum("ik,ck,kb->cbi", V, phase, W).real.astype(x.dtype)
+        # R_c[b, i] = (1/n) sum_k w_k cos(2*pi*k*(i - b - s_c)/n), expanded
+        # via cos(a - t) = cos a cos t + sin a sin t into two small real
+        # einsums against static (k, b, i) tables — all-real MXU work, much
+        # cheaper than the equivalent complex V @ diag(phase) @ W product
+        alpha = (2.0 * np.pi / nbin) * kf[:, None, None] * (
+            b[None, None, :] - b[None, :, None]  # (k, b, i): i - b
+        )
+        wk = (w / nbin).astype(x.dtype)[:, None, None]
+        cos_tab = (wk * xp.cos(alpha)).astype(x.dtype)
+        sin_tab = (wk * xp.sin(alpha)).astype(x.dtype)
+        theta = (2.0 * np.pi / nbin) * xp.outer(s_chan, kf)
+        rot = (
+            xp.einsum("kbi,ck->cbi", cos_tab, xp.cos(theta).astype(x.dtype),
+                      precision=jax.lax.Precision.HIGHEST)
+            + xp.einsum("kbi,ck->cbi", sin_tab, xp.sin(theta).astype(x.dtype),
+                        precision=jax.lax.Precision.HIGHEST)
+        )
         return xp.einsum("...cb,cbi->...ci", x, rot,
                          precision=jax.lax.Precision.HIGHEST)
     spec = xp.fft.rfft(x, axis=-1)
@@ -153,6 +165,19 @@ def baseline_offsets(profiles, xp, duty=0.15):
     """
     nbin = profiles.shape[-1]
     w = max(1, int(round(duty * nbin)))
+    if xp is not np and nbin <= 1024:
+        import jax
+
+        # TPU path: circular window sums as one 0/1 circulant matmul —
+        # lax.cumsum lowers to a sequential scan on TPU (~30x slower than
+        # this single MXU pass at profile sizes)
+        j = xp.arange(nbin)
+        box = (((j[:, None] - j[None, :]) % nbin) < w).astype(profiles.dtype)
+        win_sums = jax.lax.dot_general(
+            profiles, box, (((profiles.ndim - 1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return xp.min(win_sums, axis=-1) / w
     ext = xp.concatenate([profiles, profiles[..., : w - 1]], axis=-1) if w > 1 else profiles
     cs = xp.cumsum(ext, axis=-1)
     zero = xp.zeros_like(cs[..., :1])

@@ -119,3 +119,31 @@ def test_backend_registry():
     assert get_backend("jax").__name__.endswith("jax_backend")
     with pytest.raises(ValueError):
         get_backend("torch")
+
+
+def test_resolve_stats_impl_guards():
+    """'auto' must fall back to xla off-TPU, for big nbin, and for fft mode;
+    explicit choices pass through."""
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fft_mode, resolve_stats_impl)
+    from iterative_cleaner_tpu.stats.pallas_kernels import FUSED_STATS_MAX_NBIN
+
+    # CPU test devices: auto never picks the TPU kernels
+    assert resolve_fft_mode("auto", jnp.float32) == "fft"
+    assert resolve_stats_impl("auto", jnp.float32, 128, "dft") == "xla"
+    assert resolve_stats_impl("xla", jnp.float32, 128, "dft") == "xla"
+    assert resolve_stats_impl("fused", jnp.float32, 128, "dft") == "fused"
+    # the nbin guard applies regardless of platform
+    big = FUSED_STATS_MAX_NBIN + 1
+    assert resolve_stats_impl("auto", jnp.float32, big, "dft") == "xla"
+
+
+def test_config_rejects_fused_with_fft():
+    from iterative_cleaner_tpu.config import CleanConfig
+
+    with pytest.raises(ValueError, match="fused"):
+        CleanConfig(stats_impl="fused", fft_mode="fft")
+    CleanConfig(stats_impl="fused", fft_mode="dft")  # ok
+    CleanConfig(stats_impl="fused")                  # auto fft: ok

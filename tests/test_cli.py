@@ -117,3 +117,49 @@ def test_weights_written_back(archive_file, tmp_path, monkeypatch):
     # data unchanged, weights zapped somewhere
     np.testing.assert_allclose(cleaned.data, original.data, rtol=1e-6)
     assert (cleaned.weights == 0).sum() > 0
+
+
+def test_prefetch_matches_sequential(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    paths = []
+    for i in range(3):
+        ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=i)
+        p = tmp_path / f"obs{i}.npz"
+        save_archive(ar, str(p))
+        paths.append(str(p))
+    assert main(["-q", "-l", "--prefetch", "2"] + paths) == 0
+    pre = [np.asarray(load_archive(p + "_cleaned.npz").weights) for p in paths]
+    for p in paths:
+        os.remove(p + "_cleaned.npz")
+    assert main(["-q", "-l"] + paths) == 0
+    seq = [np.asarray(load_archive(p + "_cleaned.npz").weights) for p in paths]
+    for a, b in zip(pre, seq):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_prefetch_keep_going_isolates_bad_archive(tmp_path, monkeypatch,
+                                                  capsys):
+    monkeypatch.chdir(tmp_path)
+    ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=0)
+    good1, good2 = str(tmp_path / "a.npz"), str(tmp_path / "c.npz")
+    save_archive(ar, good1)
+    save_archive(ar, good2)
+    bad = str(tmp_path / "b.npz")
+    with open(bad, "wb") as f:
+        f.write(b"not an archive")
+    rc = main(["-q", "-l", "--prefetch", "1", "--keep_going",
+               good1, bad, good2])
+    assert rc == 1
+    assert os.path.exists(good1 + "_cleaned.npz")
+    assert os.path.exists(good2 + "_cleaned.npz")
+    assert "ERROR cleaning" in capsys.readouterr().err
+
+
+def test_platform_env_override(tmp_path, monkeypatch):
+    """ICLEAN_PLATFORM forces the jax platform (no-op here since conftest
+    already pinned cpu, but the path must parse and clean successfully)."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("ICLEAN_PLATFORM", "cpu")
+    ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=0)
+    save_archive(ar, str(tmp_path / "o.npz"))
+    assert main(["-q", "-l", str(tmp_path / "o.npz")]) == 0
