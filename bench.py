@@ -29,6 +29,21 @@ def _log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def _arm_watchdog(seconds: float):
+    """Hard-exit if the bench wedges (e.g. an unreachable device tunnel
+    blocks inside PJRT init, which no Python signal can interrupt)."""
+    import threading
+
+    def boom():
+        _log(f"bench watchdog: no result after {seconds:.0f}s, aborting")
+        os._exit(3)
+
+    t = threading.Timer(seconds, boom)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=3):
     import jax
     import jax.numpy as jnp
@@ -114,6 +129,7 @@ def bench_numpy(nsub, nchan, nbin, max_iter=5):
 
 
 def main():
+    watchdog = _arm_watchdog(float(os.environ.get("BENCH_TIMEOUT", "1800")))
     small = os.environ.get("BENCH_SMALL") == "1"
     if small:
         jax_cfg = (64, 128, 64)
@@ -135,6 +151,7 @@ def main():
     if jax_rate is None:
         raise SystemExit("all jax bench configs failed")
 
+    watchdog.cancel()
     print(json.dumps({
         "metric": "cells_cleaned_per_sec_%dx%d" % (jax_cfg[0], jax_cfg[1]),
         "value": round(jax_rate, 1),

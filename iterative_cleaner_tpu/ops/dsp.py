@@ -6,8 +6,11 @@ These replace the in-loop PSRCHIVE C++ ops the reference leans on
 ``/root/reference/iterative_cleaner.py:89-93,98-100,104``) and the per-cell
 MINPACK fit (``scipy.optimize.leastsq`` at reference :278).  PSRCHIVE itself
 is not a dependency; the framework defines its own (documented) semantics for
-these ops and uses them identically in the numpy oracle and the JAX engine,
-so cross-backend mask parity is exact by construction.
+these ops and uses the same algorithms in the numpy oracle and the float64
+JAX engine (backend rounding differs only at ulp scale; final-mask parity is
+what the test suite asserts).  float32 jax paths may additionally swap in
+MXU-matmul forms of the same operators (rotation, window sums) — float32 runs
+are compared to the oracle at final-mask level, never bitwise.
 
 Every function takes an ``xp`` array-module handle (numpy or jax.numpy).  All
 shapes are static and all control flow is trace-friendly, so the same code
@@ -43,14 +46,21 @@ def dispersion_shift_bins(freqs_mhz, dm, ref_freq_mhz, period_s, nbin, xp):
 _ROT_MATMUL_MAX_ELEMS = 2 ** 27
 
 
-def _use_matmul_rotation(x, shift_bins, xp):
+def _use_matmul_rotation(x, shift_bins, xp, method):
     if xp is np or xp.ndim(shift_bins) > 1 or x.ndim < 2:
         return False
     nchan, nbin = x.shape[-2], x.shape[-1]
-    # bound both the (nchan, nbin, nbin) operator tensor and the fourier
-    # path's (nbin//2+1, nbin, nbin) cos/sin tables
-    table = (nbin // 2 + 1) * nbin * nbin
-    return max(nchan * nbin * nbin, table) <= _ROT_MATMUL_MAX_ELEMS
+    elems = nchan * nbin * nbin  # the (nchan, nbin, nbin) operator tensor
+    if method == "fourier":
+        # fourier-only constraints: the (nbin//2+1, nbin, nbin) cos/sin
+        # tables, and float32 only — the rounding differs at ulp level from
+        # the FFT form, and float64 is the oracle-bit-parity mode where both
+        # backends must share one algorithm (the one-hot roll matmul is
+        # bit-exact, so it needs neither restriction)
+        if np.dtype(x.dtype) != np.float32:
+            return False
+        elems = max(elems, (nbin // 2 + 1) * nbin * nbin)
+    return elems <= _ROT_MATMUL_MAX_ELEMS
 
 
 def rotate_bins(x, shift_bins, xp, method="fourier"):
@@ -74,7 +84,7 @@ def rotate_bins(x, shift_bins, xp, method="fourier"):
     shift = xp.asarray(shift_bins)[..., None]  # (..., 1) against the bin axis
     if method == "roll":
         base = xp.arange(nbin)
-        if _use_matmul_rotation(x, shift_bins, xp):
+        if _use_matmul_rotation(x, shift_bins, xp, "roll"):
             # TPU path: a per-channel integer roll is a permutation, and a
             # permutation is a one-hot matmul — exact (0/1 coefficients
             # select single elements) and MXU-shaped, where the equivalent
@@ -95,7 +105,7 @@ def rotate_bins(x, shift_bins, xp, method="fourier"):
     if method != "fourier":
         raise ValueError(f"unknown rotation method {method!r}")
     k = xp.arange(nbin // 2 + 1)
-    if _use_matmul_rotation(x, shift_bins, xp):
+    if _use_matmul_rotation(x, shift_bins, xp, "fourier"):
         # TPU path: irfft(rfft(x) * phase) is linear in x, so the rotation is
         # a per-channel (nbin, nbin) matrix R_c = Re(W^H diag(phase_c) W)/n —
         # built closed-form from the tiny DFT bases (no FFT ops) and applied
@@ -165,12 +175,16 @@ def baseline_offsets(profiles, xp, duty=0.15):
     """
     nbin = profiles.shape[-1]
     w = max(1, int(round(duty * nbin)))
-    if xp is not np and nbin <= 1024:
+    if (xp is not np and nbin <= 1024
+            and np.dtype(profiles.dtype) == np.float32):
         import jax
 
         # TPU path: circular window sums as one 0/1 circulant matmul —
         # lax.cumsum lowers to a sequential scan on TPU (~30x slower than
-        # this single MXU pass at profile sizes)
+        # this single MXU pass at profile sizes).  float32 only: the matmul
+        # rounds differently from the cumsum form at ulp level, and float64
+        # is the oracle-bit-parity mode where both backends must share one
+        # algorithm
         j = xp.arange(nbin)
         box = (((j[:, None] - j[None, :]) % nbin) < w).astype(profiles.dtype)
         win_sums = jax.lax.dot_general(

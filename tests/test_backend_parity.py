@@ -147,3 +147,39 @@ def test_config_rejects_fused_with_fft():
         CleanConfig(stats_impl="fused", fft_mode="fft")
     CleanConfig(stats_impl="fused", fft_mode="dft")  # ok
     CleanConfig(stats_impl="fused")                  # auto fft: ok
+
+
+@pytest.mark.parametrize("trial", range(10))
+def test_randomized_config_mask_parity(trial):
+    """Property sweep: random archive geometry, RFI mix, thresholds, pulse
+    regions and rotation modes — the float64 jax engine must reproduce the
+    oracle's final mask bit-for-bit on every draw."""
+    rng = np.random.default_rng(1000 + trial)
+    nsub = int(rng.integers(4, 24))
+    nchan = int(rng.integers(6, 40))
+    nbin = int(rng.choice([16, 32, 64, 128]))
+    ar, _ = make_synthetic_archive(
+        nsub=nsub, nchan=nchan, nbin=nbin,
+        n_rfi_cells=int(rng.integers(0, 6)),
+        n_rfi_channels=int(rng.integers(0, 3)),
+        n_rfi_subints=int(rng.integers(0, 2)),
+        n_prezapped=int(rng.integers(0, nsub * nchan // 4)),
+        rfi_strength=float(rng.uniform(15, 80)),
+        pulse_snr=float(rng.uniform(5, 60)),
+        seed=int(rng.integers(0, 2**31)),
+    )
+    pulse_region = (0.0, 0.0, 1.0)
+    if rng.random() < 0.4:
+        a, b = sorted(rng.integers(0, nbin, size=2).tolist())
+        pulse_region = (float(rng.uniform(0, 1)), float(a), float(b))
+    cfg = dict(
+        chanthresh=float(rng.uniform(3, 8)),
+        subintthresh=float(rng.uniform(3, 8)),
+        max_iter=int(rng.integers(1, 6)),
+        pulse_region=pulse_region,
+        rotation=str(rng.choice(["fourier", "roll"])),
+        dtype="float64",
+    )
+    res_np, res_jx = _run_both(ar, **cfg)
+    np.testing.assert_array_equal(res_np.zap_mask(), res_jx.zap_mask())
+    assert res_np.loops == res_jx.loops

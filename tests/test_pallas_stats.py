@@ -208,3 +208,81 @@ def test_plain_median_pallas_matches_jnp_median(axis):
     a = np.asarray(_plain_median(jnp.asarray(v), axis, "pallas"))
     b = np.asarray(_plain_median(jnp.asarray(v), axis, "sort"))
     np.testing.assert_array_equal(a, b)
+
+
+class TestFusedAdversarial:
+    """Fused kernel vs XLA diagnostics on hostile inputs."""
+
+    def _diag_pair(self, ded, base, weights, shifts):
+        from iterative_cleaner_tpu.ops.dsp import (
+            fit_template_amplitudes, rotate_bins, weighted_template)
+        from iterative_cleaner_tpu.stats.masked_jax import cell_diagnostics_jax
+        from iterative_cleaner_tpu.stats.pallas_kernels import (
+            cell_diagnostics_pallas)
+
+        nchan, nbin = ded.shape[1], ded.shape[2]
+        cell_mask = weights == 0
+        template = weighted_template(ded, weights, jnp) * 10000.0
+        rot_t = rotate_bins(jnp.broadcast_to(template, (nchan, nbin)), shifts,
+                            jnp, method="roll")
+        amps = fit_template_amplitudes(ded, template, jnp)
+        weighted = (amps[:, :, None] * rot_t[None] - base) * weights[:, :, None]
+        want = cell_diagnostics_jax(weighted, cell_mask, fft_mode="dft")
+        got = cell_diagnostics_pallas(ded, base, rot_t, template, weights,
+                                      cell_mask)
+        return got, want
+
+    def test_constant_rows_and_zero_template(self):
+        # all-constant data -> zero-variance cells; zero template -> amp=1
+        ded = jnp.full((8, 8, 16), 3.0, dtype=jnp.float32)
+        base = ded
+        w = jnp.ones((8, 8), dtype=jnp.float32)
+        shifts = jnp.zeros(8, dtype=jnp.float32)
+        got, want = self._diag_pair(ded * 0.0, base * 0.0, w, shifts)
+        for g, x in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(x),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_nan_and_inf_cells_propagate_like_xla(self):
+        rng = np.random.default_rng(9)
+        d = rng.normal(size=(8, 8, 16)).astype(np.float32)
+        d[0, 0, 3] = np.nan
+        d[1, 2, :] = np.inf
+        d[2, 3, 5] = -np.inf
+        ded = jnp.asarray(d)
+        base = jnp.asarray(rng.normal(size=(8, 8, 16)).astype(np.float32))
+        w = jnp.ones((8, 8), dtype=jnp.float32)
+        w = w.at[4, 4].set(0.0)  # masked cell
+        shifts = jnp.asarray(rng.integers(-5, 5, size=8).astype(np.float32))
+        got, want = self._diag_pair(ded, base, w, shifts)
+        for g, x, name in zip(got, want, ("std", "mean", "ptp", "fft")):
+            g, x = np.asarray(g), np.asarray(x)
+            np.testing.assert_array_equal(np.isnan(g), np.isnan(x),
+                                          err_msg=name)
+            np.testing.assert_array_equal(np.isinf(g), np.isinf(x),
+                                          err_msg=name)
+            fin = np.isfinite(x)
+            np.testing.assert_allclose(g[fin], x[fin], rtol=1e-4, atol=1e-4,
+                                       err_msg=name)
+
+    def test_pulse_window_active_engine_parity(self):
+        from iterative_cleaner_tpu.engine.loop import clean_dedispersed_jax
+        from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+        from iterative_cleaner_tpu.engine.loop import prepare_cube_jax
+
+        ar, _ = make_synthetic_archive(nsub=10, nchan=12, nbin=64, seed=11,
+                                       dtype=np.float64)
+        cube = jnp.asarray(ar.total_intensity(), dtype=jnp.float32)
+        weights = jnp.asarray(ar.weights, dtype=jnp.float32)
+        freqs = jnp.asarray(ar.freqs_mhz, dtype=jnp.float32)
+        ded, shifts = prepare_cube_jax(
+            cube, freqs, ar.dm, ar.centre_freq_mhz, ar.period_s,
+            baseline_duty=0.15, rotation="fourier")
+        kw = dict(max_iter=3, chanthresh=5.0, subintthresh=5.0,
+                  pulse_slice=(10, 30), pulse_scale=0.25, pulse_active=True,
+                  rotation="fourier", fft_mode="dft", median_impl="sort")
+        a = clean_dedispersed_jax(ded, weights, shifts, stats_impl="xla", **kw)
+        b = clean_dedispersed_jax(ded, weights, shifts, stats_impl="fused",
+                                  **kw)
+        np.testing.assert_array_equal(np.asarray(a.final_weights),
+                                      np.asarray(b.final_weights))
