@@ -111,6 +111,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "ahead on a background thread while the device "
                              "cleans the current one (costs N extra "
                              "archives of host RAM; 0 = sequential).")
+    parser.add_argument("--batch", type=int, default=0, metavar="B",
+                        help="Clean runs of up to B consecutive "
+                             "equal-shaped archives in one compiled vmap "
+                             "program (amortises compile and dispatch for "
+                             "many small archives). Incompatible with "
+                             "--unload_res and --checkpoint.")
     return parser
 
 
@@ -148,9 +154,10 @@ def output_name(ar, args: argparse.Namespace, in_path: str) -> str:
 
 
 def clean_one(in_path: str, args: argparse.Namespace,
-              timer=None, preloaded=None) -> str:
-    """Load (unless ``preloaded``), clean, and write one archive; returns
-    the output path."""
+              timer=None, preloaded=None, result=None) -> str:
+    """Load (unless ``preloaded``), clean (unless ``result`` is a
+    precomputed CleanResult, e.g. from the batched path), and write one
+    archive; returns the output path."""
     from iterative_cleaner_tpu.utils.tracing import PhaseTimer
 
     timer = timer if timer is not None else PhaseTimer()
@@ -167,9 +174,8 @@ def clean_one(in_path: str, args: argparse.Namespace,
     if not args.quiet:
         print("Total number of profiles: %s" % ar.weights.size)
 
-    result = None
     resumed = False
-    if args.checkpoint:
+    if result is None and args.checkpoint:
         from iterative_cleaner_tpu.utils import checkpoint as ckpt
 
         result = ckpt.load_matching_checkpoint(args.checkpoint, in_path, ar,
@@ -270,6 +276,61 @@ def _iter_archives(paths, prefetch: int):
                 next_i += 1
 
 
+def _run_batched(args) -> list:
+    """--batch driver: group consecutive equal-shaped archives and clean
+    each group in one compiled vmap program; per-archive outputs, console
+    lines and logs are identical to the sequential path."""
+    from iterative_cleaner_tpu.parallel.batch import clean_archives_batched
+
+    cfg = config_from_args(args)
+    paths = list(args.archive)
+    failed = []
+
+    def record_failure(bad_paths, exc):
+        if not args.keep_going:
+            raise exc
+        failed.extend(bad_paths)
+        print("ERROR cleaning %s: %s: %s"
+              % (", ".join(bad_paths), type(exc).__name__, exc),
+              file=sys.stderr)
+
+    i = 0
+    carried = None  # (path, archive) that ended the previous group
+    while i < len(paths) or carried:
+        group, ars = [], []
+        if carried:
+            group.append(carried[0])
+            ars.append(carried[1])
+            carried = None
+        while i < len(paths) and len(group) < args.batch:
+            p = paths[i]
+            i += 1
+            try:
+                ar = ar_io.load_archive(p)
+            except Exception as exc:
+                record_failure([p], exc)
+                continue
+            if ars and (ar.nsub, ar.nchan, ar.nbin) != (
+                    ars[0].nsub, ars[0].nchan, ars[0].nbin):
+                carried = (p, ar)  # seeds the next group, not reloaded
+                break
+            group.append(p)
+            ars.append(ar)
+        if not group:
+            continue
+        try:
+            results = clean_archives_batched(ars, cfg)
+        except Exception as exc:
+            record_failure(group, exc)
+            continue
+        for p, ar, res in zip(group, ars, results):
+            try:
+                clean_one(p, args, preloaded=ar, result=res)
+            except Exception as exc:
+                record_failure([p], exc)
+    return failed
+
+
 def main(argv=None) -> int:
     args = parse_arguments(argv)
     # ICLEAN_PLATFORM=cpu forces the jax platform before any backend
@@ -282,7 +343,24 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", platform)
     from iterative_cleaner_tpu.utils.tracing import device_trace
 
+    if args.batch > 1 and (args.unload_res or args.checkpoint
+                           or args.backend != "jax"
+                           or args.stats_impl == "fused"):
+        build_parser().error(
+            "--batch is incompatible with --unload_res/--checkpoint, "
+            "requires --backend jax, and uses the vmap (xla) stats path")
+
     failed = []
+    if args.batch > 1:
+        with device_trace(args.trace):
+            failed = _run_batched(args)
+        if failed:
+            print("Failed %d/%d archives: %s"
+                  % (len(failed), len(args.archive), ", ".join(failed)),
+                  file=sys.stderr)
+            return 1
+        return 0
+
     with device_trace(args.trace):
         for in_path, preloaded in _iter_archives(list(args.archive),
                                                  args.prefetch):

@@ -163,3 +163,38 @@ def test_platform_env_override(tmp_path, monkeypatch):
     ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=0)
     save_archive(ar, str(tmp_path / "o.npz"))
     assert main(["-q", "-l", str(tmp_path / "o.npz")]) == 0
+
+
+def test_batch_matches_sequential(tmp_path, monkeypatch):
+    """--batch groups equal-shaped runs; masks must equal the sequential
+    path even across a shape change mid-list."""
+    monkeypatch.chdir(tmp_path)
+    paths = []
+    for i in range(3):  # same shape
+        ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=i)
+        p = str(tmp_path / f"s{i}.npz")
+        save_archive(ar, p)
+        paths.append(p)
+    ar, _ = make_synthetic_archive(nsub=8, nchan=12, nbin=32, seed=7)
+    p = str(tmp_path / "big.npz")
+    save_archive(ar, p)
+    paths.append(p)
+    assert main(["-q", "-l", "--batch", "2"] + paths) == 0
+    batched = [np.asarray(load_archive(p + "_cleaned.npz").weights)
+               for p in paths]
+    for p in paths:
+        os.remove(p + "_cleaned.npz")
+    assert main(["-q", "-l"] + paths) == 0
+    for p, b in zip(paths, batched):
+        np.testing.assert_array_equal(
+            b, np.asarray(load_archive(p + "_cleaned.npz").weights))
+
+
+def test_batch_incompatible_flags(tmp_path):
+    with pytest.raises(SystemExit):
+        main(["--batch", "2", "-u", str(tmp_path / "x.npz")])
+
+
+def test_batch_rejects_numpy_backend(tmp_path):
+    with pytest.raises(SystemExit):
+        main(["--batch", "2", "--backend", "numpy", str(tmp_path / "x.npz")])
