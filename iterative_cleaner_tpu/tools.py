@@ -1,0 +1,108 @@
+"""Operator utilities: ``python -m iterative_cleaner_tpu.tools <cmd>``.
+
+Small host-side commands around the cleaning pipeline — no reference
+counterpart (the reference ships only the cleaner script); these support the
+framework-only checkpoint/regression workflow (utils/checkpoint.py) and the
+container formats (io/).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _is_checkpoint(path: str) -> bool:
+    import numpy as np
+
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            return "version" in z.files and "final_weights" in z.files
+    except Exception:
+        return False  # not an npz at all (e.g. .icar) -> archive
+
+
+def _load_weights(path: str):
+    """Just the (nsub, nchan) weight matrix — never the data cube (archives
+    can be multi-GB; npz loads lazily per key and .icar by header offset)."""
+    import numpy as np
+
+    if path.endswith(".icar"):
+        from iterative_cleaner_tpu.io import native as icar
+
+        with open(path, "rb") as f:
+            head = f.read(icar._HEADER.size)
+            dims = icar._unpack_header(head)
+            f.seek(icar._HEADER.size + dims["nchan"] * 8)
+            n = dims["nsub"] * dims["nchan"]
+            w = np.frombuffer(f.read(n * 4), dtype="<f4")
+        return w.reshape(dims["nsub"], dims["nchan"])
+    with np.load(path, allow_pickle=False) as z:
+        return z["weights"]
+
+
+def cmd_diff(args) -> int:
+    """Mask regression diff between two checkpoints (or cleaned archives)."""
+    from iterative_cleaner_tpu.utils import checkpoint as ckpt
+
+    if _is_checkpoint(args.a) and _is_checkpoint(args.b):
+        out = ckpt.diff_checkpoints(args.a, args.b)
+    else:
+        out = ckpt.diff_masks(_load_weights(args.a), _load_weights(args.b))
+    print(json.dumps(out))
+    return 1 if out["changed"] else 0
+
+
+def cmd_convert(args) -> int:
+    """Container conversion (.npz <-> .icar; .ar via the psrchive bridge)."""
+    from iterative_cleaner_tpu.io import load_archive, save_archive
+
+    save_archive(load_archive(args.src), args.dst)
+    return 0
+
+
+def cmd_info(args) -> int:
+    """Print an archive's metadata as one JSON object."""
+    from iterative_cleaner_tpu.io import load_archive
+
+    ar = load_archive(args.path)
+    print(json.dumps({
+        "source": ar.source,
+        "nsub": ar.nsub, "npol": ar.npol, "nchan": ar.nchan, "nbin": ar.nbin,
+        "dm": ar.dm, "period_s": ar.period_s,
+        "centre_freq_mhz": ar.centre_freq_mhz,
+        "mjd_start": ar.mjd_start, "mjd_end": ar.mjd_end,
+        "pol_state": ar.pol_state,
+        "rfi_frac": float((ar.weights == 0).mean()),
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="iterative_cleaner_tpu.tools",
+        description="Checkpoint/regression and container utilities")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("diff", help="mask diff of two checkpoints/archives "
+                                    "(exit 1 if masks differ)")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser("convert", help="convert between archive containers")
+    p.add_argument("src")
+    p.add_argument("dst")
+    p.set_defaults(fn=cmd_convert)
+
+    p = sub.add_parser("info", help="print archive metadata as JSON")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_info)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

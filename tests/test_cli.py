@@ -198,3 +198,70 @@ def test_batch_incompatible_flags(tmp_path):
 def test_batch_rejects_numpy_backend(tmp_path):
     with pytest.raises(SystemExit):
         main(["--batch", "2", "--backend", "numpy", str(tmp_path / "x.npz")])
+
+
+def test_batch_keep_going_isolates_bad_archive(tmp_path, monkeypatch,
+                                               capsys):
+    monkeypatch.chdir(tmp_path)
+    good = []
+    for i in range(2):
+        ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=i)
+        p = str(tmp_path / f"g{i}.npz")
+        save_archive(ar, p)
+        good.append(p)
+    bad = str(tmp_path / "bad.npz")
+    with open(bad, "wb") as f:
+        f.write(b"junk")
+    rc = main(["-q", "-l", "--batch", "2", "--keep_going",
+               good[0], bad, good[1]])
+    assert rc == 1
+    for p in good:
+        assert os.path.exists(p + "_cleaned.npz")
+    assert "ERROR cleaning" in capsys.readouterr().err
+
+
+class TestTools:
+    def test_info_and_convert_and_diff(self, tmp_path, monkeypatch, capsys):
+        import json
+
+        from iterative_cleaner_tpu.tools import main as tools_main
+
+        monkeypatch.chdir(tmp_path)
+        ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=0,
+                                       dtype=np.float32)  # .icar stores f32
+        save_archive(ar, "a.npz")
+        assert tools_main(["info", "a.npz"]) == 0
+        meta = json.loads(capsys.readouterr().out)
+        assert (meta["nsub"], meta["nchan"], meta["nbin"]) == (6, 10, 32)
+
+        assert tools_main(["convert", "a.npz", "a.icar"]) == 0
+        b = load_archive("a.icar")
+        np.testing.assert_array_equal(np.asarray(b.data), np.asarray(ar.data))
+
+        # identical masks -> exit 0; after zapping a cell -> exit 1
+        assert tools_main(["diff", "a.npz", "a.icar"]) == 0
+        capsys.readouterr()
+        ar2 = load_archive("a.npz")
+        ar2.weights[0, 0] = 0.0
+        save_archive(ar2, "b.npz")
+        assert tools_main(["diff", "a.npz", "b.npz"]) == 1
+        d = json.loads(capsys.readouterr().out)
+        assert d["changed"] == 1 and d["newly_zapped"] == 1
+
+    def test_diff_checkpoints(self, tmp_path, monkeypatch, capsys):
+        import json
+
+        monkeypatch.chdir(tmp_path)
+        ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=0)
+        save_archive(ar, "o.npz")
+        assert main(["-q", "-l", "--checkpoint", "ck1", "o.npz"]) == 0
+        assert main(["-q", "-l", "-o", "out2.npz", "--checkpoint", "ck2",
+                     "o.npz"]) == 0
+        from iterative_cleaner_tpu.tools import main as tools_main
+        from iterative_cleaner_tpu.utils.checkpoint import checkpoint_path
+
+        rc = tools_main(["diff", checkpoint_path("ck1", "o.npz"),
+                         checkpoint_path("ck2", "o.npz")])
+        assert rc == 0
+        d = json.loads(capsys.readouterr().out)
+        assert d["changed"] == 0 and d["same_input"]
