@@ -163,6 +163,24 @@ def save_icar(ar: Archive, path: str) -> None:
         f.write(data.tobytes())
 
 
+def read_icar_header(path: str) -> dict:
+    """Just the 144-byte header as a dict — no array IO."""
+    with open(path, "rb") as f:
+        return _unpack_header(f.read(_HEADER.size))
+
+
+def read_icar_weights(path: str) -> np.ndarray:
+    """Just the (nsub, nchan) float32 weight matrix — never the data cube.
+    Lives next to the format definition so layout changes update all
+    readers together."""
+    with open(path, "rb") as f:
+        meta = _unpack_header(f.read(_HEADER.size))
+        f.seek(_HEADER.size + meta["nchan"] * 8)
+        n = meta["nsub"] * meta["nchan"]
+        w = np.frombuffer(f.read(n * 4), dtype="<f4")
+    return w.reshape(meta["nsub"], meta["nchan"])
+
+
 def load_icar(path: str) -> Archive:
     if native_available():
         return _load_icar_native(path)

@@ -24,26 +24,23 @@ def _is_checkpoint(path: str) -> bool:
 
 
 def _load_weights(path: str):
-    """Just the (nsub, nchan) weight matrix — never the data cube (archives
-    can be multi-GB; npz loads lazily per key and .icar by header offset)."""
+    """Just the (nsub, nchan) weight matrix of a checkpoint or archive —
+    never the data cube (archives can be multi-GB; npz loads lazily per key
+    and .icar by header offset)."""
     import numpy as np
 
     if path.endswith(".icar"):
-        from iterative_cleaner_tpu.io import native as icar
+        from iterative_cleaner_tpu.io.native import read_icar_weights
 
-        with open(path, "rb") as f:
-            head = f.read(icar._HEADER.size)
-            dims = icar._unpack_header(head)
-            f.seek(icar._HEADER.size + dims["nchan"] * 8)
-            n = dims["nsub"] * dims["nchan"]
-            w = np.frombuffer(f.read(n * 4), dtype="<f4")
-        return w.reshape(dims["nsub"], dims["nchan"])
+        return read_icar_weights(path)
     with np.load(path, allow_pickle=False) as z:
-        return z["weights"]
+        key = "final_weights" if "final_weights" in z.files else "weights"
+        return z[key]
 
 
 def cmd_diff(args) -> int:
-    """Mask regression diff between two checkpoints (or cleaned archives)."""
+    """Mask regression diff between two checkpoints, two cleaned archives,
+    or one of each."""
     from iterative_cleaner_tpu.utils import checkpoint as ckpt
 
     if _is_checkpoint(args.a) and _is_checkpoint(args.b):
@@ -63,19 +60,53 @@ def cmd_convert(args) -> int:
 
 
 def cmd_info(args) -> int:
-    """Print an archive's metadata as one JSON object."""
-    from iterative_cleaner_tpu.io import load_archive
+    """Print an archive's metadata as one JSON object (header + weights
+    only; the data cube is never read)."""
+    import numpy as np
 
-    ar = load_archive(args.path)
-    print(json.dumps({
-        "source": ar.source,
-        "nsub": ar.nsub, "npol": ar.npol, "nchan": ar.nchan, "nbin": ar.nbin,
-        "dm": ar.dm, "period_s": ar.period_s,
-        "centre_freq_mhz": ar.centre_freq_mhz,
-        "mjd_start": ar.mjd_start, "mjd_end": ar.mjd_end,
-        "pol_state": ar.pol_state,
-        "rfi_frac": float((ar.weights == 0).mean()),
-    }))
+    if args.path.endswith(".icar"):
+        from iterative_cleaner_tpu.io.native import (
+            read_icar_header,
+            read_icar_weights,
+        )
+
+        meta = read_icar_header(args.path)
+        weights = read_icar_weights(args.path)
+        info = {
+            "source": meta["source"],
+            "nsub": meta["nsub"], "npol": meta["npol"],
+            "nchan": meta["nchan"], "nbin": meta["nbin"],
+            "dm": meta["dm"], "period_s": meta["period_s"],
+            "centre_freq_mhz": meta["centre_freq_mhz"],
+            "mjd_start": meta["mjd_start"], "mjd_end": meta["mjd_end"],
+            "pol_state": meta["pol_state"],
+        }
+    else:
+        with np.load(args.path, allow_pickle=False) as z:
+            weights = z["weights"]
+            # npz members decompress per key; the cube's dims come from the
+            # zip member's .npy header without decompressing the array
+            import zipfile
+
+            with zipfile.ZipFile(args.path) as zf:
+                with zf.open("data.npy") as f:
+                    version = np.lib.format.read_magic(f)
+                    if version >= (2, 0):
+                        shape, _, _ = np.lib.format.read_array_header_2_0(f)
+                    else:
+                        shape, _, _ = np.lib.format.read_array_header_1_0(f)
+            info = {
+                "source": str(z["source"]),
+                "nsub": int(shape[0]), "npol": int(shape[1]),
+                "nchan": int(shape[2]), "nbin": int(shape[3]),
+                "dm": float(z["dm"]), "period_s": float(z["period_s"]),
+                "centre_freq_mhz": float(z["centre_freq_mhz"]),
+                "mjd_start": float(z["mjd_start"]),
+                "mjd_end": float(z["mjd_end"]),
+                "pol_state": str(z["pol_state"]),
+            }
+    info["rfi_frac"] = float((np.asarray(weights) == 0).mean())
+    print(json.dumps(info))
     return 0
 
 

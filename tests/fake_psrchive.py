@@ -1,0 +1,107 @@
+"""A pure-Python stand-in for the ``psrchive`` Python bindings.
+
+Implements exactly the API surface the framework consumes
+(`iterative_cleaner_tpu/io/psrchive_bridge.py`; the reference's call surface
+is catalogued in SURVEY.md section 2.2), backed by the framework's own
+``.npz`` container so bridge tests run without PSRCHIVE installed
+(SURVEY.md section 4, "fake-archive backend").
+
+Install with ``sys.modules["psrchive"] = fake_psrchive`` (see
+tests/test_psrchive_bridge.py).
+"""
+
+import numpy as np
+
+from iterative_cleaner_tpu.io import load_archive, save_archive
+
+
+class _Epoch:
+    def __init__(self, mjd):
+        self._mjd = float(mjd)
+
+    def in_days(self):
+        return self._mjd
+
+    def strtempo(self):
+        return "%.6f" % self._mjd
+
+
+class _Integration:
+    def __init__(self, owner, isub):
+        self._owner = owner
+        self._isub = isub
+
+    def get_centre_frequency(self, ichan):
+        return float(self._owner._ar.freqs_mhz[ichan])
+
+    def get_folding_period(self):
+        return float(self._owner._ar.period_s)
+
+    def set_weight(self, ichan, w):
+        self._owner._ar.weights[self._isub, ichan] = w
+
+
+class FakeArchive:
+    def __init__(self, ar, path=""):
+        self._ar = ar
+        self._path = path
+
+    # --- geometry / data ---
+    def get_nsubint(self):
+        return self._ar.nsub
+
+    def get_npol(self):
+        return self._ar.npol
+
+    def get_nchan(self):
+        return self._ar.nchan
+
+    def get_nbin(self):
+        return self._ar.nbin
+
+    def get_data(self):
+        return np.asarray(self._ar.data)
+
+    def get_weights(self):
+        return np.asarray(self._ar.weights)
+
+    def get_Integration(self, isub):
+        return _Integration(self, int(isub))
+
+    # --- metadata ---
+    def get_dispersion_measure(self):
+        return self._ar.dm
+
+    def get_centre_frequency(self):
+        return self._ar.centre_freq_mhz
+
+    def get_source(self):
+        return self._ar.source
+
+    def get_state(self):
+        return self._ar.pol_state
+
+    def get_dedispersed(self):
+        return self._ar.dedispersed
+
+    def get_filename(self):
+        return self._path
+
+    def start_time(self):
+        return _Epoch(self._ar.mjd_start)
+
+    def end_time(self):
+        return _Epoch(self._ar.mjd_end)
+
+    # --- lifecycle ---
+    def clone(self):
+        import copy
+
+        return FakeArchive(copy.deepcopy(self._ar), self._path)
+
+    def unload(self, path):
+        save_archive(self._ar, path)
+
+
+def Archive_load(path):
+    return FakeArchive(load_archive(path), path)
