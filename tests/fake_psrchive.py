@@ -1,10 +1,18 @@
 """A pure-Python stand-in for the ``psrchive`` Python bindings.
 
-Implements exactly the API surface the framework consumes
-(`iterative_cleaner_tpu/io/psrchive_bridge.py`; the reference's call surface
-is catalogued in SURVEY.md section 2.2), backed by the framework's own
-``.npz`` container so bridge tests run without PSRCHIVE installed
-(SURVEY.md section 4, "fake-archive backend").
+Implements the full API surface the reference consumes (catalogued in
+SURVEY.md section 2.2: the bridge getters plus the in-loop DSP ops
+``pscrunch``/``remove_baseline``/``dedisperse``/``dededisperse``/
+``fscrunch``/``tscrunch``/``get_Profile``), backed by the framework's own
+Archive model and DSP operators, so both the bridge tests and the upstream
+differential tests (tests/test_upstream_differential.py) run without
+PSRCHIVE installed (SURVEY.md section 4, "fake-archive backend").
+
+The DSP methods share ``iterative_cleaner_tpu.ops.dsp`` — by construction
+the fake's baseline/dedispersion/scrunch semantics are the framework's
+documented ones, so a differential run of the upstream script against this
+fake isolates everything *else* the framework re-implements (fit, stats,
+weights, convergence).
 
 Install with ``sys.modules["psrchive"] = fake_psrchive`` (see
 tests/test_psrchive_bridge.py).
@@ -13,6 +21,12 @@ tests/test_psrchive_bridge.py).
 import numpy as np
 
 from iterative_cleaner_tpu.io import load_archive, save_archive
+from iterative_cleaner_tpu.ops import dsp
+
+# Defaults mirror CleanConfig (config.py) so differential runs against the
+# backends share identical operator definitions.
+ROTATION_METHOD = "fourier"
+BASELINE_DUTY = 0.15
 
 
 class _Epoch:
@@ -41,6 +55,24 @@ class _Integration:
         self._owner._ar.weights[self._isub, ichan] = w
 
 
+class _Profile:
+    """PSRCHIVE ``Profile``: a live view into one (isub, ipol, ichan) cell
+    (reference :94,:268-272 reads amps and writes residuals back through it)."""
+
+    def __init__(self, owner, isub, ipol, ichan):
+        self._owner = owner
+        self._isub = isub
+        self._ipol = ipol
+        self._ichan = ichan
+
+    def get_amps(self):
+        # a mutable view: ``prof.get_amps()[:] = amps`` must write through
+        return self._owner._ar.data[self._isub, self._ipol, self._ichan]
+
+    def set_weight(self, w):
+        self._owner._ar.weights[self._isub, self._ichan] = w
+
+
 class FakeArchive:
     def __init__(self, ar, path=""):
         self._ar = ar
@@ -60,13 +92,77 @@ class FakeArchive:
         return self._ar.nbin
 
     def get_data(self):
-        return np.asarray(self._ar.data)
+        # real PSRCHIVE builds a fresh numpy array per call; mutating the
+        # result (reference :112 ``apply_weights``) must not touch the archive
+        return np.array(self._ar.data, copy=True)
 
     def get_weights(self):
-        return np.asarray(self._ar.weights)
+        return np.array(self._ar.weights, copy=True)
 
     def get_Integration(self, isub):
         return _Integration(self, int(isub))
+
+    def get_Profile(self, isub, ipol, ichan):
+        return _Profile(self, int(isub), int(ipol), int(ichan))
+
+    # --- in-loop DSP ops (reference :88-104) ---
+    def pscrunch(self):
+        self._ar.pscrunch()
+
+    def remove_baseline(self):
+        self._ar.data = dsp.remove_baseline(self._ar.data, np,
+                                            duty=BASELINE_DUTY)
+
+    def _dispersion_shifts(self):
+        return dsp.dispersion_shift_bins(
+            np.asarray(self._ar.freqs_mhz, dtype=np.float64), self._ar.dm,
+            self._ar.centre_freq_mhz, self._ar.period_s, self._ar.nbin, np,
+        )
+
+    def dedisperse(self):
+        if self._ar.dedispersed:  # PSRCHIVE tracks state; idempotent
+            return
+        self._ar.data = dsp.rotate_bins(
+            self._ar.data, -self._dispersion_shifts(), np,
+            method=ROTATION_METHOD)
+        self._ar.dedispersed = True
+
+    def dededisperse(self):
+        if not self._ar.dedispersed:
+            return
+        self._ar.data = dsp.rotate_bins(
+            self._ar.data, self._dispersion_shifts(), np,
+            method=ROTATION_METHOD)
+        self._ar.dedispersed = False
+
+    def fscrunch(self):
+        """Collapse channels to one, weight-aware: the scrunched profile is
+        the weighted mean and its weight the weight sum, so that
+        fscrunch∘tscrunch composes to the global weighted mean
+        (``ops/dsp.py:weighted_template``)."""
+        ar = self._ar
+        w = np.asarray(ar.weights, dtype=ar.data.dtype)
+        num = np.einsum("sc,spcb->spb", w, ar.data)
+        den = w.sum(axis=1)  # (nsub,)
+        safe = np.where(den == 0, 1.0, den)
+        prof = np.where(den[:, None, None] == 0, 0.0,
+                        num / safe[:, None, None])
+        ar.data = prof[:, :, None, :]
+        ar.weights = den[:, None]
+        ar.freqs_mhz = np.array([ar.centre_freq_mhz],
+                                dtype=np.asarray(ar.freqs_mhz).dtype)
+
+    def tscrunch(self):
+        """Collapse subints to one; same weight accumulation as fscrunch."""
+        ar = self._ar
+        w = np.asarray(ar.weights, dtype=ar.data.dtype)
+        num = np.einsum("sc,spcb->pcb", w, ar.data)
+        den = w.sum(axis=0)  # (nchan,)
+        safe = np.where(den == 0, 1.0, den)
+        prof = np.where(den[None, :, None] == 0, 0.0,
+                        num / safe[None, :, None])
+        ar.data = prof[None]
+        ar.weights = den[None, :]
 
     # --- metadata ---
     def get_dispersion_measure(self):
@@ -86,6 +182,11 @@ class FakeArchive:
 
     def get_filename(self):
         return self._path
+
+    def __str__(self):
+        # real PSRCHIVE prints "<class>: <filename>"; the reference's default
+        # output naming parses the part after the colon (reference :49)
+        return "FakeArchive: %s" % self._path
 
     def start_time(self):
         return _Epoch(self._ar.mjd_start)
