@@ -20,13 +20,14 @@ tests/test_psrchive_bridge.py).
 
 import numpy as np
 
+from iterative_cleaner_tpu.config import CleanConfig
 from iterative_cleaner_tpu.io import load_archive, save_archive
 from iterative_cleaner_tpu.ops import dsp
 
-# Defaults mirror CleanConfig (config.py) so differential runs against the
-# backends share identical operator definitions.
-ROTATION_METHOD = "fourier"
-BASELINE_DUTY = 0.15
+# Read straight off CleanConfig so differential runs against the backends
+# cannot drift from the operator definitions the backends use.
+ROTATION_METHOD = CleanConfig.rotation
+BASELINE_DUTY = CleanConfig.baseline_duty
 
 
 class _Epoch:
@@ -74,9 +75,14 @@ class _Profile:
 
 
 class FakeArchive:
-    def __init__(self, ar, path=""):
+    def __init__(self, ar, path="", rotation=ROTATION_METHOD,
+                 baseline_duty=BASELINE_DUTY):
+        # rotation/baseline_duty must match the CleanConfig under test:
+        # differential runs with non-default DSP knobs should pass them here
         self._ar = ar
         self._path = path
+        self._rotation = rotation
+        self._baseline_duty = baseline_duty
 
     # --- geometry / data ---
     def get_nsubint(self):
@@ -111,7 +117,7 @@ class FakeArchive:
 
     def remove_baseline(self):
         self._ar.data = dsp.remove_baseline(self._ar.data, np,
-                                            duty=BASELINE_DUTY)
+                                            duty=self._baseline_duty)
 
     def _dispersion_shifts(self):
         return dsp.dispersion_shift_bins(
@@ -124,7 +130,7 @@ class FakeArchive:
             return
         self._ar.data = dsp.rotate_bins(
             self._ar.data, -self._dispersion_shifts(), np,
-            method=ROTATION_METHOD)
+            method=self._rotation)
         self._ar.dedispersed = True
 
     def dededisperse(self):
@@ -132,7 +138,7 @@ class FakeArchive:
             return
         self._ar.data = dsp.rotate_bins(
             self._ar.data, self._dispersion_shifts(), np,
-            method=ROTATION_METHOD)
+            method=self._rotation)
         self._ar.dedispersed = False
 
     def fscrunch(self):
@@ -198,7 +204,9 @@ class FakeArchive:
     def clone(self):
         import copy
 
-        return FakeArchive(copy.deepcopy(self._ar), self._path)
+        return type(self)(copy.deepcopy(self._ar), self._path,
+                          rotation=self._rotation,
+                          baseline_duty=self._baseline_duty)
 
     def unload(self, path):
         save_archive(self._ar, path)

@@ -40,8 +40,9 @@ pytestmark = pytest.mark.skipif(
 @pytest.fixture(scope="module")
 def upstream():
     """Import the upstream script with psrchive shimmed to the fake."""
-    import matplotlib
-
+    # reference-only dependencies (the framework itself needs neither)
+    matplotlib = pytest.importorskip("matplotlib")
+    pytest.importorskip("scipy")
     matplotlib.use("Agg", force=True)
     shim = types.ModuleType("psrchive")
     shim.Archive_load = fake_psrchive.Archive_load
@@ -62,7 +63,14 @@ def upstream():
 
 
 def ref_args(**kw):
-    """An argparse namespace with the reference's defaults (reference :16-42)."""
+    """An argparse namespace with the reference's flag surface (:16-42).
+
+    Deviations from the upstream argparse defaults: quiet/no_log are on
+    (keep test output clean), and ``pscrunch=True`` so the reference's
+    post-loop ``Archive_load`` reload (:149-150) is skipped — the reload
+    branch is exercised separately by
+    :func:`test_fullpol_reload_branch_matches_upstream` with a real file.
+    """
     d = dict(
         archive=["synthetic.ar"], chanthresh=5.0, subintthresh=5.0, max_iter=5,
         print_zap=False, unload_res=False, pscrunch=True, quiet=True,
@@ -79,29 +87,25 @@ class _CapturingArchive(fake_psrchive.FakeArchive):
 
     captured = None  # set per-test: list of (path, Archive)
 
-    def clone(self):
-        import copy
-
-        out = _CapturingArchive(copy.deepcopy(self._ar), self._path)
-        return out
-
     def unload(self, path):
         type(self).captured.append((path, self._ar))
 
 
-def run_upstream(upstream, ar, args):
-    fa = fake_psrchive.FakeArchive(ar.clone(), "synthetic.ar")
+def run_upstream(upstream, ar, args, **fake_kw):
+    fa = fake_psrchive.FakeArchive(ar.clone(), "synthetic.ar", **fake_kw)
     out = upstream.clean(fa, args, "synthetic.ar")
     return out.get_weights()
 
 
 def _config_from_args(args, **extra):
-    return CleanConfig(
+    kw = dict(
         backend="numpy", dtype="float64",
         chanthresh=args.chanthresh, subintthresh=args.subintthresh,
         max_iter=args.max_iter, pulse_region=tuple(args.pulse_region),
-        bad_chan=args.bad_chan, bad_subint=args.bad_subint, **extra,
+        bad_chan=args.bad_chan, bad_subint=args.bad_subint,
     )
+    kw.update(extra)  # may override backend/dtype
+    return CleanConfig(**kw)
 
 
 CASES = [
@@ -111,6 +115,15 @@ CASES = [
     ("thresholds", dict(seed=3, n_rfi_channels=2), dict(chanthresh=4.0, subintthresh=6.5)),
     ("max_iter_1", dict(seed=4), dict(max_iter=1)),
     ("pulse_region", dict(seed=5), dict(pulse_region=[0.25, 30, 50])),
+    # degenerate geometries: single-line scalers, tiny bin counts
+    ("one_subint", dict(seed=3, nsub=1, nchan=8, nbin=32, n_rfi_cells=2,
+                        n_rfi_channels=0, n_rfi_subints=0), dict()),
+    ("one_channel", dict(seed=3, nsub=6, nchan=1, nbin=32, n_rfi_cells=2,
+                         n_rfi_channels=0, n_rfi_subints=0), dict()),
+    ("one_cell", dict(seed=3, nsub=1, nchan=1, nbin=32, n_rfi_cells=0,
+                      n_rfi_channels=0, n_rfi_subints=0), dict()),
+    ("tiny_bins", dict(seed=3, nsub=4, nchan=6, nbin=4, n_rfi_cells=2,
+                       n_rfi_channels=0, n_rfi_subints=0), dict()),
 ]
 
 
@@ -123,15 +136,53 @@ def test_final_weights_match_upstream(upstream, name, gen_kw, arg_kw):
     np.testing.assert_array_equal(res.final_weights, ref_weights)
 
 
+def test_roll_rotation_matches_upstream(upstream):
+    """Non-default DSP knob: nearest-bin roll dedispersion on both sides."""
+    ar, _ = make_synthetic_archive(seed=13)
+    args = ref_args()
+    ref_weights = run_upstream(upstream, ar, args, rotation="roll")
+    res = clean_archive(ar.clone(), _config_from_args(args, rotation="roll"))
+    np.testing.assert_array_equal(res.final_weights, ref_weights)
+
+
+def test_nan_data_matches_upstream(upstream):
+    """NaN bins poison the template and every score; NaN never zaps (quirk 8)
+    and both paths must agree on that."""
+    ar, _ = make_synthetic_archive(nsub=8, nchan=10, nbin=32, seed=11,
+                                   n_rfi_cells=3)
+    ar.data[2, 0, 3, 5] = np.nan
+    args = ref_args()
+    ref_weights = run_upstream(upstream, ar, args)
+    res = clean_archive(ar.clone(), _config_from_args(args))
+    np.testing.assert_array_equal(res.final_weights, ref_weights)
+
+
 def test_jax_backend_matches_upstream(upstream):
     ar, _ = make_synthetic_archive(seed=6)
     args = ref_args()
     ref_weights = run_upstream(upstream, ar, args)
-    res = clean_archive(
-        ar.clone(),
-        CleanConfig(backend="jax", dtype="float64"),
-    )
+    res = clean_archive(ar.clone(), _config_from_args(args, backend="jax"))
     np.testing.assert_array_equal(res.final_weights, ref_weights)
+
+
+def test_fullpol_reload_branch_matches_upstream(upstream, tmp_path):
+    """pscrunch=False, memory=False: the reference reloads the archive from
+    disk post-loop (:149-150) so the output stays full-pol (quirk 12).  The
+    fake's Archive_load serves the reload from the npz container."""
+    from iterative_cleaner_tpu.io import save_archive
+
+    ar, _ = make_synthetic_archive(seed=12, nsub=8, nchan=10, nbin=32,
+                                   npol=4, n_rfi_cells=3)
+    path = str(tmp_path / "fullpol.npz")
+    save_archive(ar, path)
+
+    fa = fake_psrchive.FakeArchive(ar.clone(), path)
+    args = ref_args(archive=[path], pscrunch=False)
+    out = upstream.clean(fa, args, path)
+    assert out.get_npol() == 4  # reloaded: output not pscrunched
+
+    res = clean_archive(ar.clone(), _config_from_args(args))
+    np.testing.assert_array_equal(res.final_weights, out.get_weights())
 
 
 def test_bad_parts_sweep_matches_upstream(upstream):
@@ -231,13 +282,11 @@ def test_cli_output_naming_matches_upstream_main(upstream, tmp_path, monkeypatch
     monkeypatch.chdir(tmp_path)
 
     written = []
-    orig_unload = fake_psrchive.FakeArchive.unload
     monkeypatch.setattr(fake_psrchive.FakeArchive, "unload",
                         lambda self, p: written.append(p))
     for output in ("", "std"):
         args = ref_args(archive=[path], output=output)
         upstream.main(args)
-    monkeypatch.setattr(fake_psrchive.FakeArchive, "unload", orig_unload)
 
     loaded = fake_psrchive.Archive_load(path)._ar
     assert written[0] == path + "_cleaned.ar"
