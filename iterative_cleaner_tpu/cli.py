@@ -142,10 +142,9 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
 
 
 def output_name(ar, args: argparse.Namespace, in_path: str) -> str:
-    """Reference naming rules (:48-58) adapted to container extensions."""
+    """Reference naming rules (:48-58); the output keeps the input's
+    container extension (``.ar`` outputs are written as PSRFITS)."""
     ext = os.path.splitext(in_path)[1] or ".npz"
-    if ext == ".ar":
-        ext = ".npz"  # we cannot write .ar without psrchive; keep data portable
     if args.output == "":
         return in_path + "_cleaned" + ext
     if args.output == "std":

@@ -47,17 +47,29 @@ _build_attempted = False
 
 
 def build_native(timeout: float = 120.0) -> bool:
-    """Run ``make -C native libicar.so``; True iff the library loads after."""
+    """Run ``make -C native libicar.so``; True iff the library loads after.
+
+    Drops any cached handle first so a rebuilt artifact (e.g. a stale
+    library missing newer symbol sets) is dlopen'd fresh."""
     import subprocess
 
+    global _lib
     try:
         subprocess.run(
-            ["make", "-C", _native_dir(), "libicar.so"],
+            ["make", "-C", _native_dir(), "-B", "libicar.so"],
             check=True, capture_output=True, timeout=timeout,
         )
     except Exception:
         return False
+    _lib = None
     return _load_lib_or_none() is not None
+
+
+def shared_lib():
+    """The loaded native library (libicar.so) or None.  Other io modules
+    (e.g. :mod:`iterative_cleaner_tpu.io.psrfits`) attach their own symbol
+    prototypes to the same handle — the library bundles every native reader."""
+    return _load_lib_or_none() if native_available() else None
 
 
 def native_available() -> bool:

@@ -3,7 +3,11 @@
 Stands in for PSRCHIVE ``Archive_load``/``unload``
 (``/root/reference/iterative_cleaner.py:47,60,150,162``).  The ``.npz``
 container stores exactly the Archive dataclass fields; ``.icar`` delegates to
-the native C++ loader; ``.ar`` delegates to the PSRCHIVE bridge when present.
+the native C++ loader; ``.sf``/``.rf``/``.fits``/``.psrfits`` (and ``.ar``
+files bearing FITS magic) go through the built-in PSRFITS fold-mode
+reader/writer (:mod:`iterative_cleaner_tpu.io.psrfits`, native C++ fast
+path); non-FITS ``.ar`` (TIMER format) falls back to the PSRCHIVE bridge
+when the bindings are present.
 """
 
 from __future__ import annotations
@@ -16,6 +20,8 @@ from iterative_cleaner_tpu.archive import Archive
 
 _META_KEYS = ("period_s", "dm", "centre_freq_mhz", "mjd_start", "mjd_end")
 
+_PSRFITS_EXTS = (".sf", ".rf", ".fits", ".psrfits")
+
 
 def save_archive(ar: Archive, path: str) -> None:
     ext = os.path.splitext(path)[1].lower()
@@ -24,10 +30,11 @@ def save_archive(ar: Archive, path: str) -> None:
 
         native.save_icar(ar, path)
         return
-    if ext == ".ar":
-        from iterative_cleaner_tpu.io import psrchive_bridge
+    if ext in _PSRFITS_EXTS or ext == ".ar":
+        # modern .ar archives are PSRFITS; write the standard layout
+        from iterative_cleaner_tpu.io import psrfits
 
-        psrchive_bridge.save_ar(ar, path)
+        psrfits.save_psrfits(ar, path)
         return
     # write through a file object so numpy cannot append '.npz' to a target
     # name with a different extension (the reported path must be the real one)
@@ -58,10 +65,18 @@ def load_archive(path: str) -> Archive:
         from iterative_cleaner_tpu.io import native
 
         return native.load_icar(path)
+    if ext in _PSRFITS_EXTS:
+        from iterative_cleaner_tpu.io import psrfits
+
+        return psrfits.load_psrfits(path)
     if ext == ".ar":
+        from iterative_cleaner_tpu.io import psrfits
+
+        if psrfits.is_fits(path):
+            return psrfits.load_psrfits(path)
         from iterative_cleaner_tpu.io import psrchive_bridge
 
-        return psrchive_bridge.load_ar(path)
+        return psrchive_bridge.load_ar(path)  # TIMER-format .ar
     with np.load(path, allow_pickle=False) as z:
         kwargs = {k: float(z[k]) for k in _META_KEYS}
         return Archive(

@@ -1,0 +1,581 @@
+"""PSRFITS fold-mode archives, read and written without PSRCHIVE or cfitsio.
+
+The reference can only touch ``.ar`` files through the PSRCHIVE C++ library
+(``/root/reference/iterative_cleaner.py:13,47,60``).  Most modern ``.ar``
+archives are PSRFITS (Hotan, van Straten & Manchester 2004): ordinary FITS
+files with a ``SUBINT`` binary table holding the fold-mode data cube.  This
+module implements the fold-mode subset of that layout directly — a
+pure-Python reader/writer that defines the framework's supported surface —
+and ``native/psrfits_io.cpp`` provides an mmap-based C++ reader for the same
+subset (byte swap + int16 scale/offset conversion in native code), used
+automatically when built.
+
+Supported subset (documented, tested):
+
+- Fold-mode (``OBS_MODE='PSR'``) single-file archives.
+- ``SUBINT`` binary table with per-row columns ``TSUBINT``, ``OFFS_SUB``,
+  ``DAT_FREQ``, ``DAT_WTS``, ``DAT_SCL``, ``DAT_OFFS`` and ``DATA``;
+  ``DATA`` element types ``E`` (float32) or ``I`` (int16, scaled by
+  ``DAT_SCL``/``DAT_OFFS`` per (pol, channel)).
+- Folding period resolution order: ``PERIOD`` key in the SUBINT header (this
+  writer emits it), then ``1/REF_F0`` from a ``POLYCO`` table, then the
+  standard fold-mode identity ``TBIN * NBIN``.
+- Search-mode files, references to external ephemerides, and exotic DATA
+  types are rejected with clear errors.
+
+FITS structural details handled here: 2880-byte units, 80-char header cards,
+big-endian table payloads, ``TDIM`` row shapes, header/data padding.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import re
+import struct
+
+import numpy as np
+
+from iterative_cleaner_tpu.archive import POL_STATES, Archive
+
+BLOCK = 2880
+CARD = 80
+
+# PSRFITS POL_TYPE strings <-> the framework's pol_state (archive.py).
+_POL_TYPE_OF_STATE = {
+    "Intensity": "INTEN",
+    "Stokes": "IQUV",
+    "Coherence": "AABBCRCI",
+}
+_STATE_OF_POL_TYPE = {
+    "INTEN": "Intensity",
+    "STOKE": "Stokes",
+    "IQUV": "Stokes",
+    "AABBCRCI": "Coherence",
+    "AABB": "Coherence",   # two-product coherence: intensity = AA + BB
+    "AA+BB": "Intensity",  # already summed
+}
+
+
+# ---------------------------------------------------------------------------
+# FITS primitives
+# ---------------------------------------------------------------------------
+
+def _card(key: str, value, comment: str = "") -> bytes:
+    """One 80-byte header card."""
+    if value is None:  # bare keyword (COMMENT/END handled separately)
+        body = f"{key:<8}"
+    elif isinstance(value, bool):
+        body = f"{key:<8}= {'T' if value else 'F':>20}"
+    elif isinstance(value, int):
+        body = f"{key:<8}= {value:>20}"
+    elif isinstance(value, float):
+        body = f"{key:<8}= {value:>20.14G}"
+    else:  # string: quoted, closing quote at col >= 20
+        s = str(value).replace("'", "''")
+        body = f"{key:<8}= '{s:<8}'"
+    if comment:
+        body = f"{body} / {comment}"
+    out = body[:CARD].ljust(CARD).encode("ascii")
+    return out
+
+
+def _end_pad(header_cards: list) -> bytes:
+    raw = b"".join(header_cards) + b"END".ljust(CARD)
+    pad = (-len(raw)) % BLOCK
+    return raw + b" " * pad
+
+
+_VALUE_RE = re.compile(
+    r"^(?:'(?P<str>(?:[^']|'')*)'|(?P<num>[^/]*?))\s*(?:/.*)?$")
+
+
+def _parse_header(buf: memoryview, off: int):
+    """Parse one FITS header starting at ``off``; returns (dict, data_off).
+
+    Repeated keys keep the first value; COMMENT/HISTORY/blank cards are
+    skipped.  The dict preserves raw string values stripped of padding.
+    """
+    cards = {}
+    pos = off
+    end_seen = False
+    while not end_seen:
+        if pos + BLOCK > len(buf):
+            raise ValueError("truncated FITS header")
+        block = bytes(buf[pos: pos + BLOCK])
+        pos += BLOCK
+        for i in range(0, BLOCK, CARD):
+            card = block[i: i + CARD].decode("ascii", "replace")
+            key = card[:8].strip()
+            if key == "END":
+                end_seen = True
+                break
+            if key in ("", "COMMENT", "HISTORY") or card[8:10] != "= ":
+                continue
+            m = _VALUE_RE.match(card[10:].strip())
+            if not m or key in cards:
+                continue
+            if m.group("str") is not None:
+                cards[key] = m.group("str").rstrip().replace("''", "'")
+            else:
+                cards[key] = m.group("num").strip()
+    return cards, pos
+
+
+def _as_int(cards, key, default=None):
+    if key not in cards:
+        if default is None:
+            raise ValueError(f"FITS header missing {key}")
+        return default
+    return int(float(cards[key]))
+
+
+def _as_float(cards, key, default=None):
+    if key not in cards:
+        if default is None:
+            raise ValueError(f"FITS header missing {key}")
+        return default
+    return float(cards[key])
+
+
+_TFORM_RE = re.compile(r"^(\d*)([LXBIJKAEDCM])")
+_TFORM_BYTES = {"L": 1, "X": 1, "B": 1, "I": 2, "J": 4, "K": 8, "A": 1,
+                "E": 4, "D": 8, "C": 8, "M": 16}
+
+
+def _columns(cards):
+    """[(name, code, repeat, byte_offset)] for a BINTABLE header."""
+    tfields = _as_int(cards, "TFIELDS")
+    cols = []
+    off = 0
+    for i in range(1, tfields + 1):
+        name = cards.get(f"TTYPE{i}", f"COL{i}").strip()
+        tform = cards.get(f"TFORM{i}", "")
+        m = _TFORM_RE.match(tform.strip())
+        if not m:
+            raise ValueError(f"unsupported TFORM{i} {tform!r}")
+        repeat = int(m.group(1)) if m.group(1) else 1
+        code = m.group(2)
+        cols.append((name, code, repeat, off))
+        off += repeat * _TFORM_BYTES[code]
+    return cols, off
+
+
+def _hdu_data_bytes(cards) -> int:
+    naxis = _as_int(cards, "NAXIS", 0)
+    if naxis == 0:
+        return 0
+    n = 1
+    for i in range(1, naxis + 1):
+        n *= _as_int(cards, f"NAXIS{i}")
+    n *= abs(_as_int(cards, "BITPIX", 8)) // 8
+    n += _as_int(cards, "PCOUNT", 0) * abs(_as_int(cards, "BITPIX", 8)) // 8
+    return n
+
+
+def _iter_hdus(buf: memoryview):
+    """Yield (cards, data_offset) for each HDU."""
+    off = 0
+    while off < len(buf):
+        cards, data_off = _parse_header(buf, off)
+        yield cards, data_off
+        size = _hdu_data_bytes(cards)
+        off = data_off + size + ((-size) % BLOCK)
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+
+def save_psrfits(ar: Archive, path: str, nbits: int = 16) -> None:
+    """Write a fold-mode PSRFITS archive.
+
+    ``nbits=16`` stores DATA as int16 with per-(pol, channel) DAT_SCL/DAT_OFFS
+    (the common on-disk layout; quantisation error ~ span/65534 per cell);
+    ``nbits=32`` stores float32 (exact for float32-precision cubes).  Cubes
+    containing non-finite values are always stored float32 — int16 scaling
+    is undefined for NaN/Inf, and float32 round-trips them.
+    """
+    if nbits not in (16, 32):
+        raise ValueError("nbits must be 16 (int16+scale) or 32 (float32)")
+    nsub, npol, nchan, nbin = ar.nsub, ar.npol, ar.nchan, ar.nbin
+    cube = np.ascontiguousarray(ar.data, dtype=np.float64)
+    if nbits == 16 and not np.isfinite(cube).all():
+        nbits = 32
+
+    stt_imjd = int(ar.mjd_start)
+    stt_smjd = (ar.mjd_start - stt_imjd) * 86400.0
+    primary = _end_pad([
+        _card("SIMPLE", True, "file does conform to FITS standard"),
+        _card("BITPIX", 8),
+        _card("NAXIS", 0),
+        _card("EXTEND", True),
+        _card("HDRVER", "6.1", "header version"),
+        _card("FITSTYPE", "PSRFITS", "FITS definition for pulsar data"),
+        _card("OBS_MODE", "PSR", "fold-mode data"),
+        _card("SRC_NAME", ar.source[:24]),
+        _card("OBSFREQ", float(ar.centre_freq_mhz), "centre frequency (MHz)"),
+        _card("OBSNCHAN", nchan),
+        _card("OBSBW", float(ar.freqs_mhz[-1] - ar.freqs_mhz[0])
+              if nchan > 1 else 0.0, "bandwidth (MHz)"),
+        _card("STT_IMJD", stt_imjd, "start MJD (UTC days)"),
+        _card("STT_SMJD", int(stt_smjd), "start time (s past UTC 0h)"),
+        _card("STT_OFFS", stt_smjd - int(stt_smjd), "start time fraction"),
+    ])
+
+    tsub = ((ar.mjd_end - ar.mjd_start) * 86400.0 / nsub) if nsub else 0.0
+    if nbits == 16:
+        data_code, data_np = "I", ">i2"
+    else:
+        data_code, data_np = "E", ">f4"
+    ncell = npol * nchan
+    row_bytes = (8 + 8 + 4 * nchan + 4 * nchan + 4 * ncell + 4 * ncell
+                 + (nbits // 8) * ncell * nbin)
+    subint = _end_pad([
+        _card("XTENSION", "BINTABLE", "binary table extension"),
+        _card("BITPIX", 8),
+        _card("NAXIS", 2),
+        _card("NAXIS1", row_bytes, "bytes per row"),
+        _card("NAXIS2", nsub, "number of subintegrations"),
+        _card("PCOUNT", 0),
+        _card("GCOUNT", 1),
+        _card("TFIELDS", 7),
+        _card("EXTNAME", "SUBINT", "fold-mode subintegration data"),
+        _card("NBIN", nbin, "phase bins"),
+        _card("NCHAN", nchan, "frequency channels"),
+        _card("NPOL", npol, "polarisations"),
+        _card("POL_TYPE", _POL_TYPE_OF_STATE[ar.pol_state]),
+        _card("NBITS", nbits),
+        _card("TBIN", ar.period_s / nbin if nbin else 0.0,
+              "time per phase bin (s) = PERIOD/NBIN"),
+        _card("PERIOD", float(ar.period_s), "folding period (s)"),
+        _card("CHAN_DM", float(ar.dm), "DM used for on-line dedispersion"),
+        _card("DEDISP", 1 if ar.dedispersed else 0,
+              "1 if channel delays removed"),
+        _card("TTYPE1", "TSUBINT"), _card("TFORM1", "1D"),
+        _card("TTYPE2", "OFFS_SUB"), _card("TFORM2", "1D"),
+        _card("TTYPE3", "DAT_FREQ"), _card("TFORM3", f"{nchan}E"),
+        _card("TTYPE4", "DAT_WTS"), _card("TFORM4", f"{nchan}E"),
+        _card("TTYPE5", "DAT_SCL"), _card("TFORM5", f"{ncell}E"),
+        _card("TTYPE6", "DAT_OFFS"), _card("TFORM6", f"{ncell}E"),
+        _card("TTYPE7", "DATA"), _card("TFORM7", f"{ncell * nbin}{data_code}"),
+        _card("TDIM7", f"({nbin},{nchan},{npol})", "DATA row shape"),
+    ])
+
+    # per-(sub, pol, chan) scale/offset; float32 rows keep identity scaling.
+    # scl/offs are stored as float32, so quantisation must use the float32-
+    # rounded values the reader will reconstruct with — otherwise a large
+    # baseline offset adds |offs|*2^-24 of error on top of span/65534.
+    if nbits == 16:
+        lo = cube.min(axis=3)                      # (nsub, npol, nchan)
+        hi = cube.max(axis=3)
+        # offs rounds to float32 first; scl then covers the true range
+        # around the *rounded* centre (else the float32 shift of offs —
+        # up to |offs|*2^-24 — pushes values past +-32767 into clipping),
+        # and itself rounds UP to the next float32 so the range still fits.
+        offs = ((lo + hi) / 2.0).astype(np.float32).astype(np.float64)
+        amp = np.maximum(hi - offs, offs - lo)
+        scl32 = np.where(amp == 0, 1.0, amp / 32767.0).astype(np.float32)
+        need = np.where(amp == 0, 1.0, amp / 32767.0)
+        scl32 = np.where(scl32.astype(np.float64) < need,
+                         np.nextafter(scl32, np.float32(np.inf)), scl32)
+        scl = scl32.astype(np.float64)
+        quant = np.rint((cube - offs[..., None]) / scl[..., None])
+        rows_data = np.clip(quant, -32767, 32767).astype(data_np)
+    else:
+        scl = np.ones((nsub, npol, nchan))
+        offs = np.zeros((nsub, npol, nchan))
+        rows_data = cube.astype(data_np)
+
+    with open(path, "wb") as f:
+        f.write(primary)
+        f.write(subint)
+        freqs32 = np.asarray(ar.freqs_mhz, dtype=">f4").tobytes()
+        for isub in range(nsub):
+            f.write(struct.pack(">d", tsub))
+            f.write(struct.pack(">d", (isub + 0.5) * tsub))
+            f.write(freqs32)
+            f.write(np.asarray(ar.weights[isub], dtype=">f4").tobytes())
+            f.write(np.asarray(scl[isub], dtype=">f4").tobytes())
+            f.write(np.asarray(offs[isub], dtype=">f4").tobytes())
+            f.write(rows_data[isub].tobytes())
+        f.write(b"\x00" * ((-f.tell()) % BLOCK))
+
+
+# ---------------------------------------------------------------------------
+# Reader (pure Python — the authoritative spec; native/psrfits_io.cpp mirrors it)
+# ---------------------------------------------------------------------------
+
+def _find_subint(buf: memoryview):
+    primary = None
+    for cards, data_off in _iter_hdus(buf):
+        if primary is None:
+            primary = cards
+            continue
+        if cards.get("EXTNAME", "").strip() == "SUBINT":
+            return primary, cards, data_off
+    raise ValueError("no SUBINT binary table in file (not a fold-mode "
+                     "PSRFITS archive?)")
+
+
+def _resolve_period(buf: memoryview, subint_cards) -> float:
+    period = _as_float(subint_cards, "PERIOD", 0.0)  # 0 = unset
+    if period > 0:
+        return period
+    for cards, data_off in _iter_hdus(buf):
+        if cards.get("EXTNAME", "").strip() == "POLYCO":
+            cols, row_bytes = _columns(cards)
+            nrows = _as_int(cards, "NAXIS2")
+            for name, code, repeat, off in cols:
+                if name == "REF_F0" and code == "D" and nrows:
+                    last = data_off + (nrows - 1) * row_bytes + off
+                    f0 = struct.unpack(">d", bytes(buf[last: last + 8]))[0]
+                    if f0 > 0:
+                        return 1.0 / f0
+    # fold-mode identity: TBIN = PERIOD / NBIN
+    period = _as_float(subint_cards, "TBIN", 0.0) * _as_int(subint_cards,
+                                                            "NBIN")
+    if period > 0:
+        return period
+    raise ValueError("cannot determine the folding period (no usable "
+                     "PERIOD key, POLYCO REF_F0, or TBIN)")
+
+
+_rebuild_attempted = False
+
+
+def _psrfits_lib():
+    """The native library with psrfits_* prototypes configured, or None
+    (missing, failed build, or a stale artifact without the symbols —
+    the latter triggers one rebuild attempt, since the Makefile already
+    knows how to produce the current symbol set)."""
+    global _rebuild_attempted
+    from iterative_cleaner_tpu.io import native
+
+    lib = native.shared_lib()
+    if lib is None:
+        return None
+    if not getattr(lib, "_psrfits_configured", False):
+        try:
+            lib.psrfits_open.restype = ctypes.c_void_p
+            lib.psrfits_open.argtypes = [ctypes.c_char_p]
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            lib.psrfits_dims.restype = ctypes.c_int
+            lib.psrfits_dims.argtypes = [ctypes.c_void_p] + [u32p] * 4
+            dp = ctypes.POINTER(ctypes.c_double)
+            ip = ctypes.POINTER(ctypes.c_int)
+            lib.psrfits_meta.restype = ctypes.c_int
+            lib.psrfits_meta.argtypes = [ctypes.c_void_p] + [dp] * 5 + \
+                [ip, ip, ctypes.c_char_p]
+            lib.psrfits_read.restype = ctypes.c_int
+            lib.psrfits_read.argtypes = [ctypes.c_void_p, dp, dp, dp]
+            lib.psrfits_close.restype = None
+            lib.psrfits_close.argtypes = [ctypes.c_void_p]
+        except AttributeError:
+            # stale libicar.so from before the psrfits reader existed
+            if not _rebuild_attempted:
+                _rebuild_attempted = True
+                if native.build_native():
+                    return _psrfits_lib()
+            return None
+        lib._psrfits_configured = True
+    return lib
+
+
+def _load_psrfits_native(path: str):
+    """Read through native/psrfits_io.cpp; None => caller falls back to the
+    pure-Python reader (library unavailable, or the file is outside the
+    native reader's subset)."""
+    lib = _psrfits_lib()
+    if lib is None:
+        return None
+    handle = lib.psrfits_open(path.encode())
+    if not handle:
+        return None
+    try:
+        dims = [ctypes.c_uint32() for _ in range(4)]
+        lib.psrfits_dims(handle, *[ctypes.byref(d) for d in dims])
+        nsub, npol, nchan, nbin = (d.value for d in dims)
+        meta = [ctypes.c_double() for _ in range(5)]
+        dedisp, pol_code = ctypes.c_int(), ctypes.c_int()
+        source = ctypes.create_string_buffer(64)
+        lib.psrfits_meta(handle, *[ctypes.byref(m) for m in meta],
+                         ctypes.byref(dedisp), ctypes.byref(pol_code), source)
+        data = np.empty((nsub, npol, nchan, nbin), dtype=np.float64)
+        weights = np.empty((nsub, nchan), dtype=np.float64)
+        freqs = np.empty(nchan, dtype=np.float64)
+        dp = ctypes.POINTER(ctypes.c_double)
+        lib.psrfits_read(handle, data.ctypes.data_as(dp),
+                         weights.ctypes.data_as(dp),
+                         freqs.ctypes.data_as(dp))
+    finally:
+        lib.psrfits_close(handle)
+    period, dm, cfreq, mjd0, mjd1 = (m.value for m in meta)
+    import math
+
+    return Archive(
+        data=data, weights=weights, freqs_mhz=freqs,
+        period_s=period, dm=dm,
+        # NaN = OBSFREQ absent (psrfits_io.cpp); same fallback as the pure
+        # reader, and OBSFREQ=0 passes through as 0 in both
+        centre_freq_mhz=float(freqs[nchan // 2]) if math.isnan(cfreq)
+        else cfreq,
+        source=source.value.decode("utf-8", "replace"),
+        mjd_start=mjd0, mjd_end=mjd1, filename=path,
+        pol_state=POL_STATES[pol_code.value],
+        dedispersed=bool(dedisp.value),
+    )
+
+
+def load_psrfits(path: str, prefer_native: bool = True) -> Archive:
+    if prefer_native:
+        ar = _load_psrfits_native(path)
+        if ar is not None:
+            return ar
+    with open(path, "rb") as f:
+        raw = f.read()
+    buf = memoryview(raw)
+    if raw[:6] != b"SIMPLE":
+        raise ValueError(f"{path} is not a FITS file")
+    primary, sub, data_off = _find_subint(buf)
+    if primary.get("OBS_MODE", "PSR").strip() not in ("PSR", "CAL"):
+        raise ValueError(
+            f"OBS_MODE={primary.get('OBS_MODE')!r}: only fold-mode (PSR/CAL) "
+            "PSRFITS is supported")
+
+    nsub = _as_int(sub, "NAXIS2")
+    nbin = _as_int(sub, "NBIN")
+    nchan = _as_int(sub, "NCHAN")
+    npol = _as_int(sub, "NPOL")
+    cols, row_bytes = _columns(sub)
+    if row_bytes != _as_int(sub, "NAXIS1"):
+        raise ValueError("SUBINT NAXIS1 disagrees with TFORM column widths")
+    col = {name: (code, repeat, off) for name, code, repeat, off in cols}
+    for need in ("DAT_FREQ", "DAT_WTS", "DAT_SCL", "DAT_OFFS", "DATA"):
+        if need not in col:
+            raise ValueError(f"SUBINT table missing column {need}")
+    dcode, drepeat, d_off = col["DATA"]
+    if dcode not in ("I", "E"):
+        raise ValueError(f"DATA column type {dcode!r} unsupported "
+                         "(expected I=int16 or E=float32)")
+    if drepeat != npol * nchan * nbin:
+        raise ValueError("DATA repeat count disagrees with NBIN*NCHAN*NPOL")
+    ncell = npol * nchan
+
+    table = np.frombuffer(raw, dtype=np.uint8, count=nsub * row_bytes,
+                          offset=data_off).reshape(nsub, row_bytes)
+
+    def column(name, dtype, count):
+        code, repeat, off = col[name]
+        width = repeat * _TFORM_BYTES[code]
+        flat = np.ascontiguousarray(table[:, off: off + width])
+        return flat.view(dtype).reshape(nsub, count)
+
+    tsubint = column("TSUBINT", ">f8", 1)[:, 0] if "TSUBINT" in col else \
+        np.zeros(nsub)
+    freqs = column("DAT_FREQ", ">f4", nchan)[0].astype(np.float64)
+    weights = column("DAT_WTS", ">f4", nchan).astype(np.float64)
+    scl = column("DAT_SCL", ">f4", ncell).astype(np.float64)
+    offs = column("DAT_OFFS", ">f4", ncell).astype(np.float64)
+    if dcode == "I":
+        rawd = column("DATA", ">i2", drepeat).astype(np.float64)
+    else:
+        rawd = column("DATA", ">f4", drepeat).astype(np.float64)
+    cube = (rawd.reshape(nsub, ncell, nbin) * scl[:, :, None]
+            + offs[:, :, None]).reshape(nsub, npol, nchan, nbin)
+
+    mjd_start = (_as_int(primary, "STT_IMJD", 0)
+                 + _as_int(primary, "STT_SMJD", 0) / 86400.0
+                 + _as_float(primary, "STT_OFFS", 0.0) / 86400.0)
+    mjd_end = mjd_start + float(np.sum(tsubint)) / 86400.0
+    pol_type = sub.get("POL_TYPE", "INTEN").strip().upper()
+    pol_state = _STATE_OF_POL_TYPE.get(pol_type,
+                                       "Intensity" if npol == 1 else "Stokes")
+    if pol_state not in POL_STATES:  # pragma: no cover - mapping is closed
+        pol_state = "Intensity"
+    return Archive(
+        data=cube,
+        weights=weights,
+        freqs_mhz=freqs,
+        period_s=_resolve_period(buf, sub),
+        dm=_as_float(sub, "CHAN_DM", _as_float(sub, "DM", 0.0)),
+        centre_freq_mhz=_as_float(primary, "OBSFREQ",
+                                  float(freqs[nchan // 2])),
+        source=primary.get("SRC_NAME", "unknown").strip(),
+        mjd_start=mjd_start,
+        mjd_end=mjd_end,
+        filename=path,
+        pol_state=pol_state,
+        dedispersed=bool(_as_int(sub, "DEDISP", 0)),
+    )
+
+
+def read_psrfits_info(path: str):
+    """(meta dict, (nsub, nchan) weights) without touching the DATA column.
+
+    The file is mmap'd, so only the header blocks and each row's DAT_WTS
+    bytes are paged in — operator tools (tools.py info/diff) stay cheap on
+    multi-GB archives.  Meta keys mirror :func:`native.read_icar_header`.
+    """
+    import mmap
+
+    with open(path, "rb") as f:
+        with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as mm:
+            buf = memoryview(mm)
+            try:
+                if bytes(buf[:6]) != b"SIMPLE":
+                    raise ValueError(f"{path} is not a FITS file")
+                primary, sub, data_off = _find_subint(buf)
+                nsub = _as_int(sub, "NAXIS2")
+                nchan = _as_int(sub, "NCHAN")
+                cols, row_bytes = _columns(sub)
+                col = {name: (code, repeat, off)
+                       for name, code, repeat, off in cols}
+                _, _, w_off = col["DAT_WTS"]
+                weights = np.empty((nsub, nchan), dtype=np.float64)
+                for i in range(nsub):
+                    start = data_off + i * row_bytes + w_off
+                    weights[i] = np.frombuffer(
+                        buf[start: start + 4 * nchan], dtype=">f4")
+                tsub_total = 0.0
+                if "TSUBINT" in col:
+                    _, _, t_off = col["TSUBINT"]
+                    for i in range(nsub):
+                        start = data_off + i * row_bytes + t_off
+                        tsub_total += struct.unpack(
+                            ">d", bytes(buf[start: start + 8]))[0]
+                mjd_start = (_as_int(primary, "STT_IMJD", 0)
+                             + _as_int(primary, "STT_SMJD", 0) / 86400.0
+                             + _as_float(primary, "STT_OFFS", 0.0) / 86400.0)
+                if "OBSFREQ" in primary:
+                    cfreq = _as_float(primary, "OBSFREQ")
+                else:  # same fallback as load_psrfits: mid-channel DAT_FREQ
+                    _, _, f_off = col["DAT_FREQ"]
+                    start = data_off + f_off + 4 * (nchan // 2)
+                    cfreq = float(np.frombuffer(
+                        buf[start: start + 4], dtype=">f4")[0])
+                meta = dict(
+                    source=primary.get("SRC_NAME", "unknown").strip(),
+                    nsub=nsub, npol=_as_int(sub, "NPOL"), nchan=nchan,
+                    nbin=_as_int(sub, "NBIN"),
+                    dm=_as_float(sub, "CHAN_DM", _as_float(sub, "DM", 0.0)),
+                    period_s=_resolve_period(buf, sub),
+                    centre_freq_mhz=cfreq,
+                    mjd_start=mjd_start,
+                    mjd_end=mjd_start + tsub_total / 86400.0,
+                    pol_state=_STATE_OF_POL_TYPE.get(
+                        sub.get("POL_TYPE", "INTEN").strip().upper(),
+                        "Intensity"),
+                    dedispersed=bool(_as_int(sub, "DEDISP", 0)),
+                )
+            finally:
+                del buf  # release the exported mmap buffer before close
+    return meta, weights
+
+
+def is_fits(path: str) -> bool:
+    """Cheap magic sniff: FITS files begin with the SIMPLE card."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(6) == b"SIMPLE"
+    except OSError:
+        return False

@@ -33,6 +33,10 @@ def _load_weights(path: str):
         from iterative_cleaner_tpu.io.native import read_icar_weights
 
         return read_icar_weights(path)
+    from iterative_cleaner_tpu.io import psrfits
+
+    if psrfits.is_fits(path):
+        return psrfits.read_psrfits_info(path)[1]
     with np.load(path, allow_pickle=False) as z:
         key = "final_weights" if "final_weights" in z.files else "weights"
         return z[key]
@@ -52,7 +56,8 @@ def cmd_diff(args) -> int:
 
 
 def cmd_convert(args) -> int:
-    """Container conversion (.npz <-> .icar; .ar via the psrchive bridge)."""
+    """Container conversion (.npz / .icar / PSRFITS .sf|.rf|.fits|.ar;
+    TIMER-format .ar via the psrchive bridge)."""
     from iterative_cleaner_tpu.io import load_archive, save_archive
 
     save_archive(load_archive(args.src), args.dst)
@@ -64,6 +69,7 @@ def cmd_info(args) -> int:
     only; the data cube is never read)."""
     import numpy as np
 
+    meta = weights = None
     if args.path.endswith(".icar"):
         from iterative_cleaner_tpu.io.native import (
             read_icar_header,
@@ -72,6 +78,12 @@ def cmd_info(args) -> int:
 
         meta = read_icar_header(args.path)
         weights = read_icar_weights(args.path)
+    else:
+        from iterative_cleaner_tpu.io import psrfits
+
+        if psrfits.is_fits(args.path):
+            meta, weights = psrfits.read_psrfits_info(args.path)
+    if meta is not None:
         info = {
             "source": meta["source"],
             "nsub": meta["nsub"], "npol": meta["npol"],

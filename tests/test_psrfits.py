@@ -1,0 +1,315 @@
+"""PSRFITS fold-mode container: writer/reader roundtrips, native C++ reader
+parity, period resolution, format dispatch, and rejection of unsupported
+layouts (iterative_cleaner_tpu/io/psrfits.py + native/psrfits_io.cpp).
+
+This is the framework's replacement for the reference's PSRCHIVE dependency
+on modern ``.ar`` files (/root/reference/iterative_cleaner.py:13,47,60):
+fold-mode PSRFITS read/written without psrchive or cfitsio.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.io import load_archive, save_archive
+from iterative_cleaner_tpu.io import psrfits
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+
+def _archive(npol=1, pol_state=None, **kw):
+    defaults = dict(nsub=6, nchan=8, nbin=32, seed=1, n_prezapped=3)
+    defaults.update(kw)
+    ar, truth = make_synthetic_archive(npol=npol, **defaults)
+    if pol_state:
+        ar.pol_state = pol_state
+    return ar, truth
+
+
+@pytest.mark.parametrize("nbits,rel_tol", [(32, 1e-7), (16, 1e-3)])
+def test_roundtrip(tmp_path, nbits, rel_tol):
+    ar, _ = _archive(npol=4, pol_state="Stokes")
+    path = str(tmp_path / f"t{nbits}.sf")
+    psrfits.save_psrfits(ar, path, nbits=nbits)
+    back = psrfits.load_psrfits(path)
+    assert back.data.shape == ar.data.shape
+    rel = np.abs(back.data - ar.data).max() / np.abs(ar.data).max()
+    assert rel < rel_tol
+    np.testing.assert_array_equal(back.weights, ar.weights)
+    np.testing.assert_allclose(back.freqs_mhz, ar.freqs_mhz, atol=2e-4)
+    assert abs(back.period_s - ar.period_s) < 1e-9
+    assert abs(back.dm - ar.dm) < 1e-9
+    assert back.centre_freq_mhz == ar.centre_freq_mhz
+    assert back.source == ar.source
+    assert back.pol_state == "Stokes"
+    assert abs(back.mjd_start - ar.mjd_start) < 2e-5  # STT_* second precision
+    assert abs((back.mjd_end - back.mjd_start)
+               - (ar.mjd_end - ar.mjd_start)) < 1e-9
+
+
+def test_float32_cube_exact(tmp_path):
+    ar, _ = _archive(dtype=np.float32, n_prezapped=0)
+    path = str(tmp_path / "f32.sf")
+    psrfits.save_psrfits(ar, path, nbits=32)
+    back = psrfits.load_psrfits(path)
+    np.testing.assert_array_equal(back.data, ar.data.astype(np.float64))
+
+
+def test_native_reader_bit_identical(tmp_path):
+    from iterative_cleaner_tpu.io import native
+
+    if not native.native_available() or psrfits._psrfits_lib() is None:
+        pytest.skip("native library unavailable")
+    for nbits in (16, 32):
+        ar, _ = _archive(npol=2, pol_state="Coherence", seed=7)
+        path = str(tmp_path / f"n{nbits}.sf")
+        psrfits.save_psrfits(ar, path, nbits=nbits)
+        nat = psrfits._load_psrfits_native(path)
+        assert nat is not None, "native open failed on a file we wrote"
+        pure = psrfits.load_psrfits(path, prefer_native=False)
+        np.testing.assert_array_equal(nat.data, pure.data)
+        np.testing.assert_array_equal(nat.weights, pure.weights)
+        np.testing.assert_array_equal(nat.freqs_mhz, pure.freqs_mhz)
+        for f in ("period_s", "dm", "centre_freq_mhz", "mjd_start", "mjd_end",
+                  "source", "pol_state", "dedispersed"):
+            assert getattr(nat, f) == getattr(pure, f), f
+
+
+def _strip_card(path, key):
+    raw = open(path, "rb").read()
+    idx = raw.find(key.ljust(8).encode() + b"= ")
+    assert idx >= 0
+    return raw[:idx] + b"COMMENT stripped".ljust(80) + raw[idx + 80:]
+
+
+def test_period_fallback_tbin(tmp_path):
+    ar, _ = _archive()
+    path = str(tmp_path / "p.sf")
+    psrfits.save_psrfits(ar, path)
+    patched = str(tmp_path / "nop.sf")
+    with open(patched, "wb") as f:
+        f.write(_strip_card(path, "PERIOD"))
+    back = psrfits.load_psrfits(patched, prefer_native=False)
+    assert abs(back.period_s - ar.period_s) < 1e-9  # TBIN * NBIN
+    nat = psrfits._load_psrfits_native(patched)
+    if nat is not None:
+        assert abs(nat.period_s - ar.period_s) < 1e-9
+
+
+def test_period_fallback_polyco(tmp_path):
+    """No PERIOD key + a POLYCO table: period = 1/REF_F0 of the last row."""
+    import struct
+
+    ar, _ = _archive()
+    path = str(tmp_path / "p.sf")
+    psrfits.save_psrfits(ar, path)
+    f0 = 2.5  # Hz
+    polyco_hdr = psrfits._end_pad([
+        psrfits._card("XTENSION", "BINTABLE"),
+        psrfits._card("BITPIX", 8),
+        psrfits._card("NAXIS", 2),
+        psrfits._card("NAXIS1", 8),
+        psrfits._card("NAXIS2", 2),
+        psrfits._card("PCOUNT", 0),
+        psrfits._card("GCOUNT", 1),
+        psrfits._card("TFIELDS", 1),
+        psrfits._card("EXTNAME", "POLYCO"),
+        psrfits._card("TTYPE1", "REF_F0"),
+        psrfits._card("TFORM1", "1D"),
+    ])
+    rows = struct.pack(">d", 1.0) + struct.pack(">d", f0)
+    rows += b"\x00" * ((-len(rows)) % psrfits.BLOCK)
+    patched = str(tmp_path / "polyco.sf")
+    with open(patched, "wb") as f:
+        f.write(_strip_card(path, "PERIOD"))
+        f.write(polyco_hdr)
+        f.write(rows)
+    back = psrfits.load_psrfits(patched, prefer_native=False)
+    assert abs(back.period_s - 1.0 / f0) < 1e-12
+    nat = psrfits._load_psrfits_native(patched)
+    if nat is not None:
+        assert abs(nat.period_s - 1.0 / f0) < 1e-12
+
+
+def test_ar_extension_dispatch(tmp_path):
+    """.ar files carry FITS magic -> the PSRFITS path handles them without
+    psrchive, both directions (the reference needs PSRCHIVE for any .ar)."""
+    ar, _ = _archive()
+    path = str(tmp_path / "obs.ar")
+    save_archive(ar, path)
+    with open(path, "rb") as f:
+        assert f.read(6) == b"SIMPLE"
+    back = load_archive(path)
+    np.testing.assert_array_equal(back.weights, ar.weights)
+    assert back.filename == path
+
+
+def test_non_fits_ar_falls_back_to_bridge(tmp_path):
+    path = str(tmp_path / "legacy.ar")
+    with open(path, "wb") as f:
+        f.write(b"TIMER archive, not FITS" * 10)
+    with pytest.raises(ImportError, match="psrchive"):
+        load_archive(path)  # no psrchive in the test env
+
+
+def test_cli_end_to_end_psrfits(tmp_path, monkeypatch):
+    from iterative_cleaner_tpu.cli import main
+
+    ar, truth = _archive(n_rfi_cells=4, n_prezapped=0, rfi_strength=60.0)
+    path = str(tmp_path / "obs.sf")
+    save_archive(ar, path)
+    monkeypatch.chdir(tmp_path)
+    assert main([path, "-q", "-l", "--backend", "numpy"]) == 0
+    out = load_archive(path + "_cleaned.sf")
+    zap = out.weights == 0
+    for s, c in truth.rfi_cells:
+        assert zap[s, c]
+    # weights quantise exactly (float32 holds 0/1); data within int16 scaling
+    assert np.abs(out.data - ar.data).max() / np.abs(ar.data).max() < 1e-3
+
+
+def test_rejects_unsupported(tmp_path):
+    ar, _ = _archive()
+    good = str(tmp_path / "g.sf")
+    psrfits.save_psrfits(ar, good)
+
+    bad = str(tmp_path / "notfits.sf")
+    with open(bad, "wb") as f:
+        f.write(b"\x00" * 5760)
+    with pytest.raises(ValueError, match="not a FITS"):
+        psrfits.load_psrfits(bad, prefer_native=False)
+    assert psrfits._load_psrfits_native(bad) is None
+
+    raw = open(good, "rb").read()
+    searchmode = raw.replace(b"'PSR     '", b"'SEARCH  '", 1)
+    sm = str(tmp_path / "search.sf")
+    open(sm, "wb").write(searchmode)
+    with pytest.raises(ValueError, match="fold-mode"):
+        psrfits.load_psrfits(sm, prefer_native=False)
+    assert psrfits._load_psrfits_native(sm) is None
+
+    nodata = raw.replace(b"'DATA    '", b"'NOPE    '", 1)
+    nd = str(tmp_path / "nodata.sf")
+    open(nd, "wb").write(nodata)
+    with pytest.raises(ValueError, match="DATA"):
+        psrfits.load_psrfits(nd, prefer_native=False)
+    assert psrfits._load_psrfits_native(nd) is None
+
+    truncated = str(tmp_path / "trunc.sf")
+    open(truncated, "wb").write(raw[: len(raw) // 2])
+    with pytest.raises(Exception):
+        psrfits.load_psrfits(truncated, prefer_native=False)
+
+
+def test_period_zero_treated_as_unset(tmp_path):
+    """PERIOD=0 (tools write it when unset) must fall through to TBIN*NBIN,
+    matching the native reader."""
+    ar, _ = _archive()
+    path = str(tmp_path / "p0.sf")
+    psrfits.save_psrfits(ar, path)
+    raw = open(path, "rb").read()
+    idx = raw.find(b"PERIOD  = ")
+    zeroed = psrfits._card("PERIOD", 0.0, "unset")
+    open(path, "wb").write(raw[:idx] + zeroed + raw[idx + 80:])
+    back = psrfits.load_psrfits(path, prefer_native=False)
+    assert abs(back.period_s - ar.period_s) < 1e-9
+    nat = psrfits._load_psrfits_native(path)
+    if nat is not None:
+        assert abs(nat.period_s - ar.period_s) < 1e-9
+
+
+def test_int16_error_bound_with_large_baseline(tmp_path):
+    """Round-trip error must stay ~span/65534 even when the baseline offset
+    is many orders larger than the per-profile span (DAT_SCL/DAT_OFFS are
+    float32; quantisation uses the float32-rounded values)."""
+    ar, _ = _archive(n_prezapped=0, baseline_level=1.0e6, noise_sigma=0.5,
+                     rfi_strength=5.0, pulse_snr=5.0)
+    path = str(tmp_path / "big.sf")
+    psrfits.save_psrfits(ar, path, nbits=16)
+    back = psrfits.load_psrfits(path, prefer_native=False)
+    span = (ar.data.max(axis=3) - ar.data.min(axis=3))[..., None]
+    centre = np.abs(ar.data.max(axis=3) + ar.data.min(axis=3))[..., None] / 2
+    # half a quantum, with the float32 scl/offs rounding accounted for
+    bound = ((span / 2 + centre * 2.0 ** -23) / 32767.0).max() * 0.51
+    assert np.abs(back.data - ar.data).max() <= bound
+
+
+def test_tools_info_and_diff_on_psrfits(tmp_path, capsys):
+    import json
+
+    from iterative_cleaner_tpu.tools import main as tools_main
+
+    ar, _ = _archive(npol=2, pol_state="Coherence")
+    a = str(tmp_path / "a.sf")
+    b = str(tmp_path / "b.sf")
+    psrfits.save_psrfits(ar, a)
+    ar.weights[0, 0] = 0.0
+    psrfits.save_psrfits(ar, b)
+
+    assert tools_main(["info", a]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert (info["nsub"], info["npol"], info["nchan"], info["nbin"]) == \
+        (ar.nsub, 2, ar.nchan, ar.nbin)
+    assert info["pol_state"] == "Coherence"
+    assert abs(info["period_s"] - ar.period_s) < 1e-9
+    assert abs(info["dm"] - ar.dm) < 1e-9
+
+    assert tools_main(["diff", a, b]) == 1  # masks differ
+    out = json.loads(capsys.readouterr().out)
+    assert out["changed"] == 1
+
+    assert tools_main(["diff", a, a]) == 0
+
+
+def test_aabb_pol_type_maps_to_coherence(tmp_path):
+    """POL_TYPE='AABB' (two-product coherence): total intensity must be
+    AA + BB, not just AA — both readers map it to Coherence."""
+    ar, _ = _archive(npol=2, pol_state="Coherence")
+    path = str(tmp_path / "aabb.sf")
+    psrfits.save_psrfits(ar, path)
+    raw = open(path, "rb").read().replace(b"'AABBCRCI'", b"'AABB    '", 1)
+    open(path, "wb").write(raw)
+    back = psrfits.load_psrfits(path, prefer_native=False)
+    assert back.pol_state == "Coherence"
+    nat = psrfits._load_psrfits_native(path)
+    if nat is not None:
+        assert nat.pol_state == "Coherence"
+
+
+def test_nonfinite_cube_stored_float32(tmp_path):
+    """int16 scaling is undefined for NaN/Inf; the writer upgrades to
+    float32 and the values round-trip."""
+    ar, _ = _archive(dtype=np.float32)
+    ar.data[1, 0, 2, 3] = np.nan
+    ar.data[2, 0, 1, 0] = np.inf
+    path = str(tmp_path / "nan.sf")
+    psrfits.save_psrfits(ar, path, nbits=16)  # silently upgraded
+    back = psrfits.load_psrfits(path, prefer_native=False)
+    np.testing.assert_array_equal(back.data, ar.data.astype(np.float64))
+    nat = psrfits._load_psrfits_native(path)
+    if nat is not None:
+        np.testing.assert_array_equal(nat.data, back.data)
+
+
+def test_no_period_anywhere_is_an_error(tmp_path):
+    ar, _ = _archive()
+    path = str(tmp_path / "nop.sf")
+    psrfits.save_psrfits(ar, path)
+    raw = _strip_card(path, "PERIOD")
+    open(path, "wb").write(raw)
+    raw = _strip_card(path, "TBIN")
+    open(path, "wb").write(raw)
+    with pytest.raises(ValueError, match="folding period"):
+        psrfits.load_psrfits(path, prefer_native=False)
+    assert psrfits._load_psrfits_native(path) is None  # native stays in sync
+
+
+def test_is_fits(tmp_path):
+    ar, _ = _archive()
+    p = str(tmp_path / "x.sf")
+    psrfits.save_psrfits(ar, p)
+    assert psrfits.is_fits(p)
+    q = str(tmp_path / "y.bin")
+    open(q, "wb").write(b"nope")
+    assert not psrfits.is_fits(q)
+    assert not psrfits.is_fits(str(tmp_path / "missing"))
