@@ -47,10 +47,13 @@ _build_attempted = False
 
 
 def build_native(timeout: float = 120.0) -> bool:
-    """Run ``make -C native libicar.so``; True iff the library loads after.
+    """Run ``make -C native -B libicar.so``; True iff the library loads after.
 
-    Drops any cached handle first so a rebuilt artifact (e.g. a stale
-    library missing newer symbol sets) is dlopen'd fresh."""
+    Note: if this process already dlopen'd the old artifact, re-loading the
+    same path returns the stale mapping (glibc caches by path; ctypes never
+    dlcloses).  Callers needing the new symbols in-process must load a
+    unique-path copy (see psrfits._load_fresh_copy); new processes pick the
+    rebuilt artifact up automatically."""
     import subprocess
 
     global _lib

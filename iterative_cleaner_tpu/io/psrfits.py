@@ -341,43 +341,83 @@ def _resolve_period(buf: memoryview, subint_cards) -> float:
 
 
 _rebuild_attempted = False
+_fresh_lib = None  # handle loaded from a unique-path copy after a rebuild
+
+
+def _configure_psrfits(lib):
+    """Attach the psrfits_* prototypes; AttributeError if symbols absent."""
+    lib.psrfits_open.restype = ctypes.c_void_p
+    lib.psrfits_open.argtypes = [ctypes.c_char_p]
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.psrfits_dims.restype = ctypes.c_int
+    lib.psrfits_dims.argtypes = [ctypes.c_void_p] + [u32p] * 4
+    dp = ctypes.POINTER(ctypes.c_double)
+    ip = ctypes.POINTER(ctypes.c_int)
+    lib.psrfits_meta.restype = ctypes.c_int
+    lib.psrfits_meta.argtypes = [ctypes.c_void_p] + [dp] * 5 + \
+        [ip, ip, ctypes.c_char_p]
+    lib.psrfits_read.restype = ctypes.c_int
+    lib.psrfits_read.argtypes = [ctypes.c_void_p, dp, dp, dp]
+    lib.psrfits_close.restype = None
+    lib.psrfits_close.argtypes = [ctypes.c_void_p]
+    lib._psrfits_configured = True
+
+
+def _load_fresh_copy():
+    """dlopen a unique-path copy of the (re)built library.
+
+    glibc caches shared objects by path and never unloads ctypes handles,
+    so an in-place rebuild of libicar.so is invisible to this process —
+    dlopen of the same path returns the stale mapping.  A copy under a
+    unique temp name forces a genuinely fresh load; the file can be
+    unlinked immediately (the mapping keeps it alive)."""
+    import os
+    import shutil
+    import tempfile
+
+    from iterative_cleaner_tpu.io import native
+
+    fd, tmp = tempfile.mkstemp(suffix=".so", prefix="libicar-")
+    os.close(fd)
+    try:
+        shutil.copy2(native._lib_path(), tmp)
+        return ctypes.CDLL(tmp)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def _psrfits_lib():
     """The native library with psrfits_* prototypes configured, or None
     (missing, failed build, or a stale artifact without the symbols —
-    the latter triggers one rebuild attempt, since the Makefile already
-    knows how to produce the current symbol set)."""
-    global _rebuild_attempted
+    the latter triggers one rebuild + fresh-copy load, since the Makefile
+    already knows how to produce the current symbol set)."""
+    global _rebuild_attempted, _fresh_lib
     from iterative_cleaner_tpu.io import native
 
+    if _fresh_lib is not None:
+        return _fresh_lib
     lib = native.shared_lib()
     if lib is None:
         return None
     if not getattr(lib, "_psrfits_configured", False):
         try:
-            lib.psrfits_open.restype = ctypes.c_void_p
-            lib.psrfits_open.argtypes = [ctypes.c_char_p]
-            u32p = ctypes.POINTER(ctypes.c_uint32)
-            lib.psrfits_dims.restype = ctypes.c_int
-            lib.psrfits_dims.argtypes = [ctypes.c_void_p] + [u32p] * 4
-            dp = ctypes.POINTER(ctypes.c_double)
-            ip = ctypes.POINTER(ctypes.c_int)
-            lib.psrfits_meta.restype = ctypes.c_int
-            lib.psrfits_meta.argtypes = [ctypes.c_void_p] + [dp] * 5 + \
-                [ip, ip, ctypes.c_char_p]
-            lib.psrfits_read.restype = ctypes.c_int
-            lib.psrfits_read.argtypes = [ctypes.c_void_p, dp, dp, dp]
-            lib.psrfits_close.restype = None
-            lib.psrfits_close.argtypes = [ctypes.c_void_p]
+            _configure_psrfits(lib)
         except AttributeError:
             # stale libicar.so from before the psrfits reader existed
             if not _rebuild_attempted:
                 _rebuild_attempted = True
                 if native.build_native():
-                    return _psrfits_lib()
+                    try:
+                        fresh = _load_fresh_copy()
+                        _configure_psrfits(fresh)
+                        _fresh_lib = fresh
+                        return fresh
+                    except (OSError, AttributeError):
+                        pass
             return None
-        lib._psrfits_configured = True
     return lib
 
 
@@ -431,10 +471,23 @@ def load_psrfits(path: str, prefer_native: bool = True) -> Archive:
         ar = _load_psrfits_native(path)
         if ar is not None:
             return ar
+    import mmap
+
+    # mmap instead of read(): the raw file never goes resident on top of
+    # the float64 cube being built (every returned array is a copy)
     with open(path, "rb") as f:
-        raw = f.read()
-    buf = memoryview(raw)
-    if raw[:6] != b"SIMPLE":
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    try:
+        return _parse_psrfits(memoryview(mm), path)
+    finally:
+        try:
+            mm.close()
+        except BufferError:
+            pass  # an error traceback still holds views; GC closes it later
+
+
+def _parse_psrfits(buf: memoryview, path: str) -> Archive:
+    if bytes(buf[:6]) != b"SIMPLE":
         raise ValueError(f"{path} is not a FITS file")
     primary, sub, data_off = _find_subint(buf)
     if primary.get("OBS_MODE", "PSR").strip() not in ("PSR", "CAL"):
@@ -461,12 +514,17 @@ def load_psrfits(path: str, prefer_native: bool = True) -> Archive:
         raise ValueError("DATA repeat count disagrees with NBIN*NCHAN*NPOL")
     ncell = npol * nchan
 
-    table = np.frombuffer(raw, dtype=np.uint8, count=nsub * row_bytes,
+    table = np.frombuffer(buf, dtype=np.uint8, count=nsub * row_bytes,
                           offset=data_off).reshape(nsub, row_bytes)
 
     def column(name, dtype, count):
+        # repeat > count is tolerated (padded columns; first `count` values
+        # are the payload, matching the native reader); repeat < count errors
         code, repeat, off = col[name]
-        width = repeat * _TFORM_BYTES[code]
+        if repeat < count:
+            raise ValueError(
+                f"SUBINT column {name}: repeat {repeat} < expected {count}")
+        width = count * _TFORM_BYTES[code]
         flat = np.ascontiguousarray(table[:, off: off + width])
         return flat.view(dtype).reshape(nsub, count)
 
@@ -519,56 +577,60 @@ def read_psrfits_info(path: str):
     import mmap
 
     with open(path, "rb") as f:
-        with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as mm:
-            buf = memoryview(mm)
-            try:
-                if bytes(buf[:6]) != b"SIMPLE":
-                    raise ValueError(f"{path} is not a FITS file")
-                primary, sub, data_off = _find_subint(buf)
-                nsub = _as_int(sub, "NAXIS2")
-                nchan = _as_int(sub, "NCHAN")
-                cols, row_bytes = _columns(sub)
-                col = {name: (code, repeat, off)
-                       for name, code, repeat, off in cols}
-                _, _, w_off = col["DAT_WTS"]
-                weights = np.empty((nsub, nchan), dtype=np.float64)
-                for i in range(nsub):
-                    start = data_off + i * row_bytes + w_off
-                    weights[i] = np.frombuffer(
-                        buf[start: start + 4 * nchan], dtype=">f4")
-                tsub_total = 0.0
-                if "TSUBINT" in col:
-                    _, _, t_off = col["TSUBINT"]
-                    for i in range(nsub):
-                        start = data_off + i * row_bytes + t_off
-                        tsub_total += struct.unpack(
-                            ">d", bytes(buf[start: start + 8]))[0]
-                mjd_start = (_as_int(primary, "STT_IMJD", 0)
-                             + _as_int(primary, "STT_SMJD", 0) / 86400.0
-                             + _as_float(primary, "STT_OFFS", 0.0) / 86400.0)
-                if "OBSFREQ" in primary:
-                    cfreq = _as_float(primary, "OBSFREQ")
-                else:  # same fallback as load_psrfits: mid-channel DAT_FREQ
-                    _, _, f_off = col["DAT_FREQ"]
-                    start = data_off + f_off + 4 * (nchan // 2)
-                    cfreq = float(np.frombuffer(
-                        buf[start: start + 4], dtype=">f4")[0])
-                meta = dict(
-                    source=primary.get("SRC_NAME", "unknown").strip(),
-                    nsub=nsub, npol=_as_int(sub, "NPOL"), nchan=nchan,
-                    nbin=_as_int(sub, "NBIN"),
-                    dm=_as_float(sub, "CHAN_DM", _as_float(sub, "DM", 0.0)),
-                    period_s=_resolve_period(buf, sub),
-                    centre_freq_mhz=cfreq,
-                    mjd_start=mjd_start,
-                    mjd_end=mjd_start + tsub_total / 86400.0,
-                    pol_state=_STATE_OF_POL_TYPE.get(
-                        sub.get("POL_TYPE", "INTEN").strip().upper(),
-                        "Intensity"),
-                    dedispersed=bool(_as_int(sub, "DEDISP", 0)),
-                )
-            finally:
-                del buf  # release the exported mmap buffer before close
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    try:
+        return _parse_info(memoryview(mm), path)
+    finally:
+        try:
+            mm.close()
+        except BufferError:
+            pass  # an error traceback still holds views; GC closes it later
+
+
+def _parse_info(buf: memoryview, path: str):
+    if bytes(buf[:6]) != b"SIMPLE":
+        raise ValueError(f"{path} is not a FITS file")
+    primary, sub, data_off = _find_subint(buf)
+    nsub = _as_int(sub, "NAXIS2")
+    nchan = _as_int(sub, "NCHAN")
+    cols, row_bytes = _columns(sub)
+    col = {name: (code, repeat, off) for name, code, repeat, off in cols}
+    for need in ("DAT_FREQ", "DAT_WTS"):
+        if need not in col:
+            raise ValueError(f"SUBINT table missing column {need}")
+    _, _, w_off = col["DAT_WTS"]
+    weights = np.empty((nsub, nchan), dtype=np.float64)
+    for i in range(nsub):
+        start = data_off + i * row_bytes + w_off
+        weights[i] = np.frombuffer(buf[start: start + 4 * nchan], dtype=">f4")
+    tsub_total = 0.0
+    if "TSUBINT" in col:
+        _, _, t_off = col["TSUBINT"]
+        for i in range(nsub):
+            start = data_off + i * row_bytes + t_off
+            tsub_total += struct.unpack(">d", bytes(buf[start: start + 8]))[0]
+    mjd_start = (_as_int(primary, "STT_IMJD", 0)
+                 + _as_int(primary, "STT_SMJD", 0) / 86400.0
+                 + _as_float(primary, "STT_OFFS", 0.0) / 86400.0)
+    if "OBSFREQ" in primary:
+        cfreq = _as_float(primary, "OBSFREQ")
+    else:  # same fallback as load_psrfits: mid-channel DAT_FREQ
+        _, _, f_off = col["DAT_FREQ"]
+        start = data_off + f_off + 4 * (nchan // 2)
+        cfreq = float(np.frombuffer(buf[start: start + 4], dtype=">f4")[0])
+    meta = dict(
+        source=primary.get("SRC_NAME", "unknown").strip(),
+        nsub=nsub, npol=_as_int(sub, "NPOL"), nchan=nchan,
+        nbin=_as_int(sub, "NBIN"),
+        dm=_as_float(sub, "CHAN_DM", _as_float(sub, "DM", 0.0)),
+        period_s=_resolve_period(buf, sub),
+        centre_freq_mhz=cfreq,
+        mjd_start=mjd_start,
+        mjd_end=mjd_start + tsub_total / 86400.0,
+        pol_state=_STATE_OF_POL_TYPE.get(
+            sub.get("POL_TYPE", "INTEN").strip().upper(), "Intensity"),
+        dedispersed=bool(_as_int(sub, "DEDISP", 0)),
+    )
     return meta, weights
 
 

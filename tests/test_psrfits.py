@@ -304,6 +304,18 @@ def test_no_period_anywhere_is_an_error(tmp_path):
     assert psrfits._load_psrfits_native(path) is None  # native stays in sync
 
 
+def test_fresh_lib_copy_loads_with_symbols():
+    """The stale-library recovery path loads a unique-path copy (glibc
+    caches dlopen by path, so an in-place rebuild is invisible otherwise)."""
+    from iterative_cleaner_tpu.io import native
+
+    if not native.native_available():
+        pytest.skip("native library unavailable")
+    lib = psrfits._load_fresh_copy()
+    psrfits._configure_psrfits(lib)  # raises AttributeError if symbols absent
+    assert lib.psrfits_open is not None
+
+
 def test_is_fits(tmp_path):
     ar, _ = _archive()
     p = str(tmp_path / "x.sf")

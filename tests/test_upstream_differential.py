@@ -268,6 +268,42 @@ def test_fit_matches_upstream_leastsq(upstream):
                                        rtol=1e-6, atol=1e-8)
 
 
+@pytest.mark.parametrize("trial", range(12))
+def test_randomized_upstream_fuzz(upstream, trial):
+    """Property sweep: random geometry, RFI mix, thresholds, pulse regions —
+    the upstream script and the numpy oracle must produce identical final
+    weights on every draw."""
+    rng = np.random.default_rng(5000 + trial)
+    nsub = int(rng.integers(2, 14))
+    nchan = int(rng.integers(2, 18))
+    nbin = int(rng.choice([8, 16, 32, 64]))
+    ar, _ = make_synthetic_archive(
+        nsub=nsub, nchan=nchan, nbin=nbin,
+        n_rfi_cells=int(rng.integers(0, 5)),
+        n_rfi_channels=int(rng.integers(0, 2)),
+        n_rfi_subints=int(rng.integers(0, 2)),
+        n_prezapped=int(rng.integers(0, max(1, nsub * nchan // 4))),
+        rfi_strength=float(rng.uniform(10, 80)),
+        pulse_snr=float(rng.uniform(3, 50)),
+        seed=int(rng.integers(0, 2 ** 31)),
+    )
+    pulse_region = [0, 0, 1]
+    if rng.random() < 0.4:
+        a, b = sorted(rng.integers(0, nbin, size=2).tolist())
+        pulse_region = [float(rng.uniform(0, 1)), float(a), float(b)]
+    args = ref_args(
+        chanthresh=float(rng.uniform(2.5, 8)),
+        subintthresh=float(rng.uniform(2.5, 8)),
+        max_iter=int(rng.integers(1, 7)),
+        pulse_region=pulse_region,
+        bad_chan=float(rng.choice([1.0, rng.uniform(0.2, 0.9)])),
+        bad_subint=float(rng.choice([1.0, rng.uniform(0.2, 0.9)])),
+    )
+    ref_weights = run_upstream(upstream, ar, args)
+    res = clean_archive(ar.clone(), _config_from_args(args))
+    np.testing.assert_array_equal(res.final_weights, ref_weights)
+
+
 def test_cli_output_naming_matches_upstream_main(upstream, tmp_path, monkeypatch):
     """End-to-end through the upstream ``main``: the fake archive loads from
     the framework's npz container, the default and 'std' output-name rules
