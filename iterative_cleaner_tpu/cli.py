@@ -332,14 +332,9 @@ def _run_batched(args) -> list:
 
 def main(argv=None) -> int:
     args = parse_arguments(argv)
-    # ICLEAN_PLATFORM=cpu forces the jax platform before any backend
-    # initialises — the escape hatch when the default device is absent or
-    # unreachable (a sitecustomize-pinned TPU tunnel ignores JAX_PLATFORMS).
-    platform = os.environ.get("ICLEAN_PLATFORM")
-    if platform:
-        import jax
+    from iterative_cleaner_tpu.utils import apply_platform_override
 
-        jax.config.update("jax_platforms", platform)
+    apply_platform_override()
     from iterative_cleaner_tpu.utils.tracing import device_trace
 
     if args.batch > 1 and (args.unload_res or args.checkpoint

@@ -466,24 +466,34 @@ def _load_psrfits_native(path: str):
     )
 
 
-def load_psrfits(path: str, prefer_native: bool = True) -> Archive:
-    if prefer_native:
-        ar = _load_psrfits_native(path)
-        if ar is not None:
-            return ar
+def _mmap_parse(path: str, parser):
+    """Run ``parser(memoryview, path)`` over an mmap of the file.
+
+    mmap instead of read(): the raw file never goes resident on top of the
+    arrays being built (parsers only return copies).  Zero-byte files get a
+    clear not-a-FITS error instead of mmap's internal one."""
     import mmap
 
-    # mmap instead of read(): the raw file never goes resident on top of
-    # the float64 cube being built (every returned array is a copy)
     with open(path, "rb") as f:
-        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        try:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError as e:
+            raise ValueError(f"{path} is not a FITS file ({e})") from None
     try:
-        return _parse_psrfits(memoryview(mm), path)
+        return parser(memoryview(mm), path)
     finally:
         try:
             mm.close()
         except BufferError:
             pass  # an error traceback still holds views; GC closes it later
+
+
+def load_psrfits(path: str, prefer_native: bool = True) -> Archive:
+    if prefer_native:
+        ar = _load_psrfits_native(path)
+        if ar is not None:
+            return ar
+    return _mmap_parse(path, _parse_psrfits)
 
 
 def _parse_psrfits(buf: memoryview, path: str) -> Archive:
@@ -574,17 +584,7 @@ def read_psrfits_info(path: str):
     bytes are paged in — operator tools (tools.py info/diff) stay cheap on
     multi-GB archives.  Meta keys mirror :func:`native.read_icar_header`.
     """
-    import mmap
-
-    with open(path, "rb") as f:
-        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-    try:
-        return _parse_info(memoryview(mm), path)
-    finally:
-        try:
-            mm.close()
-        except BufferError:
-            pass  # an error traceback still holds views; GC closes it later
+    return _mmap_parse(path, _parse_info)
 
 
 def _parse_info(buf: memoryview, path: str):
@@ -598,6 +598,9 @@ def _parse_info(buf: memoryview, path: str):
     for need in ("DAT_FREQ", "DAT_WTS"):
         if need not in col:
             raise ValueError(f"SUBINT table missing column {need}")
+        if col[need][1] < nchan:
+            raise ValueError(f"SUBINT column {need}: repeat "
+                             f"{col[need][1]} < expected {nchan}")
     _, _, w_off = col["DAT_WTS"]
     weights = np.empty((nsub, nchan), dtype=np.float64)
     for i in range(nsub):

@@ -1,1 +1,15 @@
 """Host-side utilities: logging, plotting, progress reporting."""
+
+import os
+
+
+def apply_platform_override() -> None:
+    """Honour ICLEAN_PLATFORM: force the jax platform before any backend
+    initialises.  This is the escape hatch when the default device is absent
+    or unreachable — a sitecustomize-pinned TPU plugin ignores JAX_PLATFORMS
+    because jax is already imported by interpreter start."""
+    platform = os.environ.get("ICLEAN_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
