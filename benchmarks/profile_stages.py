@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Stage-by-stage device timing of one cleaning iteration.
+
+Times each component of the hot loop (template build, amplitude fit, fused
+Pallas diagnostics vs the XLA path, median scalers, the composed iteration
+step, and the one-off preamble) on whatever device jax resolves — the tool
+behind performance work on the engine (engine/loop.py, stats/pallas_kernels.py).
+
+Methodology: each stage is jitted and run CHAIN times back-to-back feeding
+its own output where possible, with one host sync at the end — robust to
+device tunnels whose per-call latency would otherwise dominate (the same
+reason bench.py reports a differential per-iteration rate).
+
+Usage:
+  python benchmarks/profile_stages.py [--nsub N] [--nchan C] [--nbin B]
+  ICLEAN_PLATFORM=cpu python benchmarks/profile_stages.py --nsub 64 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nsub", type=int, default=1024)
+    ap.add_argument("--nchan", type=int, default=4096)
+    ap.add_argument("--nbin", type=int, default=128)
+    ap.add_argument("--chain", type=int, default=10,
+                    help="calls per timing (one sync at the end)")
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    from iterative_cleaner_tpu.utils import apply_platform_override
+
+    apply_platform_override()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from iterative_cleaner_tpu.engine.loop import (
+        dispersed_residual_base, iteration_step, prepare_cube_jax)
+    from iterative_cleaner_tpu.ops.dsp import (
+        fit_template_amplitudes, rotate_bins, weighted_template)
+    from iterative_cleaner_tpu.stats.masked_jax import (
+        cell_diagnostics_jax, scale_and_combine)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    print(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}  "
+          f"cube {args.nsub}x{args.nchan}x{args.nbin} f32")
+
+    rng = np.random.default_rng(0)
+    cube = jnp.asarray(
+        rng.normal(size=(args.nsub, args.nchan, args.nbin)).astype(np.float32))
+    weights = jnp.ones((args.nsub, args.nchan), jnp.float32)
+    freqs = jnp.asarray(
+        np.linspace(1300, 1500, args.nchan).astype(np.float32))
+    cell_mask = weights == 0
+
+    prep = jax.jit(lambda c, f: prepare_cube_jax(
+        c, f, 26.76, 1400.0, 0.714, baseline_duty=0.15, rotation="fourier"))
+    ded, shifts = prep(cube, freqs)
+    ded.block_until_ready()
+    base_fn = jax.jit(lambda d, s: dispersed_residual_base(
+        d, s, pulse_slice=(0, 0), pulse_scale=1.0, pulse_active=False,
+        rotation="fourier"))
+    disp_base = base_fn(ded, shifts)
+    disp_base.block_until_ready()
+
+    def timeit(name, fn, *fargs, n=args.chain):
+        out = fn(*fargs)                      # compile + warm
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn(*fargs)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / n)
+        print(f"  {name:36s} {best * 1e3:9.3f} ms")
+        return out
+
+    template = timeit("weighted_template (+x1e4)", jax.jit(
+        lambda d, w: weighted_template(d, w, jnp) * 10000.0), ded, weights)
+    rot_t = jax.jit(lambda t, s: rotate_bins(
+        jnp.broadcast_to(t, (args.nchan, args.nbin)), s, jnp,
+        method="fourier"))(template, shifts)
+    timeit("rotate template (per-chan)", jax.jit(
+        lambda t, s: rotate_bins(jnp.broadcast_to(t, (args.nchan, args.nbin)),
+                                 s, jnp, method="fourier")), template, shifts)
+    timeit("fit_template_amplitudes", jax.jit(
+        lambda d, t: fit_template_amplitudes(d, t, jnp)), ded, template)
+
+    def xla_diags(ded, disp_base, rot_t, template, weights, cell_mask):
+        amps = fit_template_amplitudes(ded, template, jnp)
+        resid = amps[:, :, None] * rot_t[None] - disp_base
+        return cell_diagnostics_jax(resid * weights[:, :, None], cell_mask,
+                                    "dft" if on_tpu else "fft")
+
+    diags = timeit("cell diagnostics (xla)", jax.jit(xla_diags),
+                   ded, disp_base, rot_t, template, weights, cell_mask)
+    if on_tpu and args.nbin <= 256:
+        from iterative_cleaner_tpu.stats.pallas_kernels import (
+            cell_diagnostics_pallas)
+
+        timeit("cell diagnostics (fused pallas)",
+               jax.jit(cell_diagnostics_pallas),
+               ded, disp_base, rot_t, template, weights, cell_mask)
+    timeit("scale_and_combine (sort)", jax.jit(
+        lambda d, m: scale_and_combine(d, m, 5.0, 5.0, "sort")),
+        diags, cell_mask)
+    if on_tpu:
+        timeit("scale_and_combine (pallas)", jax.jit(
+            lambda d, m: scale_and_combine(d, m, 5.0, 5.0, "pallas")),
+            diags, cell_mask)
+
+    for label, median_impl, stats_impl in (
+            ("iteration_step (xla/sort)", "sort", "xla"),
+            ("iteration_step (fused/pallas)", "pallas", "fused")):
+        if not on_tpu and "pallas" in label:
+            continue
+        if stats_impl == "fused" and args.nbin > 256:
+            continue
+
+        def one_iter(ded, disp_base, weights, cell_mask, shifts,
+                     _mi=median_impl, _si=stats_impl):
+            new_w, _ = iteration_step(
+                ded, disp_base, weights, weights, cell_mask, shifts,
+                chanthresh=5.0, subintthresh=5.0, pulse_slice=(0, 0),
+                pulse_scale=1.0, pulse_active=False, rotation="fourier",
+                fft_mode="dft" if on_tpu else "fft",
+                median_impl=_mi, stats_impl=_si)
+            return new_w
+
+        timeit(label, jax.jit(one_iter),
+               ded, disp_base, weights, cell_mask, shifts)
+
+    timeit("preamble: prepare_cube", prep, cube, freqs, n=2)
+    timeit("preamble: dispersed_residual_base", base_fn, ded, shifts, n=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,11 @@ class Archive:
     filename: str = ""
     pol_state: str = "Intensity"
     dedispersed: bool = False  # True once channel delays have been removed
+    # DATA encoding when (re)written as PSRFITS: 16 = int16 + per-(pol,chan)
+    # scale/offset (the common on-disk layout), 32 = float32.  Set by
+    # io.psrfits.load_psrfits from the source file so cleaned outputs keep
+    # their input's fidelity; other loaders leave the 16 default.
+    psrfits_nbits: int = 16
 
     def __post_init__(self) -> None:
         if self.data.ndim != 4:

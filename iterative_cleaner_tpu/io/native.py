@@ -11,7 +11,8 @@ Layout (all little-endian):
   0   8   magic  b"ICAR\\x00\\x01\\x00\\x00" (version 1)
   8   4*u32   nsub, npol, nchan, nbin
   24  6*f64   period_s, dm, centre_freq_mhz, mjd_start, mjd_end, reserved
-  72  u32     flags (bit0: dedispersed), u32 pol_state enum
+  72  u32     flags (bit0: dedispersed, bit1: float32 PSRFITS re-save
+              encoding), u32 pol_state enum
   80  64s     source (utf-8, NUL padded)
   144 f64[nchan]              freqs_mhz
   ... f32[nsub,nchan]         weights
@@ -133,7 +134,10 @@ def _load_lib():
 
 
 def _pack_header(ar: Archive) -> bytes:
-    flags = 1 if ar.dedispersed else 0
+    # flags bit0: dedispersed; bit1: PSRFITS re-save encoding is float32
+    # (psrfits_nbits == 32) — old files leave it unset, matching the
+    # dataclass default of 16
+    flags = (1 if ar.dedispersed else 0) | (2 if ar.psrfits_nbits == 32 else 0)
     return _HEADER.pack(
         MAGIC, ar.nsub, ar.npol, ar.nchan, ar.nbin,
         ar.period_s, ar.dm, ar.centre_freq_mhz, ar.mjd_start, ar.mjd_end, 0.0,
@@ -151,6 +155,7 @@ def _unpack_header(buf: bytes):
         nsub=nsub, npol=npol, nchan=nchan, nbin=nbin, period_s=period, dm=dm,
         centre_freq_mhz=cfreq, mjd_start=mjd0, mjd_end=mjd1,
         dedispersed=bool(flags & 1), pol_state=POL_STATES[pol_idx],
+        psrfits_nbits=32 if flags & 2 else 16,
         source=source.split(b"\x00", 1)[0].decode("utf-8"),
     )
 
@@ -216,7 +221,7 @@ def load_icar(path: str) -> Archive:
         filename=path,
         **{k: meta[k] for k in ("period_s", "dm", "centre_freq_mhz",
                                 "mjd_start", "mjd_end", "dedispersed",
-                                "pol_state", "source")},
+                                "pol_state", "psrfits_nbits", "source")},
     )
 
 
@@ -247,7 +252,8 @@ def _load_icar_native(path: str) -> Archive:
             freqs_mhz=freqs, filename=path,
             **{k: meta[k] for k in ("period_s", "dm", "centre_freq_mhz",
                                     "mjd_start", "mjd_end", "dedispersed",
-                                    "pol_state", "source")},
+                                    "pol_state", "psrfits_nbits",
+                                    "source")},
         )
     finally:
         lib.icar_close(handle)

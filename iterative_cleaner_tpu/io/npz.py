@@ -56,6 +56,7 @@ def _write_npz(f, ar: Archive) -> None:
         source=np.array(ar.source),
         pol_state=np.array(ar.pol_state),
         dedispersed=np.array(ar.dedispersed),
+        psrfits_nbits=np.array(ar.psrfits_nbits),
     )
 
 
@@ -86,6 +87,9 @@ def load_archive(path: str) -> Archive:
             source=str(z["source"]),
             pol_state=str(z["pol_state"]),
             dedispersed=bool(z["dedispersed"]),
+            # key added later; old containers default like the dataclass
+            psrfits_nbits=int(z["psrfits_nbits"])
+            if "psrfits_nbits" in z.files else 16,
             filename=path,
             **kwargs,
         )

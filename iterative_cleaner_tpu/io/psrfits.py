@@ -186,15 +186,20 @@ def _iter_hdus(buf: memoryview):
 # Writer
 # ---------------------------------------------------------------------------
 
-def save_psrfits(ar: Archive, path: str, nbits: int = 16) -> None:
+def save_psrfits(ar: Archive, path: str, nbits: int = None) -> None:
     """Write a fold-mode PSRFITS archive.
 
     ``nbits=16`` stores DATA as int16 with per-(pol, channel) DAT_SCL/DAT_OFFS
     (the common on-disk layout; quantisation error ~ span/65534 per cell);
-    ``nbits=32`` stores float32 (exact for float32-precision cubes).  Cubes
-    containing non-finite values are always stored float32 — int16 scaling
-    is undefined for NaN/Inf, and float32 round-trips them.
+    ``nbits=32`` stores float32 (exact for float32-precision cubes).  The
+    default (None) follows ``ar.psrfits_nbits`` — the source file's own
+    encoding for archives loaded from PSRFITS — so a clean round-trip never
+    degrades fidelity.  Cubes containing non-finite values are always
+    stored float32 — int16 scaling is undefined for NaN/Inf, and float32
+    round-trips them.
     """
+    if nbits is None:
+        nbits = ar.psrfits_nbits
     if nbits not in (16, 32):
         raise ValueError("nbits must be 16 (int16+scale) or 32 (float32)")
     nsub, npol, nchan, nbin = ar.nsub, ar.npol, ar.nchan, ar.nbin
@@ -353,9 +358,9 @@ def _configure_psrfits(lib):
     lib.psrfits_dims.argtypes = [ctypes.c_void_p] + [u32p] * 4
     dp = ctypes.POINTER(ctypes.c_double)
     ip = ctypes.POINTER(ctypes.c_int)
-    lib.psrfits_meta.restype = ctypes.c_int
-    lib.psrfits_meta.argtypes = [ctypes.c_void_p] + [dp] * 5 + \
-        [ip, ip, ctypes.c_char_p]
+    lib.psrfits_meta_v2.restype = ctypes.c_int
+    lib.psrfits_meta_v2.argtypes = [ctypes.c_void_p] + [dp] * 5 + \
+        [ip, ip, ip, ctypes.c_char_p]
     lib.psrfits_read.restype = ctypes.c_int
     lib.psrfits_read.argtypes = [ctypes.c_void_p, dp, dp, dp]
     lib.psrfits_close.restype = None
@@ -437,9 +442,11 @@ def _load_psrfits_native(path: str):
         nsub, npol, nchan, nbin = (d.value for d in dims)
         meta = [ctypes.c_double() for _ in range(5)]
         dedisp, pol_code = ctypes.c_int(), ctypes.c_int()
+        data_nbits = ctypes.c_int()
         source = ctypes.create_string_buffer(64)
-        lib.psrfits_meta(handle, *[ctypes.byref(m) for m in meta],
-                         ctypes.byref(dedisp), ctypes.byref(pol_code), source)
+        lib.psrfits_meta_v2(handle, *[ctypes.byref(m) for m in meta],
+                         ctypes.byref(dedisp), ctypes.byref(pol_code),
+                         ctypes.byref(data_nbits), source)
         data = np.empty((nsub, npol, nchan, nbin), dtype=np.float64)
         weights = np.empty((nsub, nchan), dtype=np.float64)
         freqs = np.empty(nchan, dtype=np.float64)
@@ -463,6 +470,7 @@ def _load_psrfits_native(path: str):
         mjd_start=mjd0, mjd_end=mjd1, filename=path,
         pol_state=POL_STATES[pol_code.value],
         dedispersed=bool(dedisp.value),
+        psrfits_nbits=data_nbits.value,
     )
 
 
@@ -574,6 +582,7 @@ def _parse_psrfits(buf: memoryview, path: str) -> Archive:
         filename=path,
         pol_state=pol_state,
         dedispersed=bool(_as_int(sub, "DEDISP", 0)),
+        psrfits_nbits=16 if dcode == "I" else 32,
     )
 
 

@@ -1,7 +1,11 @@
 // Native PSRFITS fold-mode reader.
 //
 // Implements the C ABI consumed by iterative_cleaner_tpu/io/psrfits.py:
-//   psrfits_open / psrfits_dims / psrfits_meta / psrfits_read / psrfits_close
+//   psrfits_open / psrfits_dims / psrfits_meta_v2 / psrfits_read /
+//   psrfits_close  (meta is version-suffixed: extending its out-params must
+//   rename the symbol so a stale prebuilt library fails with AttributeError
+//   — which triggers the Python side's rebuild — instead of overflowing a
+//   caller buffer)
 //
 // Mirrors the supported subset defined by the pure-Python reader in
 // iterative_cleaner_tpu/io/psrfits.py (the authoritative spec, which is also
@@ -369,9 +373,9 @@ int psrfits_dims(void* handle, uint32_t* nsub, uint32_t* npol,
   return 0;
 }
 
-int psrfits_meta(void* handle, double* period, double* dm, double* cfreq,
+int psrfits_meta_v2(void* handle, double* period, double* dm, double* cfreq,
                  double* mjd_start, double* mjd_end, int* dedisp,
-                 int* pol_code, char* source64) {
+                 int* pol_code, int* data_nbits, char* source64) {
   auto* h = static_cast<PsrfitsHandle*>(handle);
   *period = h->period;
   *dm = h->dm;
@@ -380,6 +384,7 @@ int psrfits_meta(void* handle, double* period, double* dm, double* cfreq,
   *mjd_end = h->mjd_end;
   *dedisp = h->dedisp;
   *pol_code = h->pol_code;
+  *data_nbits = h->cols["DATA"].code == 'I' ? 16 : 32;
   std::memset(source64, 0, 64);
   std::memcpy(source64, h->source.c_str(),
               h->source.size() < 63 ? h->source.size() : 63);

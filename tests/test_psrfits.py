@@ -304,6 +304,35 @@ def test_no_period_anywhere_is_an_error(tmp_path):
     assert psrfits._load_psrfits_native(path) is None  # native stays in sync
 
 
+def test_roundtrip_preserves_source_encoding(tmp_path):
+    """A float32-DATA archive re-saved by default stays float32 (no silent
+    int16 quantisation of cleaned outputs); int16 sources stay int16."""
+    ar, _ = _archive(dtype=np.float32, n_prezapped=0)
+    p32 = str(tmp_path / "src32.sf")
+    psrfits.save_psrfits(ar, p32, nbits=32)
+    back = psrfits.load_psrfits(p32)
+    assert back.psrfits_nbits == 32
+    out = str(tmp_path / "out.sf")
+    psrfits.save_psrfits(back, out)  # default follows the source encoding
+    again = psrfits.load_psrfits(out, prefer_native=False)
+    np.testing.assert_array_equal(again.data, back.data)
+
+    p16 = str(tmp_path / "src16.sf")
+    psrfits.save_psrfits(ar, p16, nbits=16)
+    b16 = psrfits.load_psrfits(p16, prefer_native=False)
+    assert b16.psrfits_nbits == 16
+    nat = psrfits._load_psrfits_native(p16)
+    if nat is not None:
+        assert nat.psrfits_nbits == 16
+
+    # the marker survives the other containers, so .sf -> .npz/.icar -> .sf
+    # keeps fidelity too
+    for ext in ("npz", "icar"):
+        mid = str(tmp_path / f"mid.{ext}")
+        save_archive(back, mid)
+        assert load_archive(mid).psrfits_nbits == 32, ext
+
+
 def test_fresh_lib_copy_loads_with_symbols():
     """The stale-library recovery path loads a unique-path copy (glibc
     caches dlopen by path, so an in-place rebuild is invisible otherwise)."""
