@@ -49,6 +49,19 @@ def resolve_fft_mode(fft_mode: str, dtype) -> str:
     return "dft" if on_tpu and jnp.dtype(dtype) == jnp.float32 else "fft"
 
 
+def resolve_stats_frame(stats_frame: str, dtype) -> str:
+    """'auto' resolves to the reference-exact dispersed frame.  The
+    dedispersed frame (one-third less HBM traffic, no cube-sized rotation
+    buffer) stays strictly opt-in: under the default fourier rotation its
+    masks can differ from the reference's on borderline cells — the
+    fractional rotation's interpolation ringing inflates the ptp diagnostic
+    of spiky residuals (see CleanConfig.stats_frame)."""
+    del dtype
+    if stats_frame != "auto":
+        return stats_frame
+    return "dispersed"
+
+
 def resolve_stats_impl(stats_impl: str, dtype, nbin: int,
                        fft_mode_resolved: str) -> str:
     """'auto' picks the fused Pallas diagnostics kernel on single-device TPU
@@ -71,7 +84,7 @@ def resolve_stats_impl(stats_impl: str, dtype, nbin: int,
 def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, baseline_duty,
                    unload_res, fft_mode="fft", median_impl="sort",
-                   stats_impl="xla"):
+                   stats_impl="xla", stats_frame="dispersed"):
     """Build (and cache) the jitted whole-archive cleaning program for one
     static configuration."""
 
@@ -86,7 +99,7 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             subintthresh=subintthresh, pulse_slice=pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
-            stats_impl=stats_impl,
+            stats_impl=stats_impl, stats_frame=stats_frame,
         )
         if not unload_res:
             return outs, None
@@ -115,6 +128,7 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         fft_mode, resolve_median_impl(config.median_impl, dtype),
         resolve_stats_impl(config.stats_impl, dtype, cube.shape[-1],
                            fft_mode),
+        resolve_stats_frame(config.stats_frame, dtype),
     )
     outs, resid = fn(
         jnp.asarray(cube, dtype=dtype),

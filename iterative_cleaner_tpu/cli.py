@@ -87,6 +87,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "fusion, the fused Pallas TPU kernel (fit + "
                              "residual + all four diagnostics in one pass), "
                              "or auto (fused on TPU float32).")
+    parser.add_argument("--stats_frame",
+                        choices=("auto", "dispersed", "dedispersed"),
+                        default="auto",
+                        help="Frame the detection statistics run in on the "
+                             "jax path: 'dispersed' (= auto) re-rotates the "
+                             "residual exactly like the reference; "
+                             "'dedispersed' is an opt-in throughput mode "
+                             "that skips the rotation — one-third less "
+                             "memory traffic, but with the default fourier "
+                             "rotation borderline cells (scores near 1) can "
+                             "zap differently from the reference.")
     parser.add_argument("--checkpoint", type=str, default="",
                         metavar="DIR",
                         help="Checkpoint directory: each archive's cleaning "
@@ -136,6 +147,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         rotation=args.rotation,
         median_impl=args.median_impl,
         stats_impl=args.stats_impl,
+        stats_frame=args.stats_frame,
         unload_res=args.unload_res,
         record_history=args.record_history,
     )

@@ -100,7 +100,8 @@ class _Carry(NamedTuple):
 def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
                    back_shifts, *, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, fft_mode="fft",
-                   median_impl="sort", stats_impl="xla"):
+                   median_impl="sort", stats_impl="xla",
+                   stats_frame="dispersed"):
     """One cleaning iteration: template -> fit -> residual stats -> new weights.
 
     ``weights`` are the previous iteration's (template) weights;
@@ -110,7 +111,11 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
     the per-cell statistics — no cube-sized rotation and no materialised
     residual.  With ``stats_impl='fused'`` the whole per-cell half (fit,
     residual, weighting, four diagnostics) runs as one Pallas kernel in two
-    cube reads.  Returns (new_weights, scores).
+    cube reads.  With ``stats_frame='dedispersed'`` the statistics run on
+    the dedispersed residual directly (bin reductions are rotation-
+    invariant up to interpolation rounding): ``disp_base`` may be None and
+    the fused kernel reads the cube once instead of twice.  Returns
+    (new_weights, scores).
     """
     if stats_impl == "fused" and fft_mode == "fft":
         raise ValueError(
@@ -120,24 +125,39 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
     template = weighted_template(ded_cube, weights, jnp) * 10000.0  # ref :94
     m = _pulse_window(nbin, pulse_slice, pulse_scale, pulse_active,
                       ded_cube.dtype)
-    t = template if m is None else template * m
-    # per-channel rotation of the (nbin,) template back to the dispersed
-    # frame (reference :104 rotates the whole residual cube; linearity lets
-    # the cube part live in disp_base)
-    rot_t = rotate_bins(jnp.broadcast_to(t, (nchan, nbin)), back_shifts, jnp,
-                        method=rotation)
-    if stats_impl == "fused":
-        from iterative_cleaner_tpu.stats.pallas_kernels import (
-            cell_diagnostics_pallas,
-        )
+    if stats_frame == "dedispersed":
+        window = jnp.ones((nbin,), ded_cube.dtype) if m is None else m
+        if stats_impl == "fused":
+            from iterative_cleaner_tpu.stats.pallas_kernels import (
+                cell_diagnostics_pallas_dedisp,
+            )
 
-        diags = cell_diagnostics_pallas(ded_cube, disp_base, rot_t, template,
-                                        orig_weights, cell_mask)
+            diags = cell_diagnostics_pallas_dedisp(
+                ded_cube, template, window, orig_weights, cell_mask)
+        else:
+            amps = fit_template_amplitudes(ded_cube, template, jnp)
+            resid = (amps[:, :, None] * template - ded_cube) * window
+            weighted = resid * orig_weights[:, :, None]
+            diags = cell_diagnostics_jax(weighted, cell_mask, fft_mode)
     else:
-        amps = fit_template_amplitudes(ded_cube, template, jnp)
-        resid = amps[:, :, None] * rot_t[None] - disp_base  # ref :277-279
-        weighted = resid * orig_weights[:, :, None]  # apply_weights, :291-297
-        diags = cell_diagnostics_jax(weighted, cell_mask, fft_mode)
+        t = template if m is None else template * m
+        # per-channel rotation of the (nbin,) template back to the dispersed
+        # frame (reference :104 rotates the whole residual cube; linearity
+        # lets the cube part live in disp_base)
+        rot_t = rotate_bins(jnp.broadcast_to(t, (nchan, nbin)), back_shifts,
+                            jnp, method=rotation)
+        if stats_impl == "fused":
+            from iterative_cleaner_tpu.stats.pallas_kernels import (
+                cell_diagnostics_pallas,
+            )
+
+            diags = cell_diagnostics_pallas(ded_cube, disp_base, rot_t,
+                                            template, orig_weights, cell_mask)
+        else:
+            amps = fit_template_amplitudes(ded_cube, template, jnp)
+            resid = amps[:, :, None] * rot_t[None] - disp_base  # ref :277-279
+            weighted = resid * orig_weights[:, :, None]  # apply_weights :291-297
+            diags = cell_diagnostics_jax(weighted, cell_mask, fft_mode)
     scores = scale_and_combine(diags, cell_mask, chanthresh, subintthresh,
                                median_impl)
     new_weights = jnp.where(scores >= 1.0, 0.0, orig_weights)  # ref :300-305
@@ -149,7 +169,8 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
                           pulse_slice, pulse_scale, pulse_active,
                           rotation, fft_mode="fft",
                           median_impl="sort",
-                          stats_impl="xla") -> CleanOutputs:
+                          stats_impl="xla",
+                          stats_frame="dispersed") -> CleanOutputs:
     """Run the full iteration loop on an already-prepared cube.
 
     ``ded_cube``: baseline-removed, dedispersed (nsub, nchan, nbin) cube.
@@ -159,10 +180,13 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
     nsub, nchan, _ = ded_cube.shape
     wdtype = orig_weights.dtype
     cell_mask = orig_weights == 0  # ref :115 (mask where weight exactly 0)
-    disp_base = dispersed_residual_base(
-        ded_cube, back_shifts, pulse_slice=pulse_slice,
-        pulse_scale=pulse_scale, pulse_active=pulse_active, rotation=rotation,
-    )
+    disp_base = None
+    if stats_frame != "dedispersed":  # the dedispersed frame never needs it
+        disp_base = dispersed_residual_base(
+            ded_cube, back_shifts, pulse_slice=pulse_slice,
+            pulse_scale=pulse_scale, pulse_active=pulse_active,
+            rotation=rotation,
+        )
 
     history = jnp.zeros((max_iter + 1, nsub, nchan), dtype=wdtype)
     history = history.at[0].set(orig_weights)  # pre-loop seed, ref :78-79
@@ -191,6 +215,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
             pulse_slice=pulse_slice, pulse_scale=pulse_scale,
             pulse_active=pulse_active, rotation=rotation, fft_mode=fft_mode,
             median_impl=median_impl, stats_impl=stats_impl,
+            stats_frame=stats_frame,
         )
         seen = jnp.arange(max_iter + 1) < c.count
         matches = jnp.all(c.history == new_w[None], axis=(1, 2)) & seen

@@ -186,7 +186,7 @@ def _iter_hdus(buf: memoryview):
 # Writer
 # ---------------------------------------------------------------------------
 
-def save_psrfits(ar: Archive, path: str, nbits: int = None) -> None:
+def save_psrfits(ar: Archive, path: str, nbits: "int | None" = None) -> None:
     """Write a fold-mode PSRFITS archive.
 
     ``nbits=16`` stores DATA as int16 with per-(pol, channel) DAT_SCL/DAT_OFFS

@@ -23,7 +23,8 @@ from iterative_cleaner_tpu.config import CleanConfig
 @functools.lru_cache(maxsize=None)
 def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                            pulse_scale, pulse_active, rotation, baseline_duty,
-                           fft_mode, median_impl="sort"):
+                           fft_mode, median_impl="sort",
+                           stats_frame="dispersed"):
     """Jitted batched cleaner: every per-archive input gains a leading batch
     axis; scalars (dm, period, ref freq) are per-archive vectors."""
     import jax
@@ -43,6 +44,7 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             subintthresh=subintthresh, pulse_slice=pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
+            stats_frame=stats_frame,
         )
 
     return jax.jit(jax.vmap(one))
@@ -147,7 +149,10 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
         pad = (-n) % per
     args = stack_archive_batch(archives, pad, jnp.dtype(config.dtype))
 
-    from iterative_cleaner_tpu.backends.jax_backend import resolve_fft_mode
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fft_mode,
+        resolve_stats_frame,
+    )
 
     # 'auto' stays on the sort path here: vmap batches a pallas_call by
     # serialising over a grid axis, which forfeits the kernel's advantage.
@@ -158,6 +163,7 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
         config.rotation, config.baseline_duty,
         resolve_fft_mode(config.fft_mode, jnp.dtype(config.dtype)),
         median_impl,
+        resolve_stats_frame(config.stats_frame, jnp.dtype(config.dtype)),
     )
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

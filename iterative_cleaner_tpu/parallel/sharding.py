@@ -24,7 +24,8 @@ from iterative_cleaner_tpu.config import CleanConfig
 @functools.lru_cache(maxsize=None)
 def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
                            pulse_slice, pulse_scale, pulse_active, rotation,
-                           baseline_duty, fft_mode, median_impl="sort"):
+                           baseline_duty, fft_mode, median_impl="sort",
+                           stats_frame="dispersed"):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -48,6 +49,7 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
             subintthresh=subintthresh, pulse_slice=pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
+            stats_frame=stats_frame,
         )
 
     fn = jax.jit(
@@ -68,7 +70,10 @@ def clean_archive_sharded(archive: Archive, config: CleanConfig,
     import jax
     import jax.numpy as jnp
 
-    from iterative_cleaner_tpu.backends.jax_backend import resolve_fft_mode
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fft_mode,
+        resolve_stats_frame,
+    )
 
     dtype = jnp.dtype(config.dtype)
     # 'auto' stays on the sort path here: a pallas_call inside a GSPMD
@@ -79,6 +84,7 @@ def clean_archive_sharded(archive: Archive, config: CleanConfig,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
         config.rotation, config.baseline_duty,
         resolve_fft_mode(config.fft_mode, dtype), median_impl,
+        resolve_stats_frame(config.stats_frame, dtype),
     )
     with mesh:
         outs = fn(

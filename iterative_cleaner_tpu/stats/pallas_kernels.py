@@ -147,20 +147,11 @@ _MA_FILL_F32 = np.float32(MA_FILL)
 FUSED_STATS_MAX_NBIN = 256
 
 
-def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
-                       cos_ref, sin_ref, tt_ref,
-                       std_ref, mean_ref, ptp_ref, fft_ref):
-    nbin = ded_ref.shape[-1]
-    t = t_ref[0]                                    # (B,)
-    tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
-    ded = ded_ref[:]                                # (S, C, B)
-    # closed-form fit (dsp.fit_template_amplitudes, same ops/order)
-    tp = jnp.sum(ded * t[None, None, :], axis=2)
-    amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
-    resid = amp[:, :, None] * rott_ref[:][None] - disp_ref[:]
-    wres = resid * w_ref[:][:, :, None]             # apply_weights
-    mask = m_ref[:]                                 # (S, C) bool
-
+def _write_diags(wres, mask, cos_ref, sin_ref,
+                 std_ref, mean_ref, ptp_ref, fft_ref):
+    """Shared diagnostics tail: the four per-cell statistics of a weighted
+    residual tile (S, C, B), written to the output refs."""
+    nbin = wres.shape[-1]
     inv_n = np.float32(1.0 / nbin)
     mean = jnp.sum(wres, axis=2) * inv_n
     mean_ref[:] = jnp.where(mask, np.float32(0.0), mean)
@@ -186,66 +177,121 @@ def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
     fft_ref[:] = jnp.sqrt(jnp.max(mag2, axis=1)).reshape(ptp_ref.shape)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _cell_stats_call(ded, disp_base, rot_t, template, tt_info, weights,
-                     cell_mask, cos_t, sin_t, interpret):
-    nsub, nchan, nbin = ded.shape
-    pad_s = (-nsub) % _S_BLK
-    pad_c = (-nchan) % _C_BLK
-    if pad_s or pad_c:
-        ded = jnp.pad(ded, ((0, pad_s), (0, pad_c), (0, 0)))
-        disp_base = jnp.pad(disp_base, ((0, pad_s), (0, pad_c), (0, 0)))
-        rot_t = jnp.pad(rot_t, ((0, pad_c), (0, 0)))
-        weights = jnp.pad(weights, ((0, pad_s), (0, pad_c)))
-        cell_mask = jnp.pad(cell_mask, ((0, pad_s), (0, pad_c)),
-                            constant_values=True)
-    ns, nc = nsub + pad_s, nchan + pad_c
-    grid = (ns // _S_BLK, nc // _C_BLK)
-    cell_spec = pl.BlockSpec((_S_BLK, _C_BLK), lambda i, j: (i, j),
-                             memory_space=pltpu.VMEM)
-    outs = pl.pallas_call(
-        _cell_stats_kernel,
-        out_shape=[jax.ShapeDtypeStruct((ns, nc), jnp.float32)] * 4,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((_S_BLK, _C_BLK, nbin), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_S_BLK, _C_BLK, nbin), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_C_BLK, nbin), lambda i, j: (j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, nbin), lambda i, j: (0, 0),
-                         memory_space=pltpu.VMEM),
-            cell_spec,
-            cell_spec,
+def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
+                       cos_ref, sin_ref, tt_ref,
+                       std_ref, mean_ref, ptp_ref, fft_ref):
+    t = t_ref[0]                                    # (B,)
+    tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
+    ded = ded_ref[:]                                # (S, C, B)
+    # closed-form fit (dsp.fit_template_amplitudes, same ops/order)
+    tp = jnp.sum(ded * t[None, None, :], axis=2)
+    amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
+    resid = amp[:, :, None] * rott_ref[:][None] - disp_ref[:]
+    wres = resid * w_ref[:][:, :, None]             # apply_weights
+    _write_diags(wres, m_ref[:], cos_ref, sin_ref,
+                 std_ref, mean_ref, ptp_ref, fft_ref)
+
+
+def _cell_stats_dedisp_kernel(ded_ref, t_ref, win_ref, w_ref, m_ref,
+                              cos_ref, sin_ref, tt_ref,
+                              std_ref, mean_ref, ptp_ref, fft_ref):
+    """Dedispersed-frame variant: one cube read.  The residual never leaves
+    the dedispersed frame, so there is no disp_base input and no per-channel
+    rotated template — ``resid = (amp*t - ded) * window``."""
+    t = t_ref[0]                                    # (B,)
+    win = win_ref[0]                                # (B,) pulse-region window
+    tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
+    ded = ded_ref[:]                                # (S, C, B)
+    tp = jnp.sum(ded * t[None, None, :], axis=2)
+    amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
+    resid = (amp[:, :, None] * t[None, None, :] - ded) * win[None, None, :]
+    wres = resid * w_ref[:][:, :, None]             # apply_weights
+    _write_diags(wres, m_ref[:], cos_ref, sin_ref,
+                 std_ref, mean_ref, ptp_ref, fft_ref)
+
+
+class _FusedScaffold:
+    """Shared launch scaffolding for the fused cell kernels: pads the
+    cell-grid inputs to block multiples (padding cells masked), and owns
+    the grid/specs/out-slicing both kernels must agree on."""
+
+    def __init__(self, nsub, nchan, nbin):
+        self.nsub, self.nchan, self.nbin = nsub, nchan, nbin
+        self.pad_s = (-nsub) % _S_BLK
+        self.pad_c = (-nchan) % _C_BLK
+        self.ns, self.nc = nsub + self.pad_s, nchan + self.pad_c
+        self.grid = (self.ns // _S_BLK, self.nc // _C_BLK)
+        self.cell_spec = pl.BlockSpec((_S_BLK, _C_BLK), lambda i, j: (i, j),
+                                      memory_space=pltpu.VMEM)
+        self.cube_spec = pl.BlockSpec((_S_BLK, _C_BLK, nbin),
+                                      lambda i, j: (i, j, 0),
+                                      memory_space=pltpu.VMEM)
+        self.chan_row_spec = pl.BlockSpec((_C_BLK, nbin), lambda i, j: (j, 0),
+                                          memory_space=pltpu.VMEM)
+        self.row_spec = pl.BlockSpec((1, nbin), lambda i, j: (0, 0),
+                                     memory_space=pltpu.VMEM)
+
+    def pad_cube(self, x):
+        return jnp.pad(x, ((0, self.pad_s), (0, self.pad_c), (0, 0))) \
+            if self.pad_s or self.pad_c else x
+
+    def pad_chan_row(self, x):
+        return jnp.pad(x, ((0, self.pad_c), (0, 0))) if self.pad_c else x
+
+    def pad_cells(self, weights, cell_mask):
+        if not (self.pad_s or self.pad_c):
+            return weights, cell_mask
+        pads = ((0, self.pad_s), (0, self.pad_c))
+        return (jnp.pad(weights, pads),
+                jnp.pad(cell_mask, pads, constant_values=True))
+
+    def launch(self, kernel, inputs, in_specs, cos_t, sin_t, tt_info,
+               interpret):
+        table_specs = [
             pl.BlockSpec(cos_t.shape, lambda i, j: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(sin_t.shape, lambda i, j: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 2), lambda i, j: (0, 0),
                          memory_space=pltpu.SMEM),
-        ],
-        out_specs=[cell_spec] * 4,
-        interpret=interpret,
-    )(ded, disp_base, rot_t, template[None, :], weights, cell_mask,
-      cos_t, sin_t, tt_info)
-    return tuple(o[:nsub, :nchan] for o in outs)
+        ]
+        outs = pl.pallas_call(
+            kernel,
+            out_shape=[jax.ShapeDtypeStruct((self.ns, self.nc),
+                                            jnp.float32)] * 4,
+            grid=self.grid,
+            in_specs=list(in_specs) + table_specs,
+            out_specs=[self.cell_spec] * 4,
+            interpret=interpret,
+        )(*inputs, cos_t, sin_t, tt_info)
+        return tuple(o[: self.nsub, : self.nchan] for o in outs)
 
 
-def cell_diagnostics_pallas(ded, disp_base, rot_t, template, weights,
-                            cell_mask):
-    """Fused fit + residual + diagnostics (float32, TPU; interpreted
-    elsewhere).  Returns (d_std, d_mean, d_ptp, d_fft), each (nsub, nchan),
-    with the same masked-cell patches as the XLA path
-    (:func:`masked_jax.surgical_scores_jax`) and DFT-flavoured rFFT
-    magnitudes (:func:`masked_jax.rfft_magnitudes` mode='dft')."""
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _cell_stats_call(ded, disp_base, rot_t, template, tt_info, weights,
+                     cell_mask, cos_t, sin_t, interpret):
+    sc = _FusedScaffold(*ded.shape)
+    weights, cell_mask = sc.pad_cells(weights, cell_mask)
+    return sc.launch(
+        _cell_stats_kernel,
+        (sc.pad_cube(ded), sc.pad_cube(disp_base), sc.pad_chan_row(rot_t),
+         template[None, :], weights, cell_mask),
+        (sc.cube_spec, sc.cube_spec, sc.chan_row_spec, sc.row_spec,
+         sc.cell_spec, sc.cell_spec),
+        cos_t, sin_t, tt_info, interpret,
+    )
+
+
+def _fused_setup(ded, template):
+    """Shared validation + DFT tables + template-norm info for the fused
+    kernels.  Returns (cos_t, sin_t, tt_info, interpret)."""
     if ded.dtype != jnp.float32:
-        raise TypeError("cell_diagnostics_pallas requires float32, got %s"
+        raise TypeError("fused cell diagnostics require float32, got %s"
                         % ded.dtype)
     nbin = ded.shape[-1]
     if nbin > FUSED_STATS_MAX_NBIN:
         raise ValueError(
-            f"cell_diagnostics_pallas supports nbin <= {FUSED_STATS_MAX_NBIN} "
+            f"fused cell diagnostics support nbin <= {FUSED_STATS_MAX_NBIN} "
             f"(VMEM budget), got {nbin}; use stats_impl='xla' (or 'auto', "
             "which checks this)")
     nk = nbin // 2 + 1
@@ -261,9 +307,45 @@ def cell_diagnostics_pallas(ded, disp_base, rot_t, template, weights,
          (tt == 0).astype(jnp.float32)]
     )[None, :]
     interpret = jax.devices()[0].platform != "tpu"
+    return cos_t, sin_t, tt_info, interpret
+
+
+def cell_diagnostics_pallas(ded, disp_base, rot_t, template, weights,
+                            cell_mask):
+    """Fused fit + residual + diagnostics (float32, TPU; interpreted
+    elsewhere).  Returns (d_std, d_mean, d_ptp, d_fft), each (nsub, nchan),
+    with the same masked-cell patches as the XLA path
+    (:func:`masked_jax.surgical_scores_jax`) and DFT-flavoured rFFT
+    magnitudes (:func:`masked_jax.rfft_magnitudes` mode='dft')."""
+    cos_t, sin_t, tt_info, interpret = _fused_setup(ded, template)
     return _cell_stats_call(ded, disp_base, rot_t, template, tt_info,
                             weights.astype(jnp.float32),
                             cell_mask, cos_t, sin_t, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _cell_stats_dedisp_call(ded, template, window, tt_info, weights,
+                            cell_mask, cos_t, sin_t, interpret):
+    sc = _FusedScaffold(*ded.shape)
+    weights, cell_mask = sc.pad_cells(weights, cell_mask)
+    return sc.launch(
+        _cell_stats_dedisp_kernel,
+        (sc.pad_cube(ded), template[None, :], window[None, :],
+         weights, cell_mask),
+        (sc.cube_spec, sc.row_spec, sc.row_spec, sc.cell_spec, sc.cell_spec),
+        cos_t, sin_t, tt_info, interpret,
+    )
+
+
+def cell_diagnostics_pallas_dedisp(ded, template, window, weights, cell_mask):
+    """Dedispersed-frame fused diagnostics: one cube read per iteration
+    instead of two (engine stats_frame='dedispersed').  ``window`` is the
+    (nbin,) pulse-region multiplier (all ones when inactive)."""
+    cos_t, sin_t, tt_info, interpret = _fused_setup(ded, template)
+    return _cell_stats_dedisp_call(ded, template,
+                                   window.astype(jnp.float32), tt_info,
+                                   weights.astype(jnp.float32),
+                                   cell_mask, cos_t, sin_t, interpret)
 
 
 def masked_median_pallas(values, mask, axis):
