@@ -2,7 +2,14 @@
 """Benchmark: surgical-scrub cleaning throughput, jax/TPU vs the numpy oracle.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "cell-iters/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "cell-iters/s", "vs_baseline": N,
+   "platform": "tpu"|"cpu"|...}
+
+"platform" records the device the jax number actually came from — when the
+default accelerator is unreachable (dead tunnel) the bench falls back to a
+small CPU run instead of hanging, and that must be distinguishable.
+Env knobs: BENCH_SMALL=1 shrinks everything; BENCH_TIMEOUT (s) arms the
+hang watchdog; BENCH_PROBE_TIMEOUT (s) bounds the device probe.
 
 - value: per-iteration cell throughput (nsub * nchan / sec-per-iteration)
   for the compiled jax path on the high-res config (BASELINE.md config 3:
@@ -18,7 +25,9 @@ Prints ONE JSON line:
   per-cell-iteration rates are comparable; full-size oracle runs take tens
   of minutes on one CPU core).
 
-Environment knobs: BENCH_SMALL=1 shrinks everything for a quick smoke run.
+Environment knobs: BENCH_SMALL=1 shrinks everything for a quick smoke run;
+BENCH_TIMEOUT (s) arms the hang watchdog; BENCH_PROBE_TIMEOUT (s) bounds
+the device probe.
 """
 
 import json
@@ -122,7 +131,7 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=3):
         rate = raw_rate
         _log("differential timing unavailable (converged in one iteration "
              "or timer noise); reporting the raw rate")
-    return rate
+    return rate, dev.platform
 
 
 def bench_numpy(nsub, nchan, nbin, max_iter=5):
@@ -148,9 +157,47 @@ def bench_numpy(nsub, nchan, nbin, max_iter=5):
     return rate
 
 
+def _device_reachable(timeout_s: float) -> bool:
+    """Probe the default jax device in a subprocess: a tunnelled TPU plugin
+    whose tunnel is down blocks device enumeration forever (no in-process
+    timeout can interrupt PJRT init), so the probe must be killable.  A
+    probe that *errors* (rather than hangs) has its stderr surfaced — that
+    is a real fault (broken install, plugin mismatch), not a dead tunnel."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"device probe hung for {timeout_s:.0f}s (dead tunnel?); "
+             "raise BENCH_PROBE_TIMEOUT if the accelerator is just slow "
+             "to initialise")
+        return False
+    if out.returncode != 0:
+        tail = out.stderr.decode("utf-8", "replace").strip().splitlines()
+        _log("device probe FAILED (not a hang — likely a real fault):")
+        for line in tail[-8:]:
+            _log("  " + line)
+        return False
+    return True
+
+
 def main():
     from iterative_cleaner_tpu.utils import apply_platform_override
 
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+    if (not os.environ.get("ICLEAN_PLATFORM")
+            and not _device_reachable(probe_timeout)):
+        # Dead accelerator tunnel: fall back to CPU so the run still
+        # produces a (clearly labelled) number instead of hanging into
+        # the watchdog.
+        _log("default device unreachable (dead tunnel?); benching on CPU — "
+             "the reported rate is NOT a TPU number")
+        os.environ["ICLEAN_PLATFORM"] = "cpu"
+        os.environ.setdefault("BENCH_SMALL", "1")
     apply_platform_override()
     watchdog = _arm_watchdog(float(os.environ.get("BENCH_TIMEOUT", "1800")))
     small = os.environ.get("BENCH_SMALL") == "1"
@@ -163,10 +210,10 @@ def main():
 
     np_rate = bench_numpy(*np_cfg)
 
-    jax_rate = None
+    jax_rate = platform = None
     for cfg in (jax_cfg, (512, 4096, 128), (512, 2048, 128)):
         try:
-            jax_rate = bench_jax(*cfg)
+            jax_rate, platform = bench_jax(*cfg)
             jax_cfg = cfg
             break
         except Exception as e:  # OOM fallback ladder
@@ -180,6 +227,7 @@ def main():
         "value": round(jax_rate, 1),
         "unit": "cell-iters/s",
         "vs_baseline": round(jax_rate / np_rate, 2),
+        "platform": platform,
     }))
 
 
