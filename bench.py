@@ -157,40 +157,16 @@ def bench_numpy(nsub, nchan, nbin, max_iter=5):
     return rate
 
 
-def _device_reachable(timeout_s: float) -> bool:
-    """Probe the default jax device in a subprocess: a tunnelled TPU plugin
-    whose tunnel is down blocks device enumeration forever (no in-process
-    timeout can interrupt PJRT init), so the probe must be killable.  A
-    probe that *errors* (rather than hangs) has its stderr surfaced — that
-    is a real fault (broken install, plugin mismatch), not a dead tunnel."""
-    import subprocess
-
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        _log(f"device probe hung for {timeout_s:.0f}s (dead tunnel?); "
-             "raise BENCH_PROBE_TIMEOUT if the accelerator is just slow "
-             "to initialise")
-        return False
-    if out.returncode != 0:
-        tail = out.stderr.decode("utf-8", "replace").strip().splitlines()
-        _log("device probe FAILED (not a hang — likely a real fault):")
-        for line in tail[-8:]:
-            _log("  " + line)
-        return False
-    return True
-
-
 def main():
-    from iterative_cleaner_tpu.utils import apply_platform_override
+    from iterative_cleaner_tpu.utils import (
+        apply_platform_override,
+        device_reachable,
+    )
 
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
     if (not os.environ.get("ICLEAN_PLATFORM")
-            and not _device_reachable(probe_timeout)):
+            and not device_reachable(probe_timeout, log=_log,
+                                     knob_hint="BENCH_PROBE_TIMEOUT")):
         # Dead accelerator tunnel: fall back to CPU so the run still
         # produces a (clearly labelled) number instead of hanging into
         # the watchdog.

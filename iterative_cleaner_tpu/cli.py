@@ -344,17 +344,40 @@ def _run_batched(args) -> list:
 
 def main(argv=None) -> int:
     args = parse_arguments(argv)
-    from iterative_cleaner_tpu.utils import apply_platform_override
-
-    apply_platform_override()
-    from iterative_cleaner_tpu.utils.tracing import device_trace
+    from iterative_cleaner_tpu.utils import (
+        apply_platform_override,
+        device_reachable,
+    )
 
     if args.batch > 1 and (args.unload_res or args.checkpoint
                            or args.backend != "jax"
                            or args.stats_impl == "fused"):
+        # pure-argument validation first: never make a bad invocation wait
+        # out the device probe below before erroring
         build_parser().error(
             "--batch is incompatible with --unload_res/--checkpoint, "
             "requires --backend jax, and uses the vmap (xla) stats path")
+
+    # Probe the default device before the first jax computation: a dead
+    # accelerator tunnel otherwise hangs PJRT init forever.  Skipped when a
+    # platform is already chosen (ICLEAN_PLATFORM, or an in-process pin to
+    # plain cpu — the test/conftest configuration) or disabled with
+    # ICLEAN_PROBE_TIMEOUT=0.
+    probe_t = float(os.environ.get("ICLEAN_PROBE_TIMEOUT", "90"))
+    need_probe = (args.backend == "jax" and probe_t > 0
+                  and not os.environ.get("ICLEAN_PLATFORM"))
+    if need_probe:
+        import jax
+
+        need_probe = getattr(jax.config, "jax_platforms", None) != "cpu"
+    if need_probe and not device_reachable(
+            probe_t, knob_hint="ICLEAN_PROBE_TIMEOUT"):
+        # CPU fallback: identical masks, just slower.
+        print("WARNING: default jax device unreachable; cleaning on CPU "
+              "(set ICLEAN_PLATFORM to override)", file=sys.stderr)
+        os.environ["ICLEAN_PLATFORM"] = "cpu"
+    apply_platform_override()
+    from iterative_cleaner_tpu.utils.tracing import device_trace
 
     failed = []
     if args.batch > 1:

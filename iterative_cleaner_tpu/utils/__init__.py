@@ -13,3 +13,36 @@ def apply_platform_override() -> None:
         import jax
 
         jax.config.update("jax_platforms", platform)
+
+
+def device_reachable(timeout_s: float = 90.0, log=None,
+                     knob_hint: str = "") -> bool:
+    """Probe the default jax device in a killable subprocess.
+
+    A tunnelled TPU plugin whose tunnel is down blocks device enumeration
+    forever — no in-process timeout can interrupt PJRT init — so the probe
+    must be a subprocess.  Probe *errors* (exits, not hangs) get their
+    stderr surfaced through ``log``: those are real faults (broken install,
+    plugin mismatch), not dead tunnels."""
+    import subprocess
+    import sys
+
+    log = log or (lambda msg: print(msg, file=sys.stderr, flush=True))
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        hint = (f"; raise {knob_hint} if the accelerator is just slow to "
+                "initialise") if knob_hint else ""
+        log(f"device probe hung for {timeout_s:.0f}s (dead tunnel?){hint}")
+        return False
+    if out.returncode != 0:
+        log("device probe FAILED (not a hang — likely a real fault):")
+        for line in out.stderr.decode(
+                "utf-8", "replace").strip().splitlines()[-8:]:
+            log("  " + line)
+        return False
+    return True
