@@ -1,0 +1,41 @@
+"""Model registry — the framework's "model family" facade.
+
+The reference is not an ML framework: its single "model" is the
+surgical-scrub cleaning algorithm (template-subtract -> robust statistics
+-> median/MAD threshold; ``/root/reference/iterative_cleaner.py:65-226``),
+and this package is the stable import surface for it.  The compute graph
+lives in :mod:`iterative_cleaner_tpu.engine.loop` (the jit-compiled
+iteration), the detection math in :mod:`iterative_cleaner_tpu.stats`, and
+the batched/sharded/streaming execution modes in
+:mod:`iterative_cleaner_tpu.parallel`.
+
+``SURGICAL_SCRUB`` is the flagship entry: clean one archive with a
+:class:`~iterative_cleaner_tpu.config.CleanConfig`.  Alternative cleaning
+strategies (e.g. different diagnostic sets or thresholding rules) would
+register here alongside it.
+"""
+
+from iterative_cleaner_tpu.backends import CleanResult, clean_archive  # noqa: F401
+from iterative_cleaner_tpu.config import CleanConfig  # noqa: F401
+from iterative_cleaner_tpu.engine.loop import (  # noqa: F401
+    clean_dedispersed_jax,
+    iteration_step,
+    prepare_cube_jax,
+)
+
+# name -> callable(archive, config) -> CleanResult
+REGISTRY = {
+    "surgical_scrub": clean_archive,
+}
+
+SURGICAL_SCRUB = "surgical_scrub"
+
+
+def get_model(name: str = SURGICAL_SCRUB):
+    """Cleaning strategy by name (the reference implements exactly one)."""
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown cleaning model {name!r}; available: "
+            f"{sorted(REGISTRY)}") from None
