@@ -17,11 +17,20 @@ register here alongside it.
 
 from iterative_cleaner_tpu.backends import CleanResult, clean_archive  # noqa: F401
 from iterative_cleaner_tpu.config import CleanConfig  # noqa: F401
-from iterative_cleaner_tpu.engine.loop import (  # noqa: F401
-    clean_dedispersed_jax,
-    iteration_step,
-    prepare_cube_jax,
-)
+
+_ENGINE_EXPORTS = ("clean_dedispersed_jax", "iteration_step",
+                   "prepare_cube_jax")
+
+
+def __getattr__(name):
+    # engine primitives re-export lazily: engine.loop imports jax at module
+    # level, and the numpy-oracle path must not pay that (the codebase-wide
+    # lazy-jax convention)
+    if name in _ENGINE_EXPORTS:
+        from iterative_cleaner_tpu.engine import loop
+
+        return getattr(loop, name)
+    raise AttributeError(name)
 
 # name -> callable(archive, config) -> CleanResult
 REGISTRY = {

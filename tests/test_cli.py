@@ -266,26 +266,3 @@ class TestTools:
         d = json.loads(capsys.readouterr().out)
         assert d["changed"] == 0 and d["same_input"]
 
-
-def test_models_facade():
-    """The models package re-exports the flagship cleaning entry points
-    (the framework's single 'model family': the surgical scrub)."""
-    import numpy as np
-
-    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
-    from iterative_cleaner_tpu.models import (
-        SURGICAL_SCRUB,
-        CleanConfig,
-        CleanResult,
-        get_model,
-    )
-
-    ar, _ = make_synthetic_archive(nsub=6, nchan=8, nbin=32, seed=0)
-    res = get_model(SURGICAL_SCRUB)(ar, CleanConfig(backend="numpy",
-                                                    dtype="float64"))
-    assert isinstance(res, CleanResult)
-    assert res.final_weights.shape == (6, 8)
-    import pytest
-
-    with pytest.raises(ValueError, match="unknown cleaning model"):
-        get_model("nope")

@@ -1,0 +1,31 @@
+"""The models package: stable facade over the flagship cleaning strategy."""
+
+import pytest
+
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+from iterative_cleaner_tpu.models import (
+    SURGICAL_SCRUB,
+    CleanConfig,
+    CleanResult,
+    get_model,
+)
+
+
+def test_models_facade():
+    ar, _ = make_synthetic_archive(nsub=6, nchan=8, nbin=32, seed=0)
+    res = get_model(SURGICAL_SCRUB)(ar, CleanConfig(backend="numpy",
+                                                    dtype="float64"))
+    assert isinstance(res, CleanResult)
+    assert res.final_weights.shape == (6, 8)
+    with pytest.raises(ValueError, match="unknown cleaning model"):
+        get_model("nope")
+
+
+def test_lazy_engine_reexports():
+    import iterative_cleaner_tpu.models as m
+
+    assert callable(m.iteration_step)
+    assert callable(m.prepare_cube_jax)
+    assert callable(m.clean_dedispersed_jax)
+    with pytest.raises(AttributeError):
+        m.not_a_symbol
