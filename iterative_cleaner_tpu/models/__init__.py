@@ -30,7 +30,12 @@ def __getattr__(name):
         from iterative_cleaner_tpu.engine import loop
 
         return getattr(loop, name)
-    raise AttributeError(name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_ENGINE_EXPORTS))
 
 # name -> callable(archive, config) -> CleanResult
 REGISTRY = {

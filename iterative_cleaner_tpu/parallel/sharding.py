@@ -60,9 +60,16 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
     return fn, cube_sh, w_sh, rep
 
 
-def clean_archive_sharded(archive: Archive, config: CleanConfig,
-                          mesh) -> CleanResult:
-    """Clean one (large) archive sharded over ``mesh`` (axes 'sub', 'chan').
+def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
+                       period_s, config: CleanConfig, mesh,
+                       apply_bad_parts: bool = True) -> CleanResult:
+    """Clean one (nsub, nchan, nbin) cube sharded over ``mesh`` (axes
+    'sub', 'chan').  Cube-level primitive shared by
+    :func:`clean_archive_sharded` and the sharded streaming mode
+    (:mod:`iterative_cleaner_tpu.parallel.streaming`; it passes
+    ``apply_bad_parts=False`` — tile-local sweeps would let zero-weight
+    padding rows dominate the bad fractions, and the sweep belongs to the
+    whole observation).
 
     Note: on XLA:CPU test meshes use ``rotation='roll'`` + ``fft_mode='dft'``
     (the CPU fft thunk rejects sharded layouts); on TPU all modes work.
@@ -74,6 +81,12 @@ def clean_archive_sharded(archive: Archive, config: CleanConfig,
         resolve_fft_mode,
         resolve_stats_frame,
     )
+
+    if config.unload_res or config.record_history:
+        raise ValueError(
+            "unload_res/record_history are not supported on the sharded "
+            "path (residual cubes and weight histories are not gathered); "
+            "clean unsharded for those outputs")
 
     dtype = jnp.dtype(config.dtype)
     # 'auto' stays on the sort path here: a pallas_call inside a GSPMD
@@ -88,12 +101,12 @@ def clean_archive_sharded(archive: Archive, config: CleanConfig,
     )
     with mesh:
         outs = fn(
-            jax.device_put(jnp.asarray(archive.total_intensity(), dtype), cube_sh),
-            jax.device_put(jnp.asarray(archive.weights, dtype), w_sh),
-            jax.device_put(jnp.asarray(archive.freqs_mhz, dtype), rep),
-            jnp.asarray(archive.dm, dtype),
-            jnp.asarray(archive.centre_freq_mhz, dtype),
-            jnp.asarray(archive.period_s, dtype),
+            jax.device_put(jnp.asarray(cube, dtype), cube_sh),
+            jax.device_put(jnp.asarray(weights, dtype), w_sh),
+            jax.device_put(jnp.asarray(freqs_mhz, dtype), rep),
+            jnp.asarray(dm, dtype),
+            jnp.asarray(centre_freq_mhz, dtype),
+            jnp.asarray(period_s, dtype),
         )
     loops = int(outs.loops)
     result = CleanResult(
@@ -104,7 +117,7 @@ def clean_archive_sharded(archive: Archive, config: CleanConfig,
         loop_diffs=np.asarray(outs.loop_diffs)[:loops],
         loop_rfi_frac=np.asarray(outs.loop_rfi_frac)[:loops],
     )
-    if config.bad_chan != 1 or config.bad_subint != 1:
+    if apply_bad_parts and (config.bad_chan != 1 or config.bad_subint != 1):
         swept, nbs, nbc = sweep_bad_lines(
             result.final_weights, config.bad_subint, config.bad_chan
         )
@@ -112,3 +125,12 @@ def clean_archive_sharded(archive: Archive, config: CleanConfig,
         result.n_bad_subints = nbs
         result.n_bad_channels = nbc
     return result
+
+
+def clean_archive_sharded(archive: Archive, config: CleanConfig,
+                          mesh) -> CleanResult:
+    """Clean one (large) archive sharded over ``mesh`` (axes 'sub', 'chan')."""
+    return clean_cube_sharded(
+        archive.total_intensity(), archive.weights, archive.freqs_mhz,
+        archive.dm, archive.centre_freq_mhz, archive.period_s, config, mesh,
+    )

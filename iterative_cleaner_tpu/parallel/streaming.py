@@ -51,13 +51,19 @@ class StreamingCleaner:
     """
 
     def __init__(self, chunk_nsub: int, config: CleanConfig, freqs_mhz,
-                 dm: float, centre_freq_mhz: float, period_s: float):
+                 dm: float, centre_freq_mhz: float, period_s: float,
+                 mesh=None):
+        # ``mesh``: an optional ('sub', 'chan') device mesh — each tile is
+        # then cleaned sharded over it (parallel/sharding.py), composing the
+        # long-observation streaming mode with multi-chip execution: tile
+        # shapes are constant, so all tiles share one compiled program.
         self.chunk_nsub = int(chunk_nsub)
         self.config = config
         self.freqs_mhz = np.asarray(freqs_mhz)
         self.dm = float(dm)
         self.centre_freq_mhz = float(centre_freq_mhz)
         self.period_s = float(period_s)
+        self.mesh = mesh
         self._buf: List[np.ndarray] = []       # pending (k, nchan, nbin)
         self._wbuf: List[np.ndarray] = []      # pending (k, nchan)
         self._pending = 0
@@ -95,8 +101,6 @@ class StreamingCleaner:
         return out
 
     def _clean_tile(self, taken) -> StreamTileResult:
-        from iterative_cleaner_tpu.backends import get_backend
-
         data, weights = taken
         n_valid = data.shape[0]
         if n_valid < self.chunk_nsub:  # pad the final partial tile
@@ -108,11 +112,26 @@ class StreamingCleaner:
                 [weights, np.zeros((pad,) + weights.shape[1:], weights.dtype)],
                 axis=0,
             )
-        backend = get_backend(self.config.backend)
-        result = backend.clean_cube(
-            data, weights, self.freqs_mhz, self.dm, self.centre_freq_mhz,
-            self.period_s, self.config,
-        )
+        if self.mesh is not None:
+            from iterative_cleaner_tpu.parallel.sharding import (
+                clean_cube_sharded,
+            )
+
+            # apply_bad_parts=False: like the single-device tile path, tiles
+            # are never swept (padding rows would dominate the fractions);
+            # clean_streaming sweeps the reassembled observation once
+            result = clean_cube_sharded(
+                data, weights, self.freqs_mhz, self.dm,
+                self.centre_freq_mhz, self.period_s, self.config, self.mesh,
+                apply_bad_parts=False,
+            )
+        else:
+            from iterative_cleaner_tpu.backends import get_backend
+
+            result = get_backend(self.config.backend).clean_cube(
+                data, weights, self.freqs_mhz, self.dm, self.centre_freq_mhz,
+                self.period_s, self.config,
+            )
         tile = StreamTileResult(
             start_subint=self._emitted, n_valid=n_valid, result=result
         )
@@ -121,13 +140,14 @@ class StreamingCleaner:
 
 
 def clean_streaming(archive: Archive, chunk_nsub: int,
-                    config: CleanConfig) -> CleanResult:
+                    config: CleanConfig, mesh=None) -> CleanResult:
     """Clean a whole archive through the streaming path (tile at a time) and
     reassemble a full-archive CleanResult.  Used for testing and for archives
-    too large to clean in one device footprint."""
+    too large to clean in one device footprint; with ``mesh``, each tile is
+    cleaned sharded over the device grid."""
     sc = StreamingCleaner(
         chunk_nsub, config, archive.freqs_mhz, archive.dm,
-        archive.centre_freq_mhz, archive.period_s,
+        archive.centre_freq_mhz, archive.period_s, mesh=mesh,
     )
     cube = archive.total_intensity()
     tiles: List[StreamTileResult] = []
@@ -137,9 +157,20 @@ def clean_streaming(archive: Archive, chunk_nsub: int,
     scores = np.concatenate(
         [t.result.scores[: t.n_valid] for t in tiles], axis=0
     )
-    return CleanResult(
+    result = CleanResult(
         final_weights=final_w,
         scores=scores,
         loops=max(t.result.loops for t in tiles),
         converged=all(t.result.converged for t in tiles),
     )
+    # the bad-parts sweep runs once over the whole reassembled observation
+    # (reference :156-157 semantics), never per tile
+    if config.bad_chan != 1 or config.bad_subint != 1:
+        from iterative_cleaner_tpu.backends.base import sweep_bad_lines
+
+        swept, nbs, nbc = sweep_bad_lines(
+            result.final_weights, config.bad_subint, config.bad_chan)
+        result.final_weights = swept
+        result.n_bad_subints = nbs
+        result.n_bad_channels = nbc
+    return result
