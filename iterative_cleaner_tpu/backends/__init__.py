@@ -6,7 +6,11 @@ with identical observable semantics (the reference algorithm,
 float64 semantics oracle, the jax one is the production TPU path.
 """
 
-from iterative_cleaner_tpu.backends.base import CleanResult, sweep_bad_lines  # noqa: F401
+from iterative_cleaner_tpu.backends.base import (  # noqa: F401
+    CleanResult,
+    apply_bad_parts,
+    sweep_bad_lines,
+)
 
 
 def get_backend(name: str):
@@ -33,11 +37,4 @@ def clean_archive(archive, config):
         archive.total_intensity(), archive.weights, archive.freqs_mhz,
         archive.dm, archive.centre_freq_mhz, archive.period_s, config,
     )
-    if config.bad_chan != 1 or config.bad_subint != 1:
-        swept, nbs, nbc = sweep_bad_lines(
-            result.final_weights, config.bad_subint, config.bad_chan
-        )
-        result.final_weights = swept
-        result.n_bad_subints = nbs
-        result.n_bad_channels = nbc
-    return result
+    return apply_bad_parts(result, config)

@@ -38,6 +38,21 @@ class CleanResult:
         return self.final_weights == 0
 
 
+def apply_bad_parts(result: "CleanResult", config) -> "CleanResult":
+    """Run the optional whole-line sweep on a result, gated exactly as the
+    reference gates it (:156: only when either threshold differs from 1).
+    Mutates and returns ``result``; the single place every execution path
+    (single, batched, sharded, streaming) applies the sweep through."""
+    if config.bad_chan != 1 or config.bad_subint != 1:
+        swept, nbs, nbc = sweep_bad_lines(
+            result.final_weights, config.bad_subint, config.bad_chan
+        )
+        result.final_weights = swept
+        result.n_bad_subints = nbs
+        result.n_bad_channels = nbc
+    return result
+
+
 def sweep_bad_lines(weights: np.ndarray, bad_subint: float, bad_chan: float):
     """Whole-subint/channel removal (reference ``find_bad_parts``, :308-335).
 

@@ -16,7 +16,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from iterative_cleaner_tpu.archive import Archive
-from iterative_cleaner_tpu.backends.base import CleanResult, sweep_bad_lines
+from iterative_cleaner_tpu.backends.base import CleanResult, apply_bad_parts
 from iterative_cleaner_tpu.config import CleanConfig
 
 
@@ -107,14 +107,7 @@ def unpack_batch_results(outs, n: int,
             loop_diffs=diffs[i][:loops],
             loop_rfi_frac=fracs[i][:loops],
         )
-        if config.bad_chan != 1 or config.bad_subint != 1:
-            swept, nbs, nbc = sweep_bad_lines(
-                result.final_weights, config.bad_subint, config.bad_chan
-            )
-            result.final_weights = swept
-            result.n_bad_subints = nbs
-            result.n_bad_channels = nbc
-        results.append(result)
+        results.append(apply_bad_parts(result, config))
     return results
 
 

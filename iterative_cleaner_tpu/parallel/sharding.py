@@ -17,7 +17,8 @@ import functools
 import numpy as np
 
 from iterative_cleaner_tpu.archive import Archive
-from iterative_cleaner_tpu.backends.base import CleanResult, sweep_bad_lines
+from iterative_cleaner_tpu.backends import base
+from iterative_cleaner_tpu.backends.base import CleanResult
 from iterative_cleaner_tpu.config import CleanConfig
 
 
@@ -117,13 +118,8 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
         loop_diffs=np.asarray(outs.loop_diffs)[:loops],
         loop_rfi_frac=np.asarray(outs.loop_rfi_frac)[:loops],
     )
-    if apply_bad_parts and (config.bad_chan != 1 or config.bad_subint != 1):
-        swept, nbs, nbc = sweep_bad_lines(
-            result.final_weights, config.bad_subint, config.bad_chan
-        )
-        result.final_weights = swept
-        result.n_bad_subints = nbs
-        result.n_bad_channels = nbc
+    if apply_bad_parts:
+        base.apply_bad_parts(result, config)
     return result
 
 

@@ -57,6 +57,12 @@ class StreamingCleaner:
         # then cleaned sharded over it (parallel/sharding.py), composing the
         # long-observation streaming mode with multi-chip execution: tile
         # shapes are constant, so all tiles share one compiled program.
+        if mesh is not None and (config.unload_res or config.record_history):
+            # fail at construction, not minutes into a live stream when the
+            # first tile fills (clean_cube_sharded would reject it then)
+            raise ValueError(
+                "unload_res/record_history are not supported with a mesh "
+                "(sharded tiles do not gather residuals/history)")
         self.chunk_nsub = int(chunk_nsub)
         self.config = config
         self.freqs_mhz = np.asarray(freqs_mhz)
@@ -165,12 +171,6 @@ def clean_streaming(archive: Archive, chunk_nsub: int,
     )
     # the bad-parts sweep runs once over the whole reassembled observation
     # (reference :156-157 semantics), never per tile
-    if config.bad_chan != 1 or config.bad_subint != 1:
-        from iterative_cleaner_tpu.backends.base import sweep_bad_lines
+    from iterative_cleaner_tpu.backends.base import apply_bad_parts
 
-        swept, nbs, nbc = sweep_bad_lines(
-            result.final_weights, config.bad_subint, config.bad_chan)
-        result.final_weights = swept
-        result.n_bad_subints = nbs
-        result.n_bad_channels = nbc
-    return result
+    return apply_bad_parts(result, config)

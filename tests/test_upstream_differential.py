@@ -185,6 +185,19 @@ def test_fullpol_reload_branch_matches_upstream(upstream, tmp_path):
     np.testing.assert_array_equal(res.final_weights, out.get_weights())
 
 
+def test_memory_flag_keeps_fullpol_without_reload(upstream):
+    """--memory without -p: the archive is never pscrunched in memory and
+    never reloaded (quirk 12) — output stays full-pol, weights identical."""
+    ar, _ = make_synthetic_archive(seed=14, nsub=8, nchan=10, nbin=32,
+                                   npol=4, n_rfi_cells=3)
+    fa = fake_psrchive.FakeArchive(ar.clone(), "mem.ar")
+    args = ref_args(memory=True, pscrunch=False)
+    out = upstream.clean(fa, args, "nonexistent-path.ar")  # reload never hit
+    assert out.get_npol() == 4
+    res = clean_archive(ar.clone(), _config_from_args(args))
+    np.testing.assert_array_equal(res.final_weights, out.get_weights())
+
+
 def test_bad_parts_sweep_matches_upstream(upstream):
     # pre-zap most of one subint and one channel so the sweeps fire
     ar, _ = make_synthetic_archive(seed=7, nsub=12, nchan=20)
