@@ -21,7 +21,7 @@ from typing import Iterator, List, Optional
 import numpy as np
 
 from iterative_cleaner_tpu.archive import Archive
-from iterative_cleaner_tpu.backends.base import CleanResult
+from iterative_cleaner_tpu.backends.base import CleanResult, apply_bad_parts
 from iterative_cleaner_tpu.config import CleanConfig
 
 
@@ -171,6 +171,4 @@ def clean_streaming(archive: Archive, chunk_nsub: int,
     )
     # the bad-parts sweep runs once over the whole reassembled observation
     # (reference :156-157 semantics), never per tile
-    from iterative_cleaner_tpu.backends.base import apply_bad_parts
-
     return apply_bad_parts(result, config)
