@@ -31,10 +31,15 @@ def clean_archive(archive, config):
 
     Shared wrapper around the per-backend ``clean_cube``: extracts the
     total-intensity cube, runs the iteration loop, then applies the optional
-    whole-line sweep (gated exactly as the reference does at :156)."""
+    whole-line sweep (gated exactly as the reference does at :156).
+
+    ``archive.dedispersed`` is honoured: PSRCHIVE's ``dedisperse`` is
+    state-aware (reference :91,:100 no-ops on a DEDISP=1 archive), so the
+    backends skip the forward rotation for already-dedispersed inputs."""
     backend = get_backend(config.backend)
     result = backend.clean_cube(
         archive.total_intensity(), archive.weights, archive.freqs_mhz,
         archive.dm, archive.centre_freq_mhz, archive.period_s, config,
+        dedispersed=archive.dedispersed,
     )
     return apply_bad_parts(result, config)

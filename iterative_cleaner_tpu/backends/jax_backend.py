@@ -84,7 +84,8 @@ def resolve_stats_impl(stats_impl: str, dtype, nbin: int,
 def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, baseline_duty,
                    unload_res, fft_mode="fft", median_impl="sort",
-                   stats_impl="xla", stats_frame="dispersed"):
+                   stats_impl="xla", stats_frame="dispersed",
+                   dedispersed=False):
     """Build (and cache) the jitted whole-archive cleaning program for one
     static configuration."""
 
@@ -92,6 +93,7 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
         ded, shifts = prepare_cube_jax(
             cube, freqs_mhz, dm, ref_freq_mhz, period_s,
             baseline_duty=baseline_duty, rotation=rotation,
+            dedispersed=dedispersed,
         )
         outs = clean_dedispersed_jax(
             ded, weights, shifts,
@@ -117,8 +119,11 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
 
 
 def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
-               config: CleanConfig) -> CleanResult:
-    """Clean a total-intensity (nsub, nchan, nbin) cube on the default device."""
+               config: CleanConfig, *, dedispersed: bool = False) -> CleanResult:
+    """Clean a total-intensity (nsub, nchan, nbin) cube on the default device.
+
+    ``dedispersed=True`` marks an already-dedispersed input (PSRFITS
+    ``DEDISP=1``); see :func:`~iterative_cleaner_tpu.engine.loop.prepare_cube_jax`."""
     dtype = jnp.dtype(config.dtype)
     fft_mode = resolve_fft_mode(config.fft_mode, dtype)
     fn = build_clean_fn(
@@ -129,6 +134,7 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         resolve_stats_impl(config.stats_impl, dtype, cube.shape[-1],
                            fft_mode),
         resolve_stats_frame(config.stats_frame, dtype),
+        bool(dedispersed),
     )
     outs, resid = fn(
         jnp.asarray(cube, dtype=dtype),

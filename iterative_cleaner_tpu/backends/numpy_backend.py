@@ -30,8 +30,13 @@ from iterative_cleaner_tpu.stats.masked_numpy import surgical_scores_numpy
 
 
 def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
-               config: CleanConfig) -> CleanResult:
-    """Clean a total-intensity (nsub, nchan, nbin) cube; pure numpy."""
+               config: CleanConfig, *, dedispersed: bool = False) -> CleanResult:
+    """Clean a total-intensity (nsub, nchan, nbin) cube; pure numpy.
+
+    ``dedispersed=True`` marks an already-dedispersed input (PSRFITS
+    ``DEDISP=1``): PSRCHIVE's state-aware ``dedisperse`` no-ops on it
+    (reference :91,:100) while ``dededisperse`` (:104) still rotates into
+    the dispersed frame, so only the forward rotation is skipped."""
     cube = np.asarray(cube, dtype=np.float64)
     orig_weights = np.asarray(orig_weights, dtype=np.float64)
     nbin = cube.shape[-1]
@@ -42,8 +47,9 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
     )
     # Iteration-invariant preamble (reference recomputes at :97-100 from
     # identical clones; hoisted here).
-    ded = rotate_bins(remove_baseline(cube, np, duty=config.baseline_duty),
-                      -shifts, np, method=config.rotation)
+    ded = remove_baseline(cube, np, duty=config.baseline_duty)
+    if not dedispersed:
+        ded = rotate_bins(ded, -shifts, np, method=config.rotation)
 
     cell_mask = orig_weights == 0  # ref :115
     history = [orig_weights.copy()]  # pre-loop seed, ref :78-79

@@ -321,8 +321,11 @@ def _run_batched(args) -> list:
             except Exception as exc:
                 record_failure([p], exc)
                 continue
-            if ars and (ar.nsub, ar.nchan, ar.nbin) != (
-                    ars[0].nsub, ars[0].nchan, ars[0].nbin):
+            if ars and (ar.nsub, ar.nchan, ar.nbin, ar.dedispersed) != (
+                    ars[0].nsub, ars[0].nchan, ars[0].nbin,
+                    ars[0].dedispersed):
+                # shape or DEDISP state changed: both are compiled into the
+                # batched program (check_equal_shapes rejects mixed groups)
                 carried = (p, ar)  # seeds the next group, not reloaded
                 break
             group.append(p)

@@ -258,9 +258,16 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
 
 
 def prepare_cube_jax(cube, freqs_mhz, dm, ref_freq_mhz, period_s, *,
-                     baseline_duty, rotation):
+                     baseline_duty, rotation, dedispersed=False):
     """Host-free preamble: baseline removal + dedispersion (reference
     :90-91/:99-100, identical across iterations so hoisted out of the loop).
+
+    ``dedispersed=True`` marks a cube whose channel delays were already
+    removed (PSRFITS ``DEDISP=1``): PSRCHIVE's state-aware ``dedisperse``
+    is then a no-op (reference :91,:100 relies on that), so the forward
+    rotation is skipped — but ``dededisperse`` (reference :104) still
+    rotates *into* the dispersed frame, so the back-shifts are returned
+    unchanged.
 
     Returns (ded_cube, back_shifts)."""
     nbin = cube.shape[-1]
@@ -268,6 +275,7 @@ def prepare_cube_jax(cube, freqs_mhz, dm, ref_freq_mhz, period_s, *,
         jnp.asarray(freqs_mhz, dtype=cube.dtype), dm, ref_freq_mhz, period_s,
         nbin, jnp,
     )
-    base = remove_baseline(cube, jnp, duty=baseline_duty)
-    ded = rotate_bins(base, -shifts, jnp, method=rotation)
+    ded = remove_baseline(cube, jnp, duty=baseline_duty)
+    if not dedispersed:
+        ded = rotate_bins(ded, -shifts, jnp, method=rotation)
     return ded, shifts

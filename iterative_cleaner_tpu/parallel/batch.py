@@ -24,7 +24,7 @@ from iterative_cleaner_tpu.config import CleanConfig
 def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                            pulse_scale, pulse_active, rotation, baseline_duty,
                            fft_mode, median_impl="sort",
-                           stats_frame="dispersed"):
+                           stats_frame="dispersed", dedispersed=False):
     """Jitted batched cleaner: every per-archive input gains a leading batch
     axis; scalars (dm, period, ref freq) are per-archive vectors."""
     import jax
@@ -38,6 +38,7 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
         ded, shifts = prepare_cube_jax(
             cube, freqs, dm, ref, period,
             baseline_duty=baseline_duty, rotation=rotation,
+            dedispersed=dedispersed,
         )
         return clean_dedispersed_jax(
             ded, weights, shifts, max_iter=max_iter, chanthresh=chanthresh,
@@ -57,6 +58,12 @@ def check_equal_shapes(archives: Sequence[Archive]) -> None:
             f"batched cleaning needs equal-shaped archives, got {shapes}; "
             "bucket by shape first (parallel.streaming handles ragged time "
             "axes)"
+        )
+    if len({a.dedispersed for a in archives}) != 1:
+        raise ValueError(
+            "batched cleaning needs a homogeneous dedispersed flag (the "
+            "forward rotation is compiled in); split the batch by "
+            "Archive.dedispersed first"
         )
 
 
@@ -157,6 +164,7 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
         resolve_fft_mode(config.fft_mode, jnp.dtype(config.dtype)),
         median_impl,
         resolve_stats_frame(config.stats_frame, jnp.dtype(config.dtype)),
+        bool(archives[0].dedispersed),
     )
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -26,7 +26,7 @@ from iterative_cleaner_tpu.config import CleanConfig
 def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
                            pulse_slice, pulse_scale, pulse_active, rotation,
                            baseline_duty, fft_mode, median_impl="sort",
-                           stats_frame="dispersed"):
+                           stats_frame="dispersed", dedispersed=False):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -43,7 +43,7 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
     def run(cube, weights, freqs, dm, ref, period):
         ded, shifts = prepare_cube_jax(
             cube, freqs, dm, ref, period, baseline_duty=baseline_duty,
-            rotation=rotation,
+            rotation=rotation, dedispersed=dedispersed,
         )
         return clean_dedispersed_jax(
             ded, weights, shifts, max_iter=max_iter, chanthresh=chanthresh,
@@ -63,7 +63,8 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
 
 def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
                        period_s, config: CleanConfig, mesh,
-                       apply_bad_parts: bool = True) -> CleanResult:
+                       apply_bad_parts: bool = True,
+                       dedispersed: bool = False) -> CleanResult:
     """Clean one (nsub, nchan, nbin) cube sharded over ``mesh`` (axes
     'sub', 'chan').  Cube-level primitive shared by
     :func:`clean_archive_sharded` and the sharded streaming mode
@@ -99,6 +100,7 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
         config.rotation, config.baseline_duty,
         resolve_fft_mode(config.fft_mode, dtype), median_impl,
         resolve_stats_frame(config.stats_frame, dtype),
+        bool(dedispersed),
     )
     with mesh:
         outs = fn(
@@ -129,4 +131,5 @@ def clean_archive_sharded(archive: Archive, config: CleanConfig,
     return clean_cube_sharded(
         archive.total_intensity(), archive.weights, archive.freqs_mhz,
         archive.dm, archive.centre_freq_mhz, archive.period_s, config, mesh,
+        dedispersed=archive.dedispersed,
     )

@@ -52,7 +52,7 @@ class StreamingCleaner:
 
     def __init__(self, chunk_nsub: int, config: CleanConfig, freqs_mhz,
                  dm: float, centre_freq_mhz: float, period_s: float,
-                 mesh=None):
+                 mesh=None, dedispersed: bool = False):
         # ``mesh``: an optional ('sub', 'chan') device mesh — each tile is
         # then cleaned sharded over it (parallel/sharding.py), composing the
         # long-observation streaming mode with multi-chip execution: tile
@@ -70,6 +70,7 @@ class StreamingCleaner:
         self.centre_freq_mhz = float(centre_freq_mhz)
         self.period_s = float(period_s)
         self.mesh = mesh
+        self.dedispersed = bool(dedispersed)
         self._buf: List[np.ndarray] = []       # pending (k, nchan, nbin)
         self._wbuf: List[np.ndarray] = []      # pending (k, nchan)
         self._pending = 0
@@ -129,14 +130,14 @@ class StreamingCleaner:
             result = clean_cube_sharded(
                 data, weights, self.freqs_mhz, self.dm,
                 self.centre_freq_mhz, self.period_s, self.config, self.mesh,
-                apply_bad_parts=False,
+                apply_bad_parts=False, dedispersed=self.dedispersed,
             )
         else:
             from iterative_cleaner_tpu.backends import get_backend
 
             result = get_backend(self.config.backend).clean_cube(
                 data, weights, self.freqs_mhz, self.dm, self.centre_freq_mhz,
-                self.period_s, self.config,
+                self.period_s, self.config, dedispersed=self.dedispersed,
             )
         tile = StreamTileResult(
             start_subint=self._emitted, n_valid=n_valid, result=result
@@ -154,6 +155,7 @@ def clean_streaming(archive: Archive, chunk_nsub: int,
     sc = StreamingCleaner(
         chunk_nsub, config, archive.freqs_mhz, archive.dm,
         archive.centre_freq_mhz, archive.period_s, mesh=mesh,
+        dedispersed=archive.dedispersed,
     )
     cube = archive.total_intensity()
     tiles: List[StreamTileResult] = []

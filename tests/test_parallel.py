@@ -140,6 +140,64 @@ def test_batched_rejects_ragged_shapes():
         clean_archives_batched([_mk(0), _mk(1, nbin=64)], _roll_cfg())
 
 
+def _mk_dedispersed(seed, **kw):
+    """A DEDISP=1 archive: rotated into the aligned frame through the
+    state-aware fake's own ``dedisperse`` (tests/fake_psrchive.py)."""
+    from tests import fake_psrchive
+
+    ar = _mk(seed, dm=300.0, **kw)  # ~15-bin shifts: a double rotation shows
+    fa = fake_psrchive.FakeArchive(ar, rotation="roll")
+    fa.dedisperse()
+    assert fa._ar.dedispersed
+    return fa._ar
+
+
+def test_dedispersed_flag_reaches_parallel_paths():
+    """batch / sharded / streaming must thread ``Archive.dedispersed`` —
+    a path that dropped the flag would rotate a second time and silently
+    produce the wrong mask while every other test stayed green."""
+    import dataclasses
+
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.parallel import (
+        cell_mesh,
+        clean_archive_sharded,
+        clean_archives_batched,
+        clean_streaming,
+    )
+
+    cfg = _roll_cfg()
+    archives = [_mk_dedispersed(40 + s) for s in range(2)]
+    singles = [clean_archive(a.clone(), cfg) for a in archives]
+
+    # teeth: ignoring the flag must change the mask for this fixture
+    wrong = clean_archive(
+        dataclasses.replace(archives[0].clone(), dedispersed=False), cfg)
+    assert (wrong.final_weights != singles[0].final_weights).any()
+
+    batched = clean_archives_batched(archives, cfg)
+    for single, b in zip(singles, batched):
+        np.testing.assert_array_equal(single.final_weights, b.final_weights)
+
+    sharded = clean_archive_sharded(archives[0].clone(), cfg, cell_mesh(8))
+    np.testing.assert_array_equal(singles[0].final_weights,
+                                  sharded.final_weights)
+
+    # one full-size tile: tile semantics == whole-archive semantics, so any
+    # difference is the flag being dropped on the streaming path
+    streamed = clean_streaming(archives[0].clone(),
+                               chunk_nsub=archives[0].nsub, config=cfg)
+    np.testing.assert_array_equal(singles[0].final_weights,
+                                  streamed.final_weights)
+
+
+def test_batched_rejects_mixed_dedispersed_flags():
+    from iterative_cleaner_tpu.parallel import clean_archives_batched
+
+    with pytest.raises(ValueError, match="dedispersed"):
+        clean_archives_batched([_mk(0), _mk_dedispersed(1)], _roll_cfg())
+
+
 def test_sharded_library_path_matches_single():
     from iterative_cleaner_tpu.backends import clean_archive
     from iterative_cleaner_tpu.parallel import cell_mesh, clean_archive_sharded

@@ -157,6 +157,31 @@ def test_nan_data_matches_upstream(upstream):
     np.testing.assert_array_equal(res.final_weights, ref_weights)
 
 
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_dedispersed_input_matches_upstream(upstream, backend):
+    """DEDISP=1 archives: PSRCHIVE's ``dedisperse`` is state-aware and no-ops
+    on an already-dedispersed archive (reference :91,:100), while
+    ``dededisperse`` (:104) still rotates the residual into the dispersed
+    frame.  Construct the input through the state-aware fake's own
+    ``dedisperse`` and require identical final masks — a backend that
+    rotated a second time would fail this."""
+    # dm=300 spans ~15 bins across the band so a spurious second rotation
+    # visibly smears the pulse (the default dm's shifts are sub-bin)
+    ar, _ = make_synthetic_archive(seed=21, nsub=10, nchan=12, nbin=64,
+                                   n_rfi_cells=4, dm=300.0)
+    fa = fake_psrchive.FakeArchive(ar.clone(), "ded.ar")
+    fa.dedisperse()  # rotates into the aligned frame and sets the flag
+    ded_ar = fa._ar
+    assert ded_ar.dedispersed
+    args = ref_args()
+    ref_weights = run_upstream(upstream, ded_ar, args)
+    kw = dict(backend=backend)
+    if backend == "jax":
+        kw["dtype"] = "float64"
+    res = clean_archive(ded_ar.clone(), _config_from_args(args, **kw))
+    np.testing.assert_array_equal(res.final_weights, ref_weights)
+
+
 def test_jax_backend_matches_upstream(upstream):
     ar, _ = make_synthetic_archive(seed=6)
     args = ref_args()
