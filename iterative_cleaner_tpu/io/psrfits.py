@@ -162,24 +162,40 @@ def _columns(cards):
 
 def _hdu_data_bytes(cards) -> int:
     naxis = _as_int(cards, "NAXIS", 0)
+    if naxis < 0:
+        raise ValueError(f"negative NAXIS {naxis}")
     if naxis == 0:
         return 0
     n = 1
     for i in range(1, naxis + 1):
-        n *= _as_int(cards, f"NAXIS{i}")
+        v = _as_int(cards, f"NAXIS{i}")
+        if v < 0:
+            raise ValueError(f"negative NAXIS{i} {v}")
+        n *= v
+    pcount = _as_int(cards, "PCOUNT", 0)
+    if pcount < 0:
+        raise ValueError(f"negative PCOUNT {pcount}")
     n *= abs(_as_int(cards, "BITPIX", 8)) // 8
-    n += _as_int(cards, "PCOUNT", 0) * abs(_as_int(cards, "BITPIX", 8)) // 8
+    n += pcount * abs(_as_int(cards, "BITPIX", 8)) // 8
     return n
 
 
 def _iter_hdus(buf: memoryview):
-    """Yield (cards, data_offset) for each HDU."""
+    """Yield (cards, data_offset) for each HDU.
+
+    Negative NAXISn/PCOUNT raise (``_hdu_data_bytes``) rather than walking
+    the offset backwards, and the next offset must strictly advance — a
+    crafted header can therefore never make this loop revisit offsets
+    (the corruption-fuzz contract: reject or load, never hang)."""
     off = 0
     while off < len(buf):
         cards, data_off = _parse_header(buf, off)
         yield cards, data_off
         size = _hdu_data_bytes(cards)
-        off = data_off + size + ((-size) % BLOCK)
+        nxt = data_off + size + ((-size) % BLOCK)
+        if nxt <= off:  # pragma: no cover - guarded by the raises above
+            raise ValueError("corrupt FITS: HDU walk does not advance")
+        off = nxt
 
 
 # ---------------------------------------------------------------------------
@@ -233,7 +249,7 @@ def save_psrfits(ar: Archive, path: str, nbits: "int | None" = None) -> None:
     else:
         data_code, data_np = "E", ">f4"
     ncell = npol * nchan
-    row_bytes = (8 + 8 + 4 * nchan + 4 * nchan + 4 * ncell + 4 * ncell
+    row_bytes = (8 + 8 + 8 * nchan + 4 * nchan + 4 * ncell + 4 * ncell
                  + (nbits // 8) * ncell * nbin)
     subint = _end_pad([
         _card("XTENSION", "BINTABLE", "binary table extension"),
@@ -258,7 +274,10 @@ def save_psrfits(ar: Archive, path: str, nbits: "int | None" = None) -> None:
               "1 if channel delays removed"),
         _card("TTYPE1", "TSUBINT"), _card("TFORM1", "1D"),
         _card("TTYPE2", "OFFS_SUB"), _card("TFORM2", "1D"),
-        _card("TTYPE3", "DAT_FREQ"), _card("TFORM3", f"{nchan}E"),
+        # DAT_FREQ is written float64 ('D', PSRFITS permits it): channel
+        # frequencies survive an icar/npz -> PSRFITS round-trip exactly
+        # instead of being squeezed through float32
+        _card("TTYPE3", "DAT_FREQ"), _card("TFORM3", f"{nchan}D"),
         _card("TTYPE4", "DAT_WTS"), _card("TFORM4", f"{nchan}E"),
         _card("TTYPE5", "DAT_SCL"), _card("TFORM5", f"{ncell}E"),
         _card("TTYPE6", "DAT_OFFS"), _card("TFORM6", f"{ncell}E"),
@@ -294,11 +313,11 @@ def save_psrfits(ar: Archive, path: str, nbits: "int | None" = None) -> None:
     with open(path, "wb") as f:
         f.write(primary)
         f.write(subint)
-        freqs32 = np.asarray(ar.freqs_mhz, dtype=">f4").tobytes()
+        freqs_be = np.asarray(ar.freqs_mhz, dtype=">f8").tobytes()
         for isub in range(nsub):
             f.write(struct.pack(">d", tsub))
             f.write(struct.pack(">d", (isub + 0.5) * tsub))
-            f.write(freqs32)
+            f.write(freqs_be)
             f.write(np.asarray(ar.weights[isub], dtype=">f4").tobytes())
             f.write(np.asarray(scl[isub], dtype=">f4").tobytes())
             f.write(np.asarray(offs[isub], dtype=">f4").tobytes())
@@ -333,6 +352,11 @@ def _resolve_period(buf: memoryview, subint_cards) -> float:
             for name, code, repeat, off in cols:
                 if name == "REF_F0" and code == "D" and nrows:
                     last = data_off + (nrows - 1) * row_bytes + off
+                    if last + 8 > len(buf):
+                        # truncated POLYCO: no usable REF_F0 — fall through
+                        # to the TBIN identity, exactly like the native
+                        # reader (struct.error would escape otherwise)
+                        continue
                     f0 = struct.unpack(">d", bytes(buf[last: last + 8]))[0]
                     if f0 > 0:
                         return 1.0 / f0
@@ -548,7 +572,14 @@ def _parse_psrfits(buf: memoryview, path: str) -> Archive:
 
     tsubint = column("TSUBINT", ">f8", 1)[:, 0] if "TSUBINT" in col else \
         np.zeros(nsub)
-    freqs = column("DAT_FREQ", ">f4", nchan)[0].astype(np.float64)
+    # DAT_FREQ may be E (float32, the common layout) or D (float64, what
+    # this writer emits); honour the column's own code
+    fcode = col["DAT_FREQ"][0]
+    if fcode not in ("E", "D"):
+        raise ValueError(f"DAT_FREQ column type {fcode!r} unsupported "
+                         "(expected E=float32 or D=float64)")
+    freqs = column("DAT_FREQ", ">f8" if fcode == "D" else ">f4",
+                   nchan)[0].astype(np.float64)
     weights = column("DAT_WTS", ">f4", nchan).astype(np.float64)
     scl = column("DAT_SCL", ">f4", ncell).astype(np.float64)
     offs = column("DAT_OFFS", ">f4", ncell).astype(np.float64)
@@ -627,20 +658,26 @@ def _parse_info(buf: memoryview, path: str):
     if "OBSFREQ" in primary:
         cfreq = _as_float(primary, "OBSFREQ")
     else:  # same fallback as load_psrfits: mid-channel DAT_FREQ
-        _, _, f_off = col["DAT_FREQ"]
-        start = data_off + f_off + 4 * (nchan // 2)
-        cfreq = float(np.frombuffer(buf[start: start + 4], dtype=">f4")[0])
+        fcode, _, f_off = col["DAT_FREQ"]
+        w = _TFORM_BYTES.get(fcode, 4)
+        dt = ">f8" if fcode == "D" else ">f4"
+        start = data_off + f_off + w * (nchan // 2)
+        cfreq = float(np.frombuffer(buf[start: start + w], dtype=dt)[0])
+    npol = _as_int(sub, "NPOL")
     meta = dict(
         source=primary.get("SRC_NAME", "unknown").strip(),
-        nsub=nsub, npol=_as_int(sub, "NPOL"), nchan=nchan,
+        nsub=nsub, npol=npol, nchan=nchan,
         nbin=_as_int(sub, "NBIN"),
         dm=_as_float(sub, "CHAN_DM", _as_float(sub, "DM", 0.0)),
         period_s=_resolve_period(buf, sub),
         centre_freq_mhz=cfreq,
         mjd_start=mjd_start,
         mjd_end=mjd_start + tsub_total / 86400.0,
+        # same npol-aware fallback as _parse_psrfits: `tools info` must
+        # report the pol_state an actual load of the file would produce
         pol_state=_STATE_OF_POL_TYPE.get(
-            sub.get("POL_TYPE", "INTEN").strip().upper(), "Intensity"),
+            sub.get("POL_TYPE", "INTEN").strip().upper(),
+            "Intensity" if npol == 1 else "Stokes"),
         dedispersed=bool(_as_int(sub, "DEDISP", 0)),
     )
     return meta, weights

@@ -185,18 +185,29 @@ bool parse_columns(const Cards& c, std::map<std::string, Column>* cols,
   return tfields > 0;
 }
 
-size_t hdu_data_bytes(const Cards& c) {
+// False on a negative NAXISn/PCOUNT: casting those to size_t would wrap
+// the HDU walk backwards/around (same clamp as the Python reader's
+// _hdu_data_bytes — corrupt files must be rejected, never spun on).
+bool hdu_data_bytes(const Cards& c, size_t* out) {
   bool ok = true;
+  *out = 0;
   long naxis = as_int(c, "NAXIS", 0, &ok);
-  if (naxis <= 0) return 0;
+  if (naxis < 0) return false;
+  if (naxis == 0) return true;
   size_t n = 1;
-  for (long i = 1; i <= naxis; ++i)
-    n *= static_cast<size_t>(as_int(c, "NAXIS" + std::to_string(i), 0, &ok));
+  for (long i = 1; i <= naxis; ++i) {
+    long v = as_int(c, "NAXIS" + std::to_string(i), 0, &ok);
+    if (v < 0) return false;
+    n *= static_cast<size_t>(v);
+  }
+  long pcount = as_int(c, "PCOUNT", 0, &ok);
+  if (pcount < 0) return false;
   size_t el = static_cast<size_t>(
       labs(as_int(c, "BITPIX", 8, &ok))) / 8;
   n *= el;
-  n += static_cast<size_t>(as_int(c, "PCOUNT", 0, &ok)) * el;
-  return n;
+  n += static_cast<size_t>(pcount) * el;
+  *out = n;
+  return true;
 }
 
 struct PsrfitsHandle {
@@ -225,7 +236,8 @@ double polyco_period(const unsigned char* buf, size_t size) {
     Cards cards;
     size_t data_off;
     if (!parse_header(buf, size, off, &cards, &data_off)) return 0;
-    size_t bytes = hdu_data_bytes(cards);
+    size_t bytes;
+    if (!hdu_data_bytes(cards, &bytes) || bytes > size) return 0;
     if (!first && strip(cards.count("EXTNAME") ? cards["EXTNAME"] : "") ==
         "POLYCO") {
       std::map<std::string, Column> cols;
@@ -283,14 +295,17 @@ void* psrfits_open(const char* path) {
   std::string mode = h->primary.count("OBS_MODE")
                          ? strip(h->primary["OBS_MODE"]) : "PSR";
   if (mode != "PSR" && mode != "CAL") return fail();
-  size_t bytes = hdu_data_bytes(h->primary);
+  size_t bytes;
+  if (!hdu_data_bytes(h->primary, &bytes) || bytes > h->map_size)
+    return fail();
   off = data_off + bytes + ((kBlock - bytes % kBlock) % kBlock);
   bool found = false;
   while (off < h->map_size) {
     Cards cards;
     if (!parse_header(h->map, h->map_size, off, &cards, &data_off))
       return fail();
-    bytes = hdu_data_bytes(cards);
+    if (!hdu_data_bytes(cards, &bytes) || bytes > h->map_size)
+      return fail();
     if (strip(cards.count("EXTNAME") ? cards["EXTNAME"] : "") == "SUBINT") {
       h->subint = cards;
       h->table_off = data_off;
@@ -321,6 +336,9 @@ void* psrfits_open(const char* path) {
       h->cols["DAT_OFFS"].repeat < size_t(h->npol) * h->nchan ||
       h->cols["DAT_WTS"].repeat < h->nchan ||
       h->cols["DAT_FREQ"].repeat < h->nchan)
+    return fail();
+  // DAT_FREQ: E (float32, common) or D (float64, what save_psrfits writes)
+  if (h->cols["DAT_FREQ"].code != 'E' && h->cols["DAT_FREQ"].code != 'D')
     return fail();
   if (h->table_off + size_t(h->nsub) * h->row_bytes > h->map_size)
     return fail();
@@ -406,7 +424,9 @@ int psrfits_read(void* handle, double* data, double* weights, double* freqs) {
 
   const unsigned char* row0 = h->map + h->table_off;
   for (uint32_t c = 0; c < h->nchan; ++c)
-    freqs[c] = double(be_f32(row0 + cf.offset + 4 * size_t(c)));
+    freqs[c] = cf.code == 'D'
+                   ? be_f64(row0 + cf.offset + 8 * size_t(c))
+                   : double(be_f32(row0 + cf.offset + 4 * size_t(c)));
 
   std::vector<double> scl(ncell), offs(ncell);
   for (uint32_t isub = 0; isub < h->nsub; ++isub) {

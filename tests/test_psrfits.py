@@ -384,6 +384,105 @@ def test_corruption_fuzz_never_crashes(tmp_path, reader):
                 pass  # clean rejection is fine; crashes/hangs are not
 
 
+def test_negative_naxis_rejected_not_hung(tmp_path):
+    """A crafted HDU with negative NAXISn must raise cleanly: the old walk
+    computed a negative data size and moved the HDU offset *backwards*,
+    revisiting offsets forever (ADVICE r1).  Native must reject (None)."""
+    ar, _ = _archive(nsub=4, nchan=6, nbin=16)
+    good = str(tmp_path / "g.sf")
+    psrfits.save_psrfits(ar, good)
+    raw = open(good, "rb").read()
+    # splice an evil extension between the primary HDU and SUBINT
+    evil = psrfits._end_pad([
+        psrfits._card("XTENSION", "BINTABLE"),
+        psrfits._card("BITPIX", 8),
+        psrfits._card("NAXIS", 2),
+        psrfits._card("NAXIS1", -5760),
+        psrfits._card("NAXIS2", 1),
+        psrfits._card("PCOUNT", 0),
+        psrfits._card("GCOUNT", 1),
+        psrfits._card("TFIELDS", 0),
+        psrfits._card("EXTNAME", "EVIL"),
+    ])
+    end = raw.find(b"END" + b" " * 77)  # primary END card
+    assert end >= 0
+    prim_len = (end // psrfits.BLOCK + 1) * psrfits.BLOCK
+    bad = str(tmp_path / "evil.sf")
+    with open(bad, "wb") as f:
+        f.write(raw[:prim_len] + evil + raw[prim_len:])
+    with pytest.raises(ValueError, match="negative NAXIS"):
+        psrfits.load_psrfits(bad, prefer_native=False)
+    if psrfits._psrfits_lib() is not None:
+        assert psrfits._load_psrfits_native(bad) is None
+
+
+def test_truncated_polyco_falls_back_to_tbin(tmp_path):
+    """POLYCO REF_F0 pointing past EOF: no struct.error — both readers treat
+    the truncated table as 'no usable POLYCO' and resolve the period from
+    TBIN*NBIN (ADVICE r1: pure reader matches the native bounds check)."""
+    import struct
+
+    ar, _ = _archive()
+    path = str(tmp_path / "p.sf")
+    psrfits.save_psrfits(ar, path)
+    polyco_hdr = psrfits._end_pad([
+        psrfits._card("XTENSION", "BINTABLE"),
+        psrfits._card("BITPIX", 8),
+        psrfits._card("NAXIS", 2),
+        psrfits._card("NAXIS1", 8),
+        psrfits._card("NAXIS2", 2),
+        psrfits._card("PCOUNT", 0),
+        psrfits._card("GCOUNT", 1),
+        psrfits._card("TFIELDS", 1),
+        psrfits._card("EXTNAME", "POLYCO"),
+        psrfits._card("TTYPE1", "REF_F0"),
+        psrfits._card("TFORM1", "1D"),
+    ])
+    truncated = str(tmp_path / "trunc.sf")
+    with open(truncated, "wb") as f:
+        f.write(_strip_card(path, "PERIOD"))
+        f.write(polyco_hdr)
+        f.write(struct.pack(">d", 1.0))  # row 1 only; row 2 missing
+    pure = psrfits.load_psrfits(truncated, prefer_native=False)
+    assert abs(pure.period_s - ar.period_s) < 1e-9  # TBIN * NBIN
+    nat = psrfits._load_psrfits_native(truncated)
+    if nat is not None:
+        assert abs(nat.period_s - ar.period_s) < 1e-9
+
+
+def test_dat_freq_float64_roundtrip_exact(tmp_path):
+    """DAT_FREQ is written as 'D' (float64): channel frequencies survive a
+    round-trip bit-exactly instead of being squeezed through float32
+    (ADVICE r1); pure and native readers agree."""
+    ar, _ = _archive(n_prezapped=0)
+    ar.freqs_mhz = ar.freqs_mhz + 1e-7  # not representable in float32
+    path = str(tmp_path / "f64.sf")
+    psrfits.save_psrfits(ar, path)
+    pure = psrfits.load_psrfits(path, prefer_native=False)
+    np.testing.assert_array_equal(pure.freqs_mhz, ar.freqs_mhz)
+    nat = psrfits._load_psrfits_native(path)
+    if nat is not None:
+        np.testing.assert_array_equal(nat.freqs_mhz, ar.freqs_mhz)
+
+
+def test_info_pol_state_matches_load_for_unknown_pol_type(tmp_path):
+    """`tools info` must report the pol_state an actual load would produce:
+    both fall back npol-aware on an unknown POL_TYPE (ADVICE r1)."""
+    ar, _ = _archive(npol=4, pol_state="Stokes")
+    path = str(tmp_path / "u.sf")
+    psrfits.save_psrfits(ar, path)
+    raw = bytearray(open(path, "rb").read())
+    i = raw.find(b"POL_TYPE= ")
+    assert i >= 0
+    val = raw.find(b"'", i)
+    raw[val: val + 6] = b"'WAT' "  # unknown POL_TYPE, quote-terminated
+    patched = str(tmp_path / "unknown.sf")
+    open(patched, "wb").write(bytes(raw))
+    loaded = psrfits.load_psrfits(patched, prefer_native=False)
+    meta, _ = psrfits.read_psrfits_info(patched)
+    assert loaded.pol_state == meta["pol_state"] == "Stokes"
+
+
 def test_is_fits(tmp_path):
     ar, _ = _archive()
     p = str(tmp_path / "x.sf")
