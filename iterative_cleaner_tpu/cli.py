@@ -203,6 +203,7 @@ def clean_one(in_path: str, args: argparse.Namespace,
         ckpt.save_clean_checkpoint(
             ckpt.checkpoint_path(args.checkpoint, in_path), result, cfg,
             ckpt.fingerprint_archive(ar),
+            file_sig=ckpt.file_signature(in_path),
         )
 
     if not args.quiet:
