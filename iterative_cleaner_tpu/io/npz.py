@@ -75,9 +75,25 @@ def load_archive(path: str) -> Archive:
 
         if psrfits.is_fits(path):
             return psrfits.load_psrfits(path)
-        from iterative_cleaner_tpu.io import psrchive_bridge
+        # no FITS magic: a pre-PSRFITS (TIMER-format) archive — the one
+        # input class the reference reads (through PSRCHIVE,
+        # /root/reference/iterative_cleaner.py:47) that this framework
+        # only handles via the optional bridge
+        try:
+            from iterative_cleaner_tpu.io import psrchive_bridge
 
-        return psrchive_bridge.load_ar(path)  # TIMER-format .ar
+            return psrchive_bridge.load_ar(path)
+        except ImportError as e:
+            raise ValueError(
+                f"{path} is a pre-PSRFITS (TIMER-format) .ar archive and "
+                "the psrchive Python bindings are not installed. Either "
+                "convert it once with PSRCHIVE's own tools — "
+                "`psrconv -o PSRFITS " + os.path.basename(path) + "` (or "
+                "`pam -a PSRFITS`) — and clean the resulting PSRFITS file, "
+                "or run in an environment where `import psrchive` works "
+                "(the optional bridge then loads TIMER directly). See "
+                "MIGRATION.md."
+            ) from e
     with np.load(path, allow_pickle=False) as z:
         kwargs = {k: float(z[k]) for k in _META_KEYS}
         return Archive(
