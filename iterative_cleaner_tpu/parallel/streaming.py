@@ -11,6 +11,17 @@ final partial tile is padded with zero-weight subints: zero weight excludes
 the padding from every statistic (mask semantics of the engine), so a
 partial tile cleans identically to the same subints alone, modulo the
 subint-scaler median population.
+
+Tile semantics differ from whole-archive cleaning in one way: the
+channel-scaler median/MAD populations are the tile's subints, not the whole
+observation's (the reference's scalers at
+``/root/reference/iterative_cleaner.py:229-256`` always see every subint).
+Measured drift on 1024-subint synthetic observations cleaned whole vs in
+256-subint tiles is ~0.01-0.02% of cells (a handful of borderline scores
+crossing 1.0 either way); the bound is asserted at <0.1% by
+``tests/test_parallel.py::test_streaming_vs_whole_mask_drift_bounded``.
+The reassembled :func:`clean_streaming` result likewise summarises
+``loops``/``converged`` across tiles as max/all.
 """
 
 from __future__ import annotations
