@@ -134,17 +134,42 @@ def _median_axis0(values, mask, interpret):
 # cos/sin bases and their max never leaves VMEM.
 
 _S_BLK = 8      # subints per block (sublane-friendly)
-_C_BLK = 128    # channels per block (lane width)
+
+
+def _cell_blocks(nbin: int):
+    """(S_BLK, C_BLK) cell-block shape for one fused-kernel grid step.
+
+    VMEM per step scales as ``S_BLK * C_BLK * nbin`` (two cube blocks +
+    the flat intermediates) on top of the O(nbin^2) DFT tables, so the
+    channel block shrinks as profiles lengthen — the footprint stays
+    roughly flat from 256 to 1024 bins (~12 MB worst case incl. the
+    2x2.6 MB tables at 1024, inside the ~16 MB budget).
+
+    This is deliberately cell-axis tiling, not bin-axis tiling: the
+    closed-form amplitude needs a full-bin reduction *before* the residual
+    exists, so bin tiles would force either a second pass over the cube
+    (a third HBM read — exactly what the fused kernel exists to avoid) or
+    cross-grid-step accumulators for six partial statistics.  Shrinking
+    the cell block keeps the single-pass two-read structure at every nbin;
+    bin reductions stay whole-line on the VPU lanes.
+    """
+    if nbin <= 256:
+        return _S_BLK, 128
+    if nbin <= 512:
+        return _S_BLK, 64
+    return _S_BLK, 32
+
 
 # np.ma's float fill value (masked ptp, quirk 4), shared with the XLA path.
 from iterative_cleaner_tpu.stats.masked_jax import MA_FILL  # noqa: E402
 
 _MA_FILL_F32 = np.float32(MA_FILL)
 
-# The kernel keeps two (S, C, nbin) cube blocks, the DFT tables, and the
-# (S*C, nbin)/(S*C, nk) intermediates in VMEM; past this nbin the ~16 MB
-# VMEM budget is at risk, so callers fall back to the XLA path.
-FUSED_STATS_MAX_NBIN = 256
+# Past this nbin the O(nbin^2) DFT tables alone (2 x nbin x ~(nbin/2+128)
+# float32) blow the ~16 MB VMEM budget regardless of cell-block shrinking
+# (_cell_blocks), so callers fall back to the XLA path.  1024 covers
+# BASELINE config 1 (512 bins) and common 1024-bin archives.
+FUSED_STATS_MAX_NBIN = 1024
 
 
 def _write_diags(wres, mask, cos_ref, sin_ref,
@@ -217,16 +242,17 @@ class _FusedScaffold:
 
     def __init__(self, nsub, nchan, nbin):
         self.nsub, self.nchan, self.nbin = nsub, nchan, nbin
-        self.pad_s = (-nsub) % _S_BLK
-        self.pad_c = (-nchan) % _C_BLK
+        s_blk, c_blk = _cell_blocks(nbin)
+        self.pad_s = (-nsub) % s_blk
+        self.pad_c = (-nchan) % c_blk
         self.ns, self.nc = nsub + self.pad_s, nchan + self.pad_c
-        self.grid = (self.ns // _S_BLK, self.nc // _C_BLK)
-        self.cell_spec = pl.BlockSpec((_S_BLK, _C_BLK), lambda i, j: (i, j),
+        self.grid = (self.ns // s_blk, self.nc // c_blk)
+        self.cell_spec = pl.BlockSpec((s_blk, c_blk), lambda i, j: (i, j),
                                       memory_space=pltpu.VMEM)
-        self.cube_spec = pl.BlockSpec((_S_BLK, _C_BLK, nbin),
+        self.cube_spec = pl.BlockSpec((s_blk, c_blk, nbin),
                                       lambda i, j: (i, j, 0),
                                       memory_space=pltpu.VMEM)
-        self.chan_row_spec = pl.BlockSpec((_C_BLK, nbin), lambda i, j: (j, 0),
+        self.chan_row_spec = pl.BlockSpec((c_blk, nbin), lambda i, j: (j, 0),
                                           memory_space=pltpu.VMEM)
         self.row_spec = pl.BlockSpec((1, nbin), lambda i, j: (0, 0),
                                      memory_space=pltpu.VMEM)
