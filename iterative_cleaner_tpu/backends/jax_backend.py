@@ -31,9 +31,12 @@ from iterative_cleaner_tpu.ops.dsp import (
 
 
 def resolve_median_impl(median_impl: str, dtype) -> str:
-    """'auto' picks the Pallas kernel on single-device TPU float32 runs and
-    the sort path everywhere else (CPU, float64 oracle comparisons, sharded
-    GSPMD programs where a pallas_call would force a gather)."""
+    """'auto' picks the Pallas kernel on TPU float32 runs and the sort path
+    everywhere else (CPU, float64 oracle comparisons).  Sharded programs
+    route the kernel through shard_map (parallel/shard_stats); a cell grid
+    that does not divide the mesh is rejected up front by
+    clean_cube_sharded (no sharding layout supports it).  The vmap-batched
+    path stays on 'sort' (vmap serialises a pallas_call over a grid axis)."""
     if median_impl != "auto":
         return median_impl
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -50,12 +53,19 @@ def resolve_fft_mode(fft_mode: str, dtype) -> str:
 
 
 def resolve_stats_frame(stats_frame: str, dtype) -> str:
-    """'auto' resolves to the reference-exact dispersed frame.  The
-    dedispersed frame (one-third less HBM traffic, no cube-sized rotation
-    buffer) stays strictly opt-in: under the default fourier rotation its
-    masks can differ from the reference's on borderline cells — the
-    fractional rotation's interpolation ringing inflates the ptp diagnostic
-    of spiky residuals (see CleanConfig.stats_frame)."""
+    """'auto' resolves to the reference-exact dispersed frame.
+
+    Measured on a v5e (benchmarks/profile_stages.py, 2026-07-30,
+    1024x4096x128 fused path): the dedispersed frame's one-cube-read
+    iteration is 25.8 ms vs 28.1 ms dispersed — an ~8% win, because the
+    iteration is far from pure-bandwidth-bound (the scaler medians and
+    diagnostics dominate at ~230 GB/s effective vs the 819 GB/s roofline
+    the template/fit stages reach).  That 8% does not buy back the risk:
+    under the default fourier rotation the dedispersed frame's masks can
+    differ from the reference's on borderline cells (interpolation ringing
+    inflates the ptp diagnostic of spiky residuals — see
+    CleanConfig.stats_frame), so 'auto' keeps the reference-exact frame
+    and 'dedispersed' stays an explicit opt-in."""
     del dtype
     if stats_frame != "auto":
         return stats_frame
@@ -64,10 +74,11 @@ def resolve_stats_frame(stats_frame: str, dtype) -> str:
 
 def resolve_stats_impl(stats_impl: str, dtype, nbin: int,
                        fft_mode_resolved: str) -> str:
-    """'auto' picks the fused Pallas diagnostics kernel on single-device TPU
-    float32 runs (same rationale as :func:`resolve_median_impl`) when its
-    constraints hold: DFT-flavoured rFFT magnitudes and an nbin that fits
-    the kernel's VMEM budget."""
+    """'auto' picks the fused Pallas diagnostics kernel on TPU float32 runs
+    (same rationale as :func:`resolve_median_impl` — sharded programs route
+    it through shard_map, see parallel/shard_stats) when its constraints
+    hold: DFT-flavoured rFFT magnitudes and an nbin that fits the kernel's
+    VMEM budget."""
     if stats_impl != "auto":
         return stats_impl
     from iterative_cleaner_tpu.stats.pallas_kernels import (

@@ -101,7 +101,7 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
                    back_shifts, *, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, fft_mode="fft",
                    median_impl="sort", stats_impl="xla",
-                   stats_frame="dispersed"):
+                   stats_frame="dispersed", shard_mesh=None):
     """One cleaning iteration: template -> fit -> residual stats -> new weights.
 
     ``weights`` are the previous iteration's (template) weights;
@@ -116,6 +116,12 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
     invariant up to interpolation rounding): ``disp_base`` may be None and
     the fused kernel reads the cube once instead of twice.  Returns
     (new_weights, scores).
+
+    ``shard_mesh`` (a 2-D ('sub', 'chan') Mesh) routes the Pallas paths
+    through :mod:`iterative_cleaner_tpu.parallel.shard_stats` so they stay
+    partitioned under GSPMD — a bare ``pallas_call`` in a sharded program
+    would gather its operands onto every device.  The XLA/sort paths ignore
+    it (GSPMD partitions them natively).
     """
     if stats_impl == "fused" and fft_mode == "fft":
         raise ValueError(
@@ -128,12 +134,21 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
     if stats_frame == "dedispersed":
         window = jnp.ones((nbin,), ded_cube.dtype) if m is None else m
         if stats_impl == "fused":
-            from iterative_cleaner_tpu.stats.pallas_kernels import (
-                cell_diagnostics_pallas_dedisp,
-            )
+            if shard_mesh is not None:
+                from iterative_cleaner_tpu.parallel.shard_stats import (
+                    sharded_cell_diagnostics_fused_dedisp,
+                )
 
-            diags = cell_diagnostics_pallas_dedisp(
-                ded_cube, template, window, orig_weights, cell_mask)
+                diags = sharded_cell_diagnostics_fused_dedisp(
+                    shard_mesh, ded_cube, template, window, orig_weights,
+                    cell_mask)
+            else:
+                from iterative_cleaner_tpu.stats.pallas_kernels import (
+                    cell_diagnostics_pallas_dedisp,
+                )
+
+                diags = cell_diagnostics_pallas_dedisp(
+                    ded_cube, template, window, orig_weights, cell_mask)
         else:
             amps = fit_template_amplitudes(ded_cube, template, jnp)
             resid = (amps[:, :, None] * template - ded_cube) * window
@@ -147,19 +162,38 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
         rot_t = rotate_bins(jnp.broadcast_to(t, (nchan, nbin)), back_shifts,
                             jnp, method=rotation)
         if stats_impl == "fused":
-            from iterative_cleaner_tpu.stats.pallas_kernels import (
-                cell_diagnostics_pallas,
-            )
+            if shard_mesh is not None:
+                from iterative_cleaner_tpu.parallel.shard_stats import (
+                    sharded_cell_diagnostics_fused,
+                )
 
-            diags = cell_diagnostics_pallas(ded_cube, disp_base, rot_t,
-                                            template, orig_weights, cell_mask)
+                diags = sharded_cell_diagnostics_fused(
+                    shard_mesh, ded_cube, disp_base, rot_t, template,
+                    orig_weights, cell_mask)
+            else:
+                from iterative_cleaner_tpu.stats.pallas_kernels import (
+                    cell_diagnostics_pallas,
+                )
+
+                diags = cell_diagnostics_pallas(
+                    ded_cube, disp_base, rot_t, template, orig_weights,
+                    cell_mask)
         else:
             amps = fit_template_amplitudes(ded_cube, template, jnp)
             resid = amps[:, :, None] * rot_t[None] - disp_base  # ref :277-279
             weighted = resid * orig_weights[:, :, None]  # apply_weights :291-297
             diags = cell_diagnostics_jax(weighted, cell_mask, fft_mode)
-    scores = scale_and_combine(diags, cell_mask, chanthresh, subintthresh,
-                               median_impl)
+    if shard_mesh is not None and median_impl == "pallas":
+        from iterative_cleaner_tpu.parallel.shard_stats import (
+            sharded_scale_and_combine,
+        )
+
+        scores = sharded_scale_and_combine(shard_mesh, diags, cell_mask,
+                                           chanthresh, subintthresh,
+                                           median_impl)
+    else:
+        scores = scale_and_combine(diags, cell_mask, chanthresh,
+                                   subintthresh, median_impl)
     new_weights = jnp.where(scores >= 1.0, 0.0, orig_weights)  # ref :300-305
     return new_weights, scores
 
@@ -170,7 +204,8 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
                           rotation, fft_mode="fft",
                           median_impl="sort",
                           stats_impl="xla",
-                          stats_frame="dispersed") -> CleanOutputs:
+                          stats_frame="dispersed",
+                          shard_mesh=None) -> CleanOutputs:
     """Run the full iteration loop on an already-prepared cube.
 
     ``ded_cube``: baseline-removed, dedispersed (nsub, nchan, nbin) cube.
@@ -215,7 +250,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
             pulse_slice=pulse_slice, pulse_scale=pulse_scale,
             pulse_active=pulse_active, rotation=rotation, fft_mode=fft_mode,
             median_impl=median_impl, stats_impl=stats_impl,
-            stats_frame=stats_frame,
+            stats_frame=stats_frame, shard_mesh=shard_mesh,
         )
         seen = jnp.arange(max_iter + 1) < c.count
         matches = jnp.all(c.history == new_w[None], axis=(1, 2)) & seen

@@ -1,0 +1,123 @@
+"""Shard-mapped Pallas statistics for multi-device cleaning programs.
+
+A ``pallas_call`` placed directly inside a GSPMD program is not
+partitionable: XLA falls back to gathering the operands onto every device
+and running the kernel on the full array — which is why round 1 forced the
+sharded and batched paths onto the sort-based medians.  ``jax.shard_map``
+fixes that: the kernel runs per-device on the local shard (SPMD), with
+explicit collectives only where the math genuinely crosses the mesh.
+
+Two wrappers, matching the two Pallas kernels of
+:mod:`iterative_cleaner_tpu.stats.pallas_kernels`:
+
+- **Fused cell diagnostics** — the per-cell half of an iteration (fit,
+  residual, weighting, four diagnostics; reference
+  ``/root/reference/iterative_cleaner.py:206-212,275-296``) is row-local to
+  a (subint, channel) cell, so the shard_map needs *no collectives at all*:
+  every device runs the fused kernel on its (sub-shard × chan-shard) block
+  of the cube.
+- **scale_and_combine** — the scaler medians reduce across whole lines of
+  the (nsub, nchan) diagnostic matrices (the channel scaler needs every
+  subint of a channel, the subint scaler every channel of a subint;
+  reference :229-256).  Those matrices are tiny relative to the cube
+  (SURVEY.md §2.3: ≤ 1024×4096 floats ≈ 16 MB), so each device all-gathers
+  the four diagnostics plus the cell mask, runs the full single-device
+  scaler — radix-bisection Pallas medians included — and keeps only its
+  shard of the scores.  Bit-parity with the single-device path is
+  structural: the gathered compute *is* the single-device function.
+
+Shapes must divide the mesh ('sub', 'chan') axes exactly (a shard_map
+requirement); :func:`shard_divisible` is the caller-side check.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
+
+_CELL = P("sub", "chan")
+_CUBE = P("sub", "chan", None)
+_CHAN_ROW = P("chan", None)
+_REP = P()
+
+
+def shard_divisible(mesh, nsub: int, nchan: int) -> bool:
+    """True when each mesh axis size divides its (nsub, nchan) cell-grid
+    dimension exactly, i.e. the grid splits into equal shards (shard_map's
+    layout requirement, and what NamedSharding's device_put enforces)."""
+    return (nsub % int(mesh.shape["sub"]) == 0
+            and nchan % int(mesh.shape["chan"]) == 0)
+
+
+def _gather_cells(x):
+    """All-gather a ('sub', 'chan')-sharded matrix to full size on every
+    device (both axes tiled back into position)."""
+    x = jax.lax.all_gather(x, "sub", axis=0, tiled=True)
+    return jax.lax.all_gather(x, "chan", axis=1, tiled=True)
+
+
+def sharded_scale_and_combine(mesh, diagnostics, cell_mask, chanthresh,
+                              subintthresh, median_impl):
+    """:func:`~iterative_cleaner_tpu.stats.masked_jax.scale_and_combine`
+    over ('sub', 'chan')-sharded diagnostics, Pallas medians allowed.
+
+    Gather-compute-slice: the full scaler runs redundantly on every device
+    (the diagnostics are ~cube_size/nbin — noise next to the cube passes),
+    which keeps one code path and exact parity for every ``median_impl``.
+    Returns the scores sharded like the inputs.
+    """
+
+    def local(d_std, d_mean, d_ptp, d_fft, mask):
+        full = tuple(_gather_cells(d) for d in (d_std, d_mean, d_ptp, d_fft))
+        scores = scale_and_combine(full, _gather_cells(mask), chanthresh,
+                                   subintthresh, median_impl)
+        ns, nc = mask.shape
+        return jax.lax.dynamic_slice(
+            scores,
+            (jax.lax.axis_index("sub") * ns, jax.lax.axis_index("chan") * nc),
+            (ns, nc),
+        )
+
+    # check_vma=False: pallas_call's abstract eval carries no varying-mesh
+    # annotation, so shard_map's replication checker cannot see through it.
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(_CELL,) * 5,
+                       out_specs=_CELL, check_vma=False)
+    return fn(*diagnostics, cell_mask)
+
+
+def sharded_cell_diagnostics_fused(mesh, ded, disp_base, rot_t, template,
+                                   weights, cell_mask):
+    """Dispersed-frame fused diagnostics kernel on each device's cube shard.
+
+    Cell-local math — no collectives; the template (and its norm, computed
+    inside the kernel setup) is replicated, the per-channel rotated template
+    rides the 'chan' axis with the cube.
+    """
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        cell_diagnostics_pallas,
+    )
+
+    fn = jax.shard_map(
+        cell_diagnostics_pallas, mesh=mesh,
+        in_specs=(_CUBE, _CUBE, _CHAN_ROW, _REP, _CELL, _CELL),
+        out_specs=(_CELL,) * 4, check_vma=False,
+    )
+    return fn(ded, disp_base, rot_t, template, weights, cell_mask)
+
+
+def sharded_cell_diagnostics_fused_dedisp(mesh, ded, template, window,
+                                          weights, cell_mask):
+    """Dedispersed-frame fused diagnostics kernel (one cube read) on each
+    device's cube shard; template and pulse window replicated."""
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        cell_diagnostics_pallas_dedisp,
+    )
+
+    fn = jax.shard_map(
+        cell_diagnostics_pallas_dedisp, mesh=mesh,
+        in_specs=(_CUBE, _REP, _REP, _CELL, _CELL),
+        out_specs=(_CELL,) * 4, check_vma=False,
+    )
+    return fn(ded, template, window, weights, cell_mask)

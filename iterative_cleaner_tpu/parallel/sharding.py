@@ -26,7 +26,8 @@ from iterative_cleaner_tpu.config import CleanConfig
 def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
                            pulse_slice, pulse_scale, pulse_active, rotation,
                            baseline_duty, fft_mode, median_impl="sort",
-                           stats_frame="dispersed", dedispersed=False):
+                           stats_frame="dispersed", dedispersed=False,
+                           stats_impl="xla"):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -39,6 +40,10 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
     cube_sh = NamedSharding(mesh, P("sub", "chan", None))
     w_sh = NamedSharding(mesh, P("sub", "chan"))
     rep = NamedSharding(mesh, P())
+    # Pallas paths need the explicit shard_map route (parallel/shard_stats);
+    # the sort/xla paths partition natively under GSPMD.
+    shard_mesh = mesh if (median_impl == "pallas"
+                          or stats_impl == "fused") else None
 
     def run(cube, weights, freqs, dm, ref, period):
         ded, shifts = prepare_cube_jax(
@@ -50,7 +55,8 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
             subintthresh=subintthresh, pulse_slice=pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
-            stats_frame=stats_frame,
+            stats_frame=stats_frame, stats_impl=stats_impl,
+            shard_mesh=shard_mesh,
         )
 
     fn = jax.jit(
@@ -81,8 +87,11 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
 
     from iterative_cleaner_tpu.backends.jax_backend import (
         resolve_fft_mode,
+        resolve_median_impl,
         resolve_stats_frame,
+        resolve_stats_impl,
     )
+    from iterative_cleaner_tpu.parallel.shard_stats import shard_divisible
 
     if config.unload_res or config.record_history:
         raise ValueError(
@@ -91,16 +100,26 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
             "clean unsharded for those outputs")
 
     dtype = jnp.dtype(config.dtype)
-    # 'auto' stays on the sort path here: a pallas_call inside a GSPMD
-    # program forces the diagnostics to gather onto one device.
-    median_impl = "sort" if config.median_impl == "auto" else config.median_impl
+    fft_mode = resolve_fft_mode(config.fft_mode, dtype)
+    # Fail fast on uneven layouts: NamedSharding's device_put rejects them
+    # anyway (deep inside jit), and the shard_map-routed Pallas kernels
+    # (parallel/shard_stats) require exact division too.
+    if not shard_divisible(mesh, cube.shape[0], cube.shape[1]):
+        raise ValueError(
+            f"each mesh axis must divide the cell grid exactly: grid "
+            f"{cube.shape[0]}x{cube.shape[1]} vs mesh {dict(mesh.shape)}; "
+            "pad the archive or pick a mesh whose axis sizes divide "
+            "(nsub, nchan)")
+    median_impl = resolve_median_impl(config.median_impl, dtype)
+    stats_impl = resolve_stats_impl(config.stats_impl, dtype,
+                                    cube.shape[-1], fft_mode)
     fn, cube_sh, w_sh, rep = build_sharded_clean_fn(
         mesh, config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
         config.rotation, config.baseline_duty,
-        resolve_fft_mode(config.fft_mode, dtype), median_impl,
+        fft_mode, median_impl,
         resolve_stats_frame(config.stats_frame, dtype),
-        bool(dedispersed),
+        bool(dedispersed), stats_impl,
     )
     with mesh:
         outs = fn(

@@ -68,12 +68,22 @@ class StreamingCleaner:
         # then cleaned sharded over it (parallel/sharding.py), composing the
         # long-observation streaming mode with multi-chip execution: tile
         # shapes are constant, so all tiles share one compiled program.
-        if mesh is not None and (config.unload_res or config.record_history):
+        if mesh is not None:
             # fail at construction, not minutes into a live stream when the
             # first tile fills (clean_cube_sharded would reject it then)
-            raise ValueError(
-                "unload_res/record_history are not supported with a mesh "
-                "(sharded tiles do not gather residuals/history)")
+            if config.unload_res or config.record_history:
+                raise ValueError(
+                    "unload_res/record_history are not supported with a "
+                    "mesh (sharded tiles do not gather residuals/history)")
+            from iterative_cleaner_tpu.parallel.shard_stats import (
+                shard_divisible,
+            )
+
+            if not shard_divisible(mesh, int(chunk_nsub), len(freqs_mhz)):
+                raise ValueError(
+                    f"each mesh axis must divide the tile grid exactly: "
+                    f"tile {int(chunk_nsub)}x{len(freqs_mhz)} vs mesh "
+                    f"{dict(mesh.shape)}; adjust chunk_nsub or the mesh")
         self.chunk_nsub = int(chunk_nsub)
         self.config = config
         self.freqs_mhz = np.asarray(freqs_mhz)

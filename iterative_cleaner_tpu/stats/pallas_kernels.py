@@ -142,8 +142,8 @@ def _cell_blocks(nbin: int):
     VMEM per step scales as ``S_BLK * C_BLK * nbin`` (two cube blocks +
     the flat intermediates) on top of the O(nbin^2) DFT tables, so the
     channel block shrinks as profiles lengthen — the footprint stays
-    roughly flat from 256 to 1024 bins (~12 MB worst case incl. the
-    2x2.6 MB tables at 1024, inside the ~16 MB budget).
+    roughly flat from 256 to 1024 bins (measured on a v5e: C_BLK=128
+    overflows VMEM at 512 bins, these tiers compile and run at all sizes).
 
     This is deliberately cell-axis tiling, not bin-axis tiling: the
     closed-form amplitude needs a full-bin reduction *before* the residual
@@ -152,6 +152,14 @@ def _cell_blocks(nbin: int):
     cross-grid-step accumulators for six partial statistics.  Shrinking
     the cell block keeps the single-pass two-read structure at every nbin;
     bin reductions stay whole-line on the VPU lanes.
+
+    Mosaic legality at C_BLK < 128: a (S_BLK, C_BLK) block over the
+    (nsub, nchan) cell-plane arrays would violate the lane-tiling rule
+    (last block dim must be a multiple of 128 or the full array dim), so
+    the scaffold reshapes those arrays to (nchan/C_BLK, nsub, C_BLK) —
+    blocks (1, S_BLK, C_BLK) whose last dim IS the full (reshaped) array
+    dim.  Cube blocks are unaffected: their last dim is the whole bin
+    axis, and C_BLK sits second-to-last where a multiple of 8 suffices.
     """
     if nbin <= 256:
         return _S_BLK, 128
@@ -175,13 +183,13 @@ FUSED_STATS_MAX_NBIN = 1024
 def _write_diags(wres, mask, cos_ref, sin_ref,
                  std_ref, mean_ref, ptp_ref, fft_ref):
     """Shared diagnostics tail: the four per-cell statistics of a weighted
-    residual tile (S, C, B), written to the output refs."""
+    residual tile (S, C, B), written to the (1, S, C) output refs."""
     nbin = wres.shape[-1]
     inv_n = np.float32(1.0 / nbin)
     mean = jnp.sum(wres, axis=2) * inv_n
-    mean_ref[:] = jnp.where(mask, np.float32(0.0), mean)
+    mean_ref[0] = jnp.where(mask, np.float32(0.0), mean)
     ptp = jnp.max(wres, axis=2) - jnp.min(wres, axis=2)
-    ptp_ref[:] = jnp.where(mask, _MA_FILL_F32, ptp)
+    ptp_ref[0] = jnp.where(mask, _MA_FILL_F32, ptp)
 
     # mask-aware mean subtraction (reference :210-211); the tile is
     # VMEM-resident, so the two-pass centred variance (jnp.std's stable
@@ -190,7 +198,7 @@ def _write_diags(wres, mask, cos_ref, sin_ref,
     # patched to 0.
     centred = wres - jnp.where(mask, np.float32(0.0), mean)[:, :, None]
     var = jnp.sum(centred * centred, axis=2) * inv_n
-    std_ref[:] = jnp.where(mask, np.float32(0.0), jnp.sqrt(var))
+    std_ref[0] = jnp.where(mask, np.float32(0.0), jnp.sqrt(var))
     flat = centred.reshape(-1, nbin)                # (S*C, B)
     re = jax.lax.dot_general(flat, cos_ref[:], (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32,
@@ -199,7 +207,7 @@ def _write_diags(wres, mask, cos_ref, sin_ref,
                              preferred_element_type=jnp.float32,
                              precision=jax.lax.Precision.HIGHEST)
     mag2 = re * re + im * im                        # (S*C, K)
-    fft_ref[:] = jnp.sqrt(jnp.max(mag2, axis=1)).reshape(ptp_ref.shape)
+    fft_ref[0] = jnp.sqrt(jnp.max(mag2, axis=1)).reshape(mask.shape)
 
 
 def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
@@ -212,8 +220,8 @@ def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
     tp = jnp.sum(ded * t[None, None, :], axis=2)
     amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
     resid = amp[:, :, None] * rott_ref[:][None] - disp_ref[:]
-    wres = resid * w_ref[:][:, :, None]             # apply_weights
-    _write_diags(wres, m_ref[:], cos_ref, sin_ref,
+    wres = resid * w_ref[0][:, :, None]             # apply_weights
+    _write_diags(wres, m_ref[0], cos_ref, sin_ref,
                  std_ref, mean_ref, ptp_ref, fft_ref)
 
 
@@ -230,24 +238,31 @@ def _cell_stats_dedisp_kernel(ded_ref, t_ref, win_ref, w_ref, m_ref,
     tp = jnp.sum(ded * t[None, None, :], axis=2)
     amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
     resid = (amp[:, :, None] * t[None, None, :] - ded) * win[None, None, :]
-    wres = resid * w_ref[:][:, :, None]             # apply_weights
-    _write_diags(wres, m_ref[:], cos_ref, sin_ref,
+    wres = resid * w_ref[0][:, :, None]             # apply_weights
+    _write_diags(wres, m_ref[0], cos_ref, sin_ref,
                  std_ref, mean_ref, ptp_ref, fft_ref)
 
 
 class _FusedScaffold:
     """Shared launch scaffolding for the fused cell kernels: pads the
     cell-grid inputs to block multiples (padding cells masked), and owns
-    the grid/specs/out-slicing both kernels must agree on."""
+    the grid/specs/out-slicing both kernels must agree on.
+
+    Cell-plane arrays (weights, mask, the four outputs) travel reshaped as
+    (nc/C_BLK, nsub_padded, C_BLK) so their (1, S_BLK, C_BLK) blocks keep
+    the last dim equal to the full (reshaped) array dim — Mosaic's lane
+    tiling otherwise demands a multiple of 128, which the VMEM-driven
+    C_BLK tiers of :func:`_cell_blocks` break past 256 bins."""
 
     def __init__(self, nsub, nchan, nbin):
         self.nsub, self.nchan, self.nbin = nsub, nchan, nbin
         s_blk, c_blk = _cell_blocks(nbin)
+        self.c_blk = c_blk
         self.pad_s = (-nsub) % s_blk
         self.pad_c = (-nchan) % c_blk
         self.ns, self.nc = nsub + self.pad_s, nchan + self.pad_c
         self.grid = (self.ns // s_blk, self.nc // c_blk)
-        self.cell_spec = pl.BlockSpec((s_blk, c_blk), lambda i, j: (i, j),
+        self.cell_spec = pl.BlockSpec((1, s_blk, c_blk), lambda i, j: (j, i, 0),
                                       memory_space=pltpu.VMEM)
         self.cube_spec = pl.BlockSpec((s_blk, c_blk, nbin),
                                       lambda i, j: (i, j, 0),
@@ -264,12 +279,17 @@ class _FusedScaffold:
     def pad_chan_row(self, x):
         return jnp.pad(x, ((0, self.pad_c), (0, 0))) if self.pad_c else x
 
+    def to_cellrows(self, x):
+        """(ns, nc) cell plane -> (nc/C_BLK, ns, C_BLK) chunk-major form."""
+        return x.reshape(self.ns, self.nc // self.c_blk,
+                         self.c_blk).swapaxes(0, 1)
+
     def pad_cells(self, weights, cell_mask):
-        if not (self.pad_s or self.pad_c):
-            return weights, cell_mask
-        pads = ((0, self.pad_s), (0, self.pad_c))
-        return (jnp.pad(weights, pads),
-                jnp.pad(cell_mask, pads, constant_values=True))
+        if self.pad_s or self.pad_c:
+            pads = ((0, self.pad_s), (0, self.pad_c))
+            weights = jnp.pad(weights, pads)
+            cell_mask = jnp.pad(cell_mask, pads, constant_values=True)
+        return self.to_cellrows(weights), self.to_cellrows(cell_mask)
 
     def launch(self, kernel, inputs, in_specs, cos_t, sin_t, tt_info,
                interpret):
@@ -283,14 +303,18 @@ class _FusedScaffold:
         ]
         outs = pl.pallas_call(
             kernel,
-            out_shape=[jax.ShapeDtypeStruct((self.ns, self.nc),
-                                            jnp.float32)] * 4,
+            out_shape=[jax.ShapeDtypeStruct(
+                (self.nc // self.c_blk, self.ns, self.c_blk),
+                jnp.float32)] * 4,
             grid=self.grid,
             in_specs=list(in_specs) + table_specs,
             out_specs=[self.cell_spec] * 4,
             interpret=interpret,
         )(*inputs, cos_t, sin_t, tt_info)
-        return tuple(o[: self.nsub, : self.nchan] for o in outs)
+        return tuple(
+            o.swapaxes(0, 1).reshape(self.ns, self.nc)[: self.nsub,
+                                                       : self.nchan]
+            for o in outs)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

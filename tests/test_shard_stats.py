@@ -1,0 +1,154 @@
+"""shard_map-routed Pallas statistics (parallel/shard_stats.py) on the
+8-device virtual CPU mesh — the kernels run in interpret mode, the
+collective/slicing structure is the real one (VERDICT round-1 item 4:
+multi-device programs must not lose the Pallas kernels)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.parallel.mesh import cell_mesh
+from iterative_cleaner_tpu.parallel.shard_stats import (
+    shard_divisible,
+    sharded_cell_diagnostics_fused,
+    sharded_cell_diagnostics_fused_dedisp,
+    sharded_scale_and_combine,
+)
+from iterative_cleaner_tpu.parallel.sharding import clean_cube_sharded
+from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
+
+
+def _mesh():
+    return cell_mesh(8)  # (2, 4) over ('sub', 'chan')
+
+
+def _diagnostics(nsub=16, nchan=32, seed=0):
+    """Random float32 diagnostics + a mask with whole dead lines (the
+    adversarial cases of the scaler: fully-masked channel, masked cells)."""
+    rng = np.random.default_rng(seed)
+    diags = tuple(
+        jnp.asarray(rng.normal(size=(nsub, nchan)).astype(np.float32))
+        for _ in range(4))
+    mask = rng.random((nsub, nchan)) < 0.15
+    mask[:, 3] = True           # fully-masked channel
+    mask[5, :] = True           # fully-masked subint
+    return diags, jnp.asarray(mask)
+
+
+@pytest.mark.parametrize("median_impl", ["pallas", "sort"])
+def test_sharded_scale_and_combine_matches_single(median_impl):
+    diags, mask = _diagnostics()
+    # jitted reference: the engine always runs this compiled, and eager
+    # op-by-op execution differs from the fused program by ulps on CPU
+    expect = np.asarray(jax.jit(
+        lambda *a: scale_and_combine(a[:4], a[4], 5.0, 3.0, median_impl)
+    )(*diags, mask))
+    mesh = _mesh()
+    with mesh:
+        got = np.asarray(jax.jit(
+            lambda *a: sharded_scale_and_combine(mesh, a[:4], a[4], 5.0, 3.0,
+                                                 median_impl)
+        )(*diags, mask))
+    np.testing.assert_array_equal(expect, got)
+
+
+def _fused_inputs(nsub=16, nchan=32, nbin=64, seed=1):
+    rng = np.random.default_rng(seed)
+    f32 = np.float32
+    ded = jnp.asarray(rng.normal(size=(nsub, nchan, nbin)).astype(f32))
+    disp = jnp.asarray(rng.normal(size=(nsub, nchan, nbin)).astype(f32))
+    rot_t = jnp.asarray(rng.normal(size=(nchan, nbin)).astype(f32))
+    template = jnp.asarray(rng.normal(size=(nbin,)).astype(f32))
+    weights = jnp.asarray(
+        (rng.random((nsub, nchan)) > 0.1).astype(f32))
+    mask = weights == 0
+    return ded, disp, rot_t, template, weights, mask
+
+
+def test_sharded_fused_diagnostics_match_single():
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        cell_diagnostics_pallas,
+    )
+
+    ded, disp, rot_t, template, weights, mask = _fused_inputs()
+    expect = cell_diagnostics_pallas(ded, disp, rot_t, template, weights,
+                                     mask)
+    mesh = _mesh()
+    with mesh:
+        got = jax.jit(lambda *a: sharded_cell_diagnostics_fused(mesh, *a))(
+            ded, disp, rot_t, template, weights, mask)
+    for e, g in zip(expect, got):
+        np.testing.assert_array_equal(np.asarray(e), np.asarray(g))
+
+
+def test_sharded_fused_dedisp_diagnostics_match_single():
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        cell_diagnostics_pallas_dedisp,
+    )
+
+    ded, _, _, template, weights, mask = _fused_inputs(seed=2)
+    window = jnp.ones((ded.shape[-1],), jnp.float32)
+    expect = cell_diagnostics_pallas_dedisp(ded, template, window, weights,
+                                            mask)
+    mesh = _mesh()
+    with mesh:
+        got = jax.jit(
+            lambda *a: sharded_cell_diagnostics_fused_dedisp(mesh, *a))(
+            ded, template, window, weights, mask)
+    for e, g in zip(expect, got):
+        np.testing.assert_array_equal(np.asarray(e), np.asarray(g))
+
+
+# --- end-to-end: the sharded cleaning path with the Pallas kernels ---------
+
+def _archive(nsub=16, nchan=32, nbin=64, seed=3):
+    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+    ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                   seed=seed, dtype=np.float32)
+    return ar
+
+
+@pytest.mark.parametrize("stats_frame", ["dispersed", "dedispersed"])
+def test_sharded_pallas_clean_matches_single_device(stats_frame):
+    """Full sharded cleaning with median_impl='pallas' + stats_impl='fused'
+    produces the same mask as the single-device engine (both impl pairs)."""
+    from iterative_cleaner_tpu.backends.jax_backend import clean_cube
+
+    ar = _archive()
+    kw = dict(max_iter=3, rotation="roll", fft_mode="dft", dtype="float32",
+              stats_frame=stats_frame)
+    cfg_pallas = CleanConfig(median_impl="pallas", stats_impl="fused", **kw)
+    cfg_sort = CleanConfig(median_impl="sort", stats_impl="xla", **kw)
+
+    single = clean_cube(ar.total_intensity(), ar.weights, ar.freqs_mhz,
+                        ar.dm, ar.centre_freq_mhz, ar.period_s, cfg_pallas)
+    oracle = clean_cube(ar.total_intensity(), ar.weights, ar.freqs_mhz,
+                        ar.dm, ar.centre_freq_mhz, ar.period_s, cfg_sort)
+    sharded = clean_cube_sharded(ar.total_intensity(), ar.weights,
+                                 ar.freqs_mhz, ar.dm, ar.centre_freq_mhz,
+                                 ar.period_s, cfg_pallas, _mesh())
+    np.testing.assert_array_equal(single.final_weights, sharded.final_weights)
+    np.testing.assert_array_equal(oracle.final_weights == 0,
+                                  sharded.final_weights == 0)
+    assert sharded.loops == single.loops
+    assert sharded.converged == single.converged
+
+
+def test_uneven_grid_fails_fast():
+    """NamedSharding rejects uneven shards deep inside jit; the sharded
+    entry point surfaces that as an immediate, actionable error instead."""
+    ar = _archive(nsub=10, nchan=34)  # 10 % 2 == 0 but 34 % 4 != 0
+    mesh = _mesh()
+    assert not shard_divisible(mesh, 10, 34)
+    for cfg in (CleanConfig(median_impl="pallas", max_iter=2,
+                            rotation="roll", fft_mode="dft",
+                            dtype="float32"),
+                CleanConfig(max_iter=2, rotation="roll", fft_mode="dft",
+                            dtype="float32")):
+        with pytest.raises(ValueError, match="mesh axis must divide"):
+            clean_cube_sharded(ar.total_intensity(), ar.weights,
+                               ar.freqs_mhz, ar.dm, ar.centre_freq_mhz,
+                               ar.period_s, cfg, mesh)
