@@ -20,10 +20,19 @@ hang watchdog; BENCH_PROBE_TIMEOUT (s) bounds the device probe.
   costs (device-tunnel round-trip latency, output D2H) cancel; the raw
   whole-clean rate is reported on stderr alongside the one-off H2D time.
   Falls back to the raw rate if the cleaner converges in one iteration.
-- vs_baseline: that rate divided by the numpy oracle's rate, measured on a
-  proportionally smaller slice (the oracle is O(cells) throughout, so
-  per-cell-iteration rates are comparable; full-size oracle runs take tens
-  of minutes on one CPU core).
+- vs_baseline: that rate divided by the numpy oracle's rate.  On the
+  full-size config the denominator is the RECORDED full-size oracle rate
+  (1.54e4 cell-iters/s = 273.3 s/iteration, BASELINE.md "Measured
+  baselines") — the honest headline methodology; a live 1/16-slice oracle
+  still runs as an environment sanity check and its (cache-friendlier,
+  ~2-3x higher) rate is reported on stderr.  Small/fallback configs divide
+  by the live-measured rate instead (the recorded constant only describes
+  the full-size config).
+- hbm_util: achieved HBM bytes/s over the chip's peak bandwidth — the
+  workload is bandwidth-bound (the fused path reads the cube 3x per
+  iteration: template einsum + the two kernel reads), so this is the
+  roofline number that distinguishes "fast" from "merely faster than
+  numpy".  null off TPU or when the chip's bandwidth is unknown.
 
 Environment knobs: BENCH_SMALL=1 shrinks everything for a quick smoke run;
 BENCH_TIMEOUT (s) arms the hang watchdog; BENCH_PROBE_TIMEOUT (s) bounds
@@ -40,6 +49,37 @@ import numpy as np
 
 def _log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+# Full-size float64 oracle, 1024x4096x128, one CPU socket: 273.3 s/iteration
+# (BASELINE.md "Measured baselines", measured in-repo round 1).
+ORACLE_FULL_RATE = 1024 * 4096 / 273.3  # ~1.54e4 cell-iters/s
+
+# Peak HBM bandwidth by device_kind substring, bytes/s (public chip specs).
+_HBM_PEAK = {
+    "v5 lite": 819e9,   # TPU v5e
+    "v5p": 2765e9,
+    "v4": 1228e9,
+    "v6 lite": 1640e9,  # Trillium
+}
+
+
+def _hbm_peak(device_kind: str):
+    for key, bw in _HBM_PEAK.items():
+        if key in device_kind.lower():
+            return bw
+    return None
+
+
+def _cube_passes(stats_impl, stats_frame):
+    """HBM cube reads per iteration for the bytes-moved model: the template
+    einsum always reads the cube once; the fused kernel reads ded+disp_base
+    (dispersed frame) or just ded (dedispersed frame); the XLA path
+    additionally materialises the residual cube (write + two stat-pass
+    reads on top of the fit/base reads)."""
+    if stats_impl == "fused":
+        return 2.0 if stats_frame == "dedispersed" else 3.0
+    return 6.0  # template + fit read + base read + resid write + 2 stat reads
 
 
 def _arm_watchdog(seconds: float):
@@ -128,10 +168,29 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=3):
         _log(f"differential per-iteration: {per_iter * 1e3:.1f} ms "
              f"-> {rate:.3e} cell-iters/s (fixed dispatch cost removed)")
     else:
+        per_iter = None  # raw time still carries the fixed dispatch cost
         rate = raw_rate
         _log("differential timing unavailable (converged in one iteration "
              "or timer noise); reporting the raw rate")
-    return rate, dev.platform
+
+    hbm_util = None
+    peak = _hbm_peak(str(getattr(dev, "device_kind", "")))
+    if peak and dev.platform == "tpu" and per_iter is not None:
+        # Only meaningful on the differential time: the raw per-clean time
+        # contains ~50 ms of fixed dispatch/D2H cost that would silently
+        # halve the utilisation figure.
+        stats_frame = "dispersed"  # build_clean_fn default above
+        passes = _cube_passes(stats_impl, stats_frame)
+        bytes_per_iter = passes * cube.nbytes
+        achieved = bytes_per_iter / per_iter
+        hbm_util = achieved / peak
+        _log(f"modelled HBM traffic: {bytes_per_iter / 1e9:.2f} GB/iteration "
+             f"({passes:.0f} cube passes, stats_impl={stats_impl}) -> "
+             f"{achieved / 1e9:.0f} GB/s achieved / {peak / 1e9:.0f} GB/s "
+             f"peak = {hbm_util:.2f} HBM utilisation")
+    elif per_iter is None:
+        _log("hbm_util omitted: no clean differential per-iteration time")
+    return rate, dev.platform, hbm_util
 
 
 def bench_numpy(nsub, nchan, nbin, max_iter=5):
@@ -186,10 +245,10 @@ def main():
 
     np_rate = bench_numpy(*np_cfg)
 
-    jax_rate = platform = None
+    jax_rate = platform = hbm_util = None
     for cfg in (jax_cfg, (512, 4096, 128), (512, 2048, 128)):
         try:
-            jax_rate, platform = bench_jax(*cfg)
+            jax_rate, platform, hbm_util = bench_jax(*cfg)
             jax_cfg = cfg
             break
         except Exception as e:  # OOM fallback ladder
@@ -197,13 +256,28 @@ def main():
     if jax_rate is None:
         raise SystemExit("all jax bench configs failed")
 
+    if not small and jax_cfg == (1024, 4096, 128):
+        # Headline methodology (BASELINE.md "Measured baselines"): divide by
+        # the recorded FULL-SIZE oracle rate; the live 1/16-slice run above
+        # is an environment sanity check (cache-friendlier, so faster).
+        denom = ORACLE_FULL_RATE
+        _log(f"denominator: recorded full-size oracle rate {denom:.3e} "
+             f"cell-iters/s (273.3 s/iteration, BASELINE.md); live 1/16 "
+             f"slice sanity check measured {np_rate:.3e}")
+    else:
+        denom = np_rate
+        _log(f"denominator: live-measured oracle rate {np_rate:.3e} "
+             "cell-iters/s (small/fallback config; the recorded full-size "
+             "constant only describes 1024x4096x128)")
+
     watchdog.cancel()
     print(json.dumps({
         "metric": "cells_cleaned_per_sec_%dx%d" % (jax_cfg[0], jax_cfg[1]),
         "value": round(jax_rate, 1),
         "unit": "cell-iters/s",
-        "vs_baseline": round(jax_rate / np_rate, 2),
+        "vs_baseline": round(jax_rate / denom, 2),
         "platform": platform,
+        "hbm_util": None if hbm_util is None else round(hbm_util, 3),
     }))
 
 
