@@ -1,0 +1,120 @@
+"""Real multi-process distributed execution (SURVEY.md section 5,
+"Distributed communication backend"; VERDICT round-1 row 30).
+
+Round 1 only ever exercised the jax.distributed bootstrap and the hybrid
+mesh on virtual devices inside ONE process.  This test launches two
+actual OS processes, each owning 4 virtual CPU devices, bootstraps them
+through :func:`iterative_cleaner_tpu.parallel.distributed.initialize`
+(coordinator on localhost), runs the sharded cleaning program over the
+8-device *global* mesh — so the scaler-median reductions really cross the
+process boundary through the distributed runtime — and checks each
+process's addressable shards of the final mask against a single-process
+reference clean.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from iterative_cleaner_tpu.parallel.distributed import initialize
+from iterative_cleaner_tpu.engine.loop import (
+    clean_dedispersed_jax, prepare_cube_jax)
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+from jax.sharding import Mesh
+
+port, pid = sys.argv[1], int(sys.argv[2])
+ctx = initialize(coordinator_address=f"127.0.0.1:{port}",
+                 num_processes=2, process_id=pid)
+assert ctx.process_count == 2, ctx
+assert ctx.local_devices == 4, ctx
+assert ctx.global_devices == 8, ctx
+
+# identical archive in both processes (same seed)
+ar, _ = make_synthetic_archive(nsub=8, nchan=16, nbin=32, seed=11,
+                               dtype=np.float64)
+cube = jnp.asarray(ar.total_intensity())
+weights = jnp.asarray(ar.weights)
+freqs = jnp.asarray(ar.freqs_mhz)
+
+def full(cube, weights, freqs):
+    ded, shifts = prepare_cube_jax(
+        cube, freqs, ar.dm, ar.centre_freq_mhz, ar.period_s,
+        baseline_duty=0.15, rotation="roll")
+    outs = clean_dedispersed_jax(
+        ded, weights, shifts, max_iter=3, chanthresh=5.0, subintthresh=5.0,
+        pulse_slice=(0, 0), pulse_scale=1.0, pulse_active=False,
+        rotation="roll", fft_mode="dft")
+    return outs.final_weights
+
+# single-process reference on this process's local devices only
+ref = np.asarray(jax.jit(full)(cube, weights, freqs))
+
+mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("sub", "chan"))
+csh = NamedSharding(mesh, P("sub", "chan", None))
+wsh = NamedSharding(mesh, P("sub", "chan"))
+rep = NamedSharding(mesh, P())
+fn = jax.jit(full, in_shardings=(csh, wsh, rep), out_shardings=wsh)
+with mesh:
+    out = fn(jax.device_put(cube, csh), jax.device_put(weights, wsh),
+             jax.device_put(freqs, rep))
+    out.block_until_ready()
+
+# compare only this process's addressable shards against the reference
+n_checked = 0
+for shard in out.addressable_shards:
+    got = np.asarray(shard.data)
+    r0, c0 = (idx.start or 0 for idx in shard.index)
+    want = ref[r0:r0 + got.shape[0], c0:c0 + got.shape[1]]
+    assert np.array_equal(got == 0, want == 0), (pid, shard.index)
+    assert np.allclose(got, want, rtol=1e-12), (pid, shard.index)
+    n_checked += 1
+assert n_checked == 4, n_checked
+print(f"WORKER_OK pid={pid} shards={n_checked}", flush=True)
+"""
+
+
+def test_two_process_sharded_clean(tmp_path):
+    import socket
+
+    with socket.socket() as s:  # free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers pin their own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(port), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert f"WORKER_OK pid={pid}" in out, out[-2000:]
