@@ -101,7 +101,7 @@ def _arm_watchdog(seconds: float):
     return t
 
 
-def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=3):
+def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=4):
     import jax
     import jax.numpy as jnp
 
@@ -197,7 +197,7 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=3):
         float(lo(*args))  # compile + warm
         float(hi(*args))
         best_lo = best_hi = float("inf")
-        for _ in range(max(repeats, 4)):
+        for _ in range(repeats):
             t0 = time.perf_counter()
             float(lo(*args))
             best_lo = min(best_lo, time.perf_counter() - t0)

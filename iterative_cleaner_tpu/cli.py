@@ -128,6 +128,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "program (amortises compile and dispatch for "
                              "many small archives). Incompatible with "
                              "--unload_res and --checkpoint.")
+    parser.add_argument("--model", choices=("surgical_scrub", "quicklook"),
+                        default="surgical_scrub",
+                        help="Cleaning strategy: the flagship iterative "
+                             "surgical scrub (reference algorithm), or the "
+                             "single-pass template-free quicklook triage "
+                             "cleaner (models/quicklook.py; jax backend "
+                             "only; no template stage, so --max_iter, "
+                             "-r/--pulse_region, --stats_impl and "
+                             "--stats_frame do not apply).")
     return parser
 
 
@@ -197,7 +206,10 @@ def clean_one(in_path: str, args: argparse.Namespace,
                   % ckpt.checkpoint_path(args.checkpoint, in_path))
     if result is None:
         with timer.phase("clean"):
-            result = clean_archive(ar, cfg)
+            from iterative_cleaner_tpu.models import get_model
+
+            result = get_model(getattr(args, "model", "surgical_scrub"))(
+                ar, cfg)
     if args.checkpoint and not resumed:
         os.makedirs(args.checkpoint, exist_ok=True)
         ckpt.save_clean_checkpoint(
@@ -361,6 +373,14 @@ def main(argv=None) -> int:
         build_parser().error(
             "--batch is incompatible with --unload_res/--checkpoint, "
             "requires --backend jax, and uses the vmap (xla) stats path")
+    if args.model != "surgical_scrub" and (args.backend != "jax"
+                                           or args.batch > 1
+                                           or args.unload_res
+                                           or args.checkpoint):
+        build_parser().error(
+            "--model %s requires --backend jax and is incompatible with "
+            "--batch/--unload_res/--checkpoint (single-pass, no residual; "
+            "checkpoints are keyed to the flagship strategy)" % args.model)
 
     # Probe the default device before the first jax computation: a dead
     # accelerator tunnel otherwise hangs PJRT init forever.  Skipped when a

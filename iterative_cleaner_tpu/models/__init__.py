@@ -10,9 +10,10 @@ the batched/sharded/streaming execution modes in
 :mod:`iterative_cleaner_tpu.parallel`.
 
 ``SURGICAL_SCRUB`` is the flagship entry: clean one archive with a
-:class:`~iterative_cleaner_tpu.config.CleanConfig`.  Alternative cleaning
-strategies (e.g. different diagnostic sets or thresholding rules) would
-register here alongside it.
+:class:`~iterative_cleaner_tpu.config.CleanConfig`.  ``QUICKLOOK``
+(:mod:`iterative_cleaner_tpu.models.quicklook`) is the single-pass
+template-free strategy for triage/pre-pass use; further strategies
+register the same way (a ``callable(archive, config) -> CleanResult``).
 """
 
 from iterative_cleaner_tpu.backends import CleanResult, clean_archive  # noqa: F401
@@ -37,12 +38,23 @@ def __getattr__(name):
 def __dir__():
     return sorted(list(globals()) + list(_ENGINE_EXPORTS))
 
+def _quicklook(archive, config):
+    # lazy: quicklook pulls in jax; keep numpy-oracle imports jax-free
+    from iterative_cleaner_tpu.models.quicklook import (
+        clean_archive_quicklook,
+    )
+
+    return clean_archive_quicklook(archive, config)
+
+
 # name -> callable(archive, config) -> CleanResult
 REGISTRY = {
     "surgical_scrub": clean_archive,
+    "quicklook": _quicklook,
 }
 
 SURGICAL_SCRUB = "surgical_scrub"
+QUICKLOOK = "quicklook"
 
 
 def get_model(name: str = SURGICAL_SCRUB):

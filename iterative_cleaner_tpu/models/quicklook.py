@@ -1,0 +1,101 @@
+"""Quicklook cleaner — the registry's second strategy.
+
+A single-pass, template-free zapper for quick-look processing: baseline
+removal, then the four surgical-scrub diagnostics computed on the
+*weighted data itself* (not a pulse-subtracted residual) and thresholded
+through the same channel/subint median/MAD scalers.  The per-channel and
+per-subint normalisation absorbs a steady pulse, so strong RFI stands out
+without paying the iterative template loop — one statistics pass instead
+of ``max_iter`` template-fit iterations.
+
+Relation to the reference: this is the surgical scrub of
+``/root/reference/iterative_cleaner.py:181-226`` with the template stage
+(:259-288) removed and exactly one iteration — the cheap first-look mode
+the coast_guard ancestor pipeline ran before its surgical cleaner.  It
+reuses the production statistics stack unchanged (``stats/masked_jax``,
+Pallas medians on TPU), so its masks are deterministic and its cost is a
+single :func:`~iterative_cleaner_tpu.stats.masked_jax.surgical_scores_jax`
+evaluation.
+
+Use the flagship ``surgical_scrub`` model for publication-quality masks;
+use ``quicklook`` to triage large batches or as a cheap pre-pass.
+
+Config fields that only parameterise the template stage are ignored by
+construction: ``max_iter``, ``pulse_region``/``pulse_slice``/
+``pulse_scale``, ``stats_impl`` (the fused kernel fuses fit+stats; with
+no fit there is nothing to fuse) and ``stats_frame`` (the statistics run
+in the frame the cube arrives in).  ``chanthresh``/``subintthresh``/
+``baseline_duty``/``rotation``/``median_impl``/``bad_*`` apply as usual.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from iterative_cleaner_tpu.backends import apply_bad_parts
+from iterative_cleaner_tpu.backends.base import CleanResult
+from iterative_cleaner_tpu.config import CleanConfig
+
+
+@functools.lru_cache(maxsize=None)
+def _build_quicklook_fn(chanthresh, subintthresh, baseline_duty, rotation,
+                        fft_mode, median_impl, dedispersed):
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.engine.loop import prepare_cube_jax
+    from iterative_cleaner_tpu.stats.masked_jax import surgical_scores_jax
+
+    def run(cube, weights, freqs, dm, ref_freq, period):
+        ded, _ = prepare_cube_jax(
+            cube, freqs, dm, ref_freq, period, baseline_duty=baseline_duty,
+            rotation=rotation, dedispersed=dedispersed,
+        )
+        cell_mask = weights == 0
+        weighted = ded * weights[:, :, None]
+        scores = surgical_scores_jax(weighted, cell_mask, chanthresh,
+                                     subintthresh, fft_mode, median_impl)
+        new_weights = jnp.where(scores >= 1.0, 0.0, weights)
+        return new_weights, scores
+
+    return jax.jit(run)
+
+
+def clean_archive_quicklook(archive, config: CleanConfig) -> CleanResult:
+    """Single-pass template-free clean; same signature as
+    :func:`iterative_cleaner_tpu.backends.clean_archive`."""
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fft_mode,
+        resolve_median_impl,
+    )
+
+    dtype = jnp.dtype(config.dtype)
+    fn = _build_quicklook_fn(
+        config.chanthresh, config.subintthresh, config.baseline_duty,
+        config.rotation, resolve_fft_mode(config.fft_mode, dtype),
+        resolve_median_impl(config.median_impl, dtype),
+        bool(archive.dedispersed),
+    )
+    new_w, scores = fn(
+        jnp.asarray(archive.total_intensity(), dtype=dtype),
+        jnp.asarray(archive.weights, dtype=dtype),
+        jnp.asarray(archive.freqs_mhz, dtype=dtype),
+        jnp.asarray(archive.dm, dtype=dtype),
+        jnp.asarray(archive.centre_freq_mhz, dtype=dtype),
+        jnp.asarray(archive.period_s, dtype=dtype),
+    )
+    new_w = np.asarray(new_w)
+    result = CleanResult(
+        final_weights=new_w,
+        scores=np.asarray(scores),
+        loops=1,
+        converged=True,  # single-pass by construction
+        loop_diffs=np.asarray([(new_w != np.asarray(archive.weights)).sum()],
+                              dtype=np.int64),
+        loop_rfi_frac=np.asarray([(new_w == 0).mean()]),
+    )
+    return apply_bad_parts(result, config)

@@ -200,6 +200,28 @@ def test_batch_rejects_numpy_backend(tmp_path):
         main(["--batch", "2", "--backend", "numpy", str(tmp_path / "x.npz")])
 
 
+def test_model_quicklook_cleans(archive_file, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    main(["-q", "--model", "quicklook", archive_file])
+    out = archive_file + "_cleaned.npz"
+    assert os.path.exists(out)
+    cleaned = load_archive(out)
+    orig = load_archive(archive_file)
+    # single pass only ever zeroes weights, never restores
+    pre = orig.weights == 0
+    assert ((cleaned.weights == 0) & pre).sum() == pre.sum()
+    np.testing.assert_array_equal(cleaned.data, orig.data)
+
+
+def test_model_quicklook_incompatible_flags(tmp_path):
+    for bad in (["--model", "quicklook", "--backend", "numpy"],
+                ["--model", "quicklook", "--batch", "2"],
+                ["--model", "quicklook", "-u"],
+                ["--model", "quicklook", "--checkpoint", str(tmp_path)]):
+        with pytest.raises(SystemExit):
+            main(bad + [str(tmp_path / "x.npz")])
+
+
 def test_batch_keep_going_isolates_bad_archive(tmp_path, monkeypatch,
                                                capsys):
     monkeypatch.chdir(tmp_path)

@@ -1,9 +1,11 @@
-"""The models package: stable facade over the flagship cleaning strategy."""
+"""The models package: registry over the cleaning strategies."""
 
+import numpy as np
 import pytest
 
 from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
 from iterative_cleaner_tpu.models import (
+    QUICKLOOK,
     SURGICAL_SCRUB,
     CleanConfig,
     CleanResult,
@@ -19,6 +21,37 @@ def test_models_facade():
     assert res.final_weights.shape == (6, 8)
     with pytest.raises(ValueError, match="unknown cleaning model"):
         get_model("nope")
+
+
+def test_quicklook_zaps_injected_rfi():
+    """The single-pass strategy must flag most of the strong injected RFI
+    without the template loop and without false positives.  It is the
+    cheap triage mode: whole contaminated channels partly self-normalise
+    in their own scaler line, so its recall is below the flagship's —
+    that tradeoff is the documented contract (models/quicklook.py)."""
+    ar, truth = make_synthetic_archive(nsub=16, nchan=32, nbin=64, seed=3,
+                                       rfi_strength=60.0)
+    res = get_model(QUICKLOOK)(ar, CleanConfig(dtype="float32"))
+    assert isinstance(res, CleanResult)
+    assert res.loops == 1 and res.converged
+    zapped = res.final_weights == 0
+    expected = truth.expected_zap(ar.nsub, ar.nchan)
+    caught = (zapped & expected).sum()
+    assert caught >= 0.6 * expected.sum()       # catches the bulk...
+    assert (zapped & ~expected).sum() == 0      # ...with no false zaps
+
+    # the flagship iterative strategy catches at least as much
+    full = get_model(SURGICAL_SCRUB)(ar, CleanConfig(dtype="float32"))
+    assert ((full.final_weights == 0) & expected).sum() >= caught
+
+
+def test_quicklook_preserves_prezapped_cells():
+    ar, _ = make_synthetic_archive(nsub=8, nchan=16, nbin=32, seed=5,
+                                   n_prezapped=6)
+    pre = ar.weights == 0
+    res = get_model(QUICKLOOK)(ar, CleanConfig(dtype="float32"))
+    assert ((res.final_weights == 0) & pre).sum() == pre.sum()
+    np.testing.assert_array_equal(res.scores.shape, (8, 16))
 
 
 def test_lazy_engine_reexports():
