@@ -23,8 +23,10 @@ use ``quicklook`` to triage large batches or as a cheap pre-pass.
 Config fields that only parameterise the template stage are ignored by
 construction: ``max_iter``, ``pulse_region``/``pulse_slice``/
 ``pulse_scale``, ``stats_impl`` (the fused kernel fuses fit+stats; with
-no fit there is nothing to fuse) and ``stats_frame`` (the statistics run
-in the frame the cube arrives in).  ``chanthresh``/``subintthresh``/
+no fit there is nothing to fuse) and ``stats_frame`` (the statistics
+always run on the baseline-removed, *dedispersed* cube that
+``prepare_cube_jax`` produces — there is no dispersed-frame residual to
+return to without a template stage).  ``chanthresh``/``subintthresh``/
 ``baseline_duty``/``rotation``/``median_impl``/``bad_*`` apply as usual.
 """
 

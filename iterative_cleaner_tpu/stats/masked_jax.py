@@ -64,6 +64,18 @@ def masked_median(values, mask, axis, impl="sort"):
     return jnp.where(n == 0, jnp.zeros_like(med), med)
 
 
+def _masked_side(centred, mad, mask, n, thresh):
+    """Shared masked-path epilogue (rules 1-4): zero-MAD/empty lines go
+    dead (centred data passes through undivided), live entries are
+    ``|centred/mad| / thresh``.  Single source of truth for both the
+    per-diagnostic route and the batched pallas route."""
+    line_dead = (mad == 0) | (n == 0)
+    safe_mad = jnp.where(line_dead, jnp.ones_like(mad), mad)
+    dead = mask | line_dead
+    mag = jnp.abs(jnp.where(dead, centred, centred / safe_mad))
+    return jnp.where(dead, mag, mag / thresh)
+
+
 def scale_lines_masked(diag, mask, axis, thresh, median_impl="sort"):
     """Masked-path line normalisation, post |.|/threshold.
 
@@ -75,12 +87,15 @@ def scale_lines_masked(diag, mask, axis, thresh, median_impl="sort"):
     med = masked_median(diag, mask, axis, impl=median_impl)
     centred = jnp.where(mask, diag, diag - med)
     mad = masked_median(jnp.abs(centred), mask, axis, impl=median_impl)
-    line_dead = (mad == 0) | (n == 0)
-    safe_mad = jnp.where(line_dead, jnp.ones_like(mad), mad)
-    dead = mask | line_dead
-    scaled = jnp.where(dead, centred, centred / safe_mad)
-    mag = jnp.abs(scaled)
-    return jnp.where(dead, mag, mag / thresh)
+    return _masked_side(centred, mad, mask, n, thresh)
+
+
+def _patch_nan_lines(med, values, axis):
+    """NaN-bearing lines median to NaN (``jnp.median`` propagation) — the
+    Pallas kernel instead sorts NaN keys above +inf, so its plain-median
+    users patch through this single helper."""
+    has_nan = jnp.any(jnp.isnan(values), axis=axis, keepdims=True)
+    return jnp.where(has_nan, jnp.nan, med)
 
 
 def _plain_median(diag, axis, median_impl):
@@ -95,8 +110,7 @@ def _plain_median(diag, axis, median_impl):
         )
 
         med = masked_median_pallas(diag, jnp.zeros(diag.shape, bool), axis)
-        has_nan = jnp.any(jnp.isnan(diag), axis=axis, keepdims=True)
-        return jnp.where(has_nan, jnp.nan, med)
+        return _patch_nan_lines(med, diag, axis)
     return jnp.median(diag, axis=axis, keepdims=True)
 
 
@@ -164,6 +178,46 @@ def cell_diagnostics_jax(resid_weighted, cell_mask, fft_mode="fft"):
     return d_std, d_mean, d_ptp, d_fft
 
 
+def _scaled_sides_batched_pallas(diagnostics, cell_mask, axis, thresh):
+    """One orientation of all four scalers in TWO Pallas launches.
+
+    The radix-bisection kernel is line-local, so the four (nsub, nchan)
+    diagnostics concatenate along the *lines* axis into one launch for the
+    medians and one for the MADs (instead of 2 launches x 4 diagnostics).
+    Per-line math is untouched — bit-identical to the unbatched route —
+    and the 4x-wider lane dimension feeds the kernel better at small
+    nchan.  The rFFT diagnostic rides along with an all-false mask (the
+    kernel equals the plain median then) plus the same NaN patch
+    :func:`_plain_median` applies.
+    """
+    d_std, d_mean, d_ptp, d_fft = diagnostics
+    m = cell_mask
+    cat_axis = 1 - axis  # lines run along the non-reduced axis
+    no_mask = jnp.zeros_like(m)
+
+    def batch(vals4, mask4):
+        cat_v = jnp.concatenate(vals4, axis=cat_axis)
+        cat_m = jnp.concatenate(mask4, axis=cat_axis)
+        out = masked_median(cat_v, cat_m, axis, impl="pallas")
+        return jnp.split(out, 4, axis=cat_axis)
+
+    meds = batch((d_std, d_mean, d_ptp, d_fft), (m, m, m, no_mask))
+    # epilogues are the shared helpers of the unbatched routes
+    # (_masked_side / _patch_nan_lines), so the two paths cannot drift
+    centred = [jnp.where(m, d, d - med)
+               for d, med in zip((d_std, d_mean, d_ptp), meds[:3])]
+    centred_fft = d_fft - _patch_nan_lines(meds[3], d_fft, axis)
+    mads = batch(tuple(jnp.abs(c) for c in centred) + (jnp.abs(centred_fft),),
+                 (m, m, m, no_mask))
+
+    n = jnp.sum(~m, axis=axis, keepdims=True)
+    sides = [_masked_side(c, mad, m, n, thresh)
+             for c, mad in zip(centred, mads[:3])]
+    mad_fft = _patch_nan_lines(mads[3], jnp.abs(centred_fft), axis)
+    sides.append(jnp.abs(centred_fft / mad_fft) / thresh)
+    return sides
+
+
 def scale_and_combine(diagnostics, cell_mask, chanthresh, subintthresh,
                       median_impl="sort"):
     """Channel/subint scaling + 4-way median (reference :220-226) over
@@ -171,6 +225,12 @@ def scale_and_combine(diagnostics, cell_mask, chanthresh, subintthresh,
     Pallas kernel)."""
     d_std, d_mean, d_ptp, d_fft = diagnostics
     m = cell_mask
+    if median_impl == "pallas" and d_fft.dtype == jnp.float32:
+        chan = _scaled_sides_batched_pallas(diagnostics, m, 0, chanthresh)
+        subint = _scaled_sides_batched_pallas(diagnostics, m, 1,
+                                              subintthresh)
+        per_diag = [jnp.maximum(c, s) for c, s in zip(chan, subint)]
+        return jnp.median(jnp.stack(per_diag), axis=0)
     per_diag = []
     for diag in (d_std, d_mean, d_ptp):
         chan_side = scale_lines_masked(diag, m, 0, chanthresh, median_impl)

@@ -117,6 +117,33 @@ def test_full_clean_parity_sort_vs_pallas():
     assert res["sort"].loops == res["pallas"].loops
 
 
+def test_scale_and_combine_batched_pallas_adversarial():
+    """The pallas route batches the four diagnostics into shared median
+    launches (masked_jax._scaled_sides_batched_pallas); its epilogue must
+    stay bit-identical to the sort route on the nasty lines: fully-masked
+    channels/subints, zero-MAD (constant) lines, and NaN-bearing rFFT
+    lines (where the plain path must propagate NaN, quirks 5-8)."""
+    from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
+
+    rng = np.random.default_rng(7)
+    nsub, nchan = 24, 48
+    diags = [rng.normal(size=(nsub, nchan)).astype(np.float32)
+             for _ in range(4)]
+    diags[0][:, 5] = 3.25          # zero-MAD channel in the std diagnostic
+    diags[2][7, :] = -1.5          # zero-MAD subint in the ptp diagnostic
+    diags[3][3, 9] = np.nan        # NaN reaches the plain rFFT path
+    mask = rng.random((nsub, nchan)) < 0.2
+    mask[:, 11] = True             # fully-masked channel
+    mask[4, :] = True              # fully-masked subint
+    args = (tuple(jnp.asarray(d) for d in diags), jnp.asarray(mask),
+            5.0, 3.0)
+    want = np.asarray(jax.jit(
+        lambda d, m: scale_and_combine(d, m, 5.0, 3.0, "sort"))(*args[:2]))
+    got = np.asarray(jax.jit(
+        lambda d, m: scale_and_combine(d, m, 5.0, 3.0, "pallas"))(*args[:2]))
+    np.testing.assert_array_equal(want, got)
+
+
 class TestFusedCellDiagnostics:
     """The fused Pallas diagnostics kernel vs the XLA path: same masked-cell
     patches, near-identical floats (MXU DFT vs jnp reductions), and —
