@@ -322,14 +322,33 @@ def main():
              "constant only describes 1024x4096x128)")
 
     watchdog.cancel()
-    print(json.dumps({
+    out = {
         "metric": "cells_cleaned_per_sec_%dx%d" % (jax_cfg[0], jax_cfg[1]),
         "value": round(jax_rate, 1),
         "unit": "cell-iters/s",
         "vs_baseline": round(jax_rate / denom, 2),
         "platform": platform,
         "hbm_util": None if hbm_util is None else round(hbm_util, 3),
-    }))
+    }
+    if platform != "tpu":
+        # Dead-tunnel fallback: surface the most recent committed real-TPU
+        # capture (benchmarks/measured/) so a CPU-platform record is never
+        # mistaken for "no TPU number exists".
+        cap_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "benchmarks", "measured")
+        caps = sorted(f for f in (os.listdir(cap_dir)
+                                  if os.path.isdir(cap_dir) else [])
+                      if f.startswith("bench_tpu_") and f.endswith(".json"))
+        if caps:
+            try:
+                with open(os.path.join(cap_dir, caps[-1])) as fh:
+                    out["last_tpu_capture"] = {"file": f"benchmarks/measured/{caps[-1]}",
+                                               **json.load(fh)}
+                _log(f"fell back off-TPU; last real-TPU capture attached "
+                     f"from benchmarks/measured/{caps[-1]}")
+            except (OSError, ValueError, TypeError) as e:
+                _log(f"could not attach TPU capture: {e}")
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -77,17 +77,21 @@ def resolve_stats_impl(stats_impl: str, dtype, nbin: int,
     """'auto' picks the fused Pallas diagnostics kernel on TPU float32 runs
     (same rationale as :func:`resolve_median_impl` — sharded programs route
     it through shard_map, see parallel/shard_stats) when its constraints
-    hold: DFT-flavoured rFFT magnitudes and an nbin that fits the kernel's
-    VMEM budget."""
+    hold: DFT-flavoured rFFT magnitudes and an nbin within the
+    hardware-validated bound (FUSED_STATS_AUTO_MAX_NBIN, currently 1024 —
+    stricter than the kernel's VMEM limit of FUSED_STATS_MAX_NBIN because
+    the k-chunked long-profile path is interpret-verified only; explicit
+    stats_impl='fused' reaches the full range)."""
     if stats_impl != "auto":
         return stats_impl
     from iterative_cleaner_tpu.stats.pallas_kernels import (
-        FUSED_STATS_MAX_NBIN,
+        FUSED_STATS_AUTO_MAX_NBIN,
     )
 
     on_tpu = jax.devices()[0].platform == "tpu"
     ok = (on_tpu and jnp.dtype(dtype) == jnp.float32
-          and fft_mode_resolved == "dft" and nbin <= FUSED_STATS_MAX_NBIN)
+          and fft_mode_resolved == "dft"
+          and nbin <= FUSED_STATS_AUTO_MAX_NBIN)
     return "fused" if ok else "xla"
 
 

@@ -86,7 +86,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Per-cell diagnostics on the jax path: XLA "
                              "fusion, the fused Pallas TPU kernel (fit + "
                              "residual + all four diagnostics in one pass), "
-                             "or auto (fused on TPU float32).")
+                             "or auto (fused on TPU float32). 'fused' "
+                             "computes DFT-flavoured spectra, so it needs "
+                             "--fft_mode dft (auto picks dft on TPU).")
+    parser.add_argument("--fft_mode", choices=("auto", "fft", "dft"),
+                        default="auto",
+                        help="rFFT magnitudes on the jax path: the XLA fft "
+                             "op, the MXU matmul DFT (mathematically "
+                             "identical; what the fused kernel and TPU "
+                             "prefer), or auto (dft on TPU float32).")
     parser.add_argument("--stats_frame",
                         choices=("auto", "dispersed", "dedispersed"),
                         default="auto",
@@ -157,6 +165,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         median_impl=args.median_impl,
         stats_impl=args.stats_impl,
         stats_frame=args.stats_frame,
+        fft_mode=args.fft_mode,
         unload_res=args.unload_res,
         record_history=args.record_history,
     )

@@ -165,7 +165,22 @@ def _cell_blocks(nbin: int):
         return _S_BLK, 128
     if nbin <= 512:
         return _S_BLK, 64
-    return _S_BLK, 32
+    if nbin <= 1024:
+        return _S_BLK, 32
+    if nbin <= 2048:
+        return _S_BLK, 16
+    return _S_BLK, 8
+
+
+def _k_chunk(nbin: int, nk_pad: int) -> int:
+    """DFT-table columns per grid step.  Up to 1024 bins the whole padded
+    table fits VMEM and one step preserves the measured single-matmul
+    schedule; past that the O(nbin^2) tables are the VMEM blocker, so the
+    spectrum is swept in 128-column chunks by a third (innermost) grid
+    dimension — the cube blocks' index map ignores it, so they stay
+    resident in VMEM across the sweep and the cube is still read from HBM
+    exactly once per cell block."""
+    return nk_pad if nbin <= 1024 else 128
 
 
 # np.ma's float fill value (masked ptp, quirk 4), shared with the XLA path.
@@ -173,23 +188,42 @@ from iterative_cleaner_tpu.stats.masked_jax import MA_FILL  # noqa: E402
 
 _MA_FILL_F32 = np.float32(MA_FILL)
 
-# Past this nbin the O(nbin^2) DFT tables alone (2 x nbin x ~(nbin/2+128)
-# float32) blow the ~16 MB VMEM budget regardless of cell-block shrinking
-# (_cell_blocks), so callers fall back to the XLA path.  1024 covers
-# BASELINE config 1 (512 bins) and common 1024-bin archives.
-FUSED_STATS_MAX_NBIN = 1024
+# Past 1024 bins the whole O(nbin^2) DFT tables blow the VMEM budget, so
+# the spectrum is swept in 128-column chunks over a third grid dimension
+# (_k_chunk) with shrinking cell blocks (_cell_blocks); 4096 is where the
+# per-chunk table slices (2 x nbin x 128 f32) themselves reach ~4 MB and
+# the cell block hits the 8-sublane floor.  Longer profiles fall back to
+# the XLA path.
+FUSED_STATS_MAX_NBIN = 4096
+
+# What 'auto' trusts (resolve_stats_impl): real-TPU Mosaic lowering has
+# been validated through 1024 bins (2026-07-30, v5e); the k-chunked
+# 2048/4096 path is interpret-mode-verified only — explicit
+# stats_impl='fused' reaches it, 'auto' won't until a hardware run
+# confirms the lowering (interpret mode cannot check Mosaic constraints).
+FUSED_STATS_AUTO_MAX_NBIN = 1024
 
 
 def _write_diags(wres, mask, cos_ref, sin_ref,
-                 std_ref, mean_ref, ptp_ref, fft_ref):
+                 std_ref, mean_ref, ptp_ref, fft_ref, num_k):
     """Shared diagnostics tail: the four per-cell statistics of a weighted
-    residual tile (S, C, B), written to the (1, S, C) output refs."""
+    residual tile (S, C, B), written to the (1, S, C) output refs.
+
+    The DFT spectrum is swept over ``num_k`` grid steps (innermost grid
+    dim; one step when the table fits VMEM whole, see :func:`_k_chunk`):
+    each step sees one (B, K_CHUNK) table slice, the k-independent
+    moments are written on the first step only, and ``fft_ref`` holds the
+    running |spectrum|^2 maximum until the last step takes the sqrt."""
+    kk = pl.program_id(2)
     nbin = wres.shape[-1]
     inv_n = np.float32(1.0 / nbin)
     mean = jnp.sum(wres, axis=2) * inv_n
-    mean_ref[0] = jnp.where(mask, np.float32(0.0), mean)
-    ptp = jnp.max(wres, axis=2) - jnp.min(wres, axis=2)
-    ptp_ref[0] = jnp.where(mask, _MA_FILL_F32, ptp)
+
+    @pl.when(kk == 0)
+    def _moments():
+        mean_ref[0] = jnp.where(mask, np.float32(0.0), mean)
+        ptp = jnp.max(wres, axis=2) - jnp.min(wres, axis=2)
+        ptp_ref[0] = jnp.where(mask, _MA_FILL_F32, ptp)
 
     # mask-aware mean subtraction (reference :210-211); the tile is
     # VMEM-resident, so the two-pass centred variance (jnp.std's stable
@@ -197,8 +231,12 @@ def _write_diags(wres, mask, cos_ref, sin_ref,
     # traffic.  Masked cells' centring skew is irrelevant: their std is
     # patched to 0.
     centred = wres - jnp.where(mask, np.float32(0.0), mean)[:, :, None]
-    var = jnp.sum(centred * centred, axis=2) * inv_n
-    std_ref[0] = jnp.where(mask, np.float32(0.0), jnp.sqrt(var))
+
+    @pl.when(kk == 0)
+    def _variance():
+        var = jnp.sum(centred * centred, axis=2) * inv_n
+        std_ref[0] = jnp.where(mask, np.float32(0.0), jnp.sqrt(var))
+
     flat = centred.reshape(-1, nbin)                # (S*C, B)
     re = jax.lax.dot_general(flat, cos_ref[:], (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32,
@@ -206,13 +244,25 @@ def _write_diags(wres, mask, cos_ref, sin_ref,
     im = jax.lax.dot_general(flat, sin_ref[:], (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32,
                              precision=jax.lax.Precision.HIGHEST)
-    mag2 = re * re + im * im                        # (S*C, K)
-    fft_ref[0] = jnp.sqrt(jnp.max(mag2, axis=1)).reshape(mask.shape)
+    mag2 = re * re + im * im                        # (S*C, K_CHUNK)
+    chunk_max = jnp.max(mag2, axis=1).reshape(mask.shape)
+
+    @pl.when(kk == 0)
+    def _init_fft():
+        fft_ref[0] = chunk_max
+
+    @pl.when(kk > 0)
+    def _acc_fft():
+        fft_ref[0] = jnp.maximum(fft_ref[0], chunk_max)
+
+    @pl.when(kk == num_k - 1)
+    def _final_fft():
+        fft_ref[0] = jnp.sqrt(fft_ref[0])
 
 
 def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
                        cos_ref, sin_ref, tt_ref,
-                       std_ref, mean_ref, ptp_ref, fft_ref):
+                       std_ref, mean_ref, ptp_ref, fft_ref, *, num_k):
     t = t_ref[0]                                    # (B,)
     tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
     ded = ded_ref[:]                                # (S, C, B)
@@ -222,12 +272,12 @@ def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
     resid = amp[:, :, None] * rott_ref[:][None] - disp_ref[:]
     wres = resid * w_ref[0][:, :, None]             # apply_weights
     _write_diags(wres, m_ref[0], cos_ref, sin_ref,
-                 std_ref, mean_ref, ptp_ref, fft_ref)
+                 std_ref, mean_ref, ptp_ref, fft_ref, num_k)
 
 
 def _cell_stats_dedisp_kernel(ded_ref, t_ref, win_ref, w_ref, m_ref,
                               cos_ref, sin_ref, tt_ref,
-                              std_ref, mean_ref, ptp_ref, fft_ref):
+                              std_ref, mean_ref, ptp_ref, fft_ref, *, num_k):
     """Dedispersed-frame variant: one cube read.  The residual never leaves
     the dedispersed frame, so there is no disp_base input and no per-channel
     rotated template — ``resid = (amp*t - ded) * window``."""
@@ -240,7 +290,7 @@ def _cell_stats_dedisp_kernel(ded_ref, t_ref, win_ref, w_ref, m_ref,
     resid = (amp[:, :, None] * t[None, None, :] - ded) * win[None, None, :]
     wres = resid * w_ref[0][:, :, None]             # apply_weights
     _write_diags(wres, m_ref[0], cos_ref, sin_ref,
-                 std_ref, mean_ref, ptp_ref, fft_ref)
+                 std_ref, mean_ref, ptp_ref, fft_ref, num_k)
 
 
 class _FusedScaffold:
@@ -254,22 +304,27 @@ class _FusedScaffold:
     tiling otherwise demands a multiple of 128, which the VMEM-driven
     C_BLK tiers of :func:`_cell_blocks` break past 256 bins."""
 
-    def __init__(self, nsub, nchan, nbin):
+    def __init__(self, nsub, nchan, nbin, num_k):
         self.nsub, self.nchan, self.nbin = nsub, nchan, nbin
+        self.num_k = num_k
         s_blk, c_blk = _cell_blocks(nbin)
         self.c_blk = c_blk
         self.pad_s = (-nsub) % s_blk
         self.pad_c = (-nchan) % c_blk
         self.ns, self.nc = nsub + self.pad_s, nchan + self.pad_c
-        self.grid = (self.ns // s_blk, self.nc // c_blk)
-        self.cell_spec = pl.BlockSpec((1, s_blk, c_blk), lambda i, j: (j, i, 0),
+        # kk innermost: the cube/cell blocks' index maps ignore it, so
+        # those blocks stay resident in VMEM across the spectrum sweep
+        self.grid = (self.ns // s_blk, self.nc // c_blk, num_k)
+        self.cell_spec = pl.BlockSpec((1, s_blk, c_blk),
+                                      lambda i, j, kk: (j, i, 0),
                                       memory_space=pltpu.VMEM)
         self.cube_spec = pl.BlockSpec((s_blk, c_blk, nbin),
-                                      lambda i, j: (i, j, 0),
+                                      lambda i, j, kk: (i, j, 0),
                                       memory_space=pltpu.VMEM)
-        self.chan_row_spec = pl.BlockSpec((c_blk, nbin), lambda i, j: (j, 0),
+        self.chan_row_spec = pl.BlockSpec((c_blk, nbin),
+                                          lambda i, j, kk: (j, 0),
                                           memory_space=pltpu.VMEM)
-        self.row_spec = pl.BlockSpec((1, nbin), lambda i, j: (0, 0),
+        self.row_spec = pl.BlockSpec((1, nbin), lambda i, j, kk: (0, 0),
                                      memory_space=pltpu.VMEM)
 
     def pad_cube(self, x):
@@ -293,16 +348,19 @@ class _FusedScaffold:
 
     def launch(self, kernel, inputs, in_specs, cos_t, sin_t, tt_info,
                interpret):
+        k_chunk = cos_t.shape[1] // self.num_k
         table_specs = [
-            pl.BlockSpec(cos_t.shape, lambda i, j: (0, 0),
+            pl.BlockSpec((cos_t.shape[0], k_chunk),
+                         lambda i, j, kk: (0, kk),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec(sin_t.shape, lambda i, j: (0, 0),
+            pl.BlockSpec((sin_t.shape[0], k_chunk),
+                         lambda i, j, kk: (0, kk),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 2), lambda i, j: (0, 0),
+            pl.BlockSpec((1, 2), lambda i, j, kk: (0, 0),
                          memory_space=pltpu.SMEM),
         ]
         outs = pl.pallas_call(
-            kernel,
+            functools.partial(kernel, num_k=self.num_k),
             out_shape=[jax.ShapeDtypeStruct(
                 (self.nc // self.c_blk, self.ns, self.c_blk),
                 jnp.float32)] * 4,
@@ -317,10 +375,10 @@ class _FusedScaffold:
             for o in outs)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("num_k", "interpret"))
 def _cell_stats_call(ded, disp_base, rot_t, template, tt_info, weights,
-                     cell_mask, cos_t, sin_t, interpret):
-    sc = _FusedScaffold(*ded.shape)
+                     cell_mask, cos_t, sin_t, num_k, interpret):
+    sc = _FusedScaffold(*ded.shape, num_k)
     weights, cell_mask = sc.pad_cells(weights, cell_mask)
     return sc.launch(
         _cell_stats_kernel,
@@ -334,7 +392,7 @@ def _cell_stats_call(ded, disp_base, rot_t, template, tt_info, weights,
 
 def _fused_setup(ded, template):
     """Shared validation + DFT tables + template-norm info for the fused
-    kernels.  Returns (cos_t, sin_t, tt_info, interpret)."""
+    kernels.  Returns (cos_t, sin_t, tt_info, num_k, interpret)."""
     if ded.dtype != jnp.float32:
         raise TypeError("fused cell diagnostics require float32, got %s"
                         % ded.dtype)
@@ -351,13 +409,14 @@ def _fused_setup(ded, template):
     ang = (-2.0 * np.pi / nbin) * jnp.outer(b, k)
     cos_t = jnp.pad(jnp.cos(ang), ((0, 0), (0, pad_k)))
     sin_t = jnp.pad(jnp.sin(ang), ((0, 0), (0, pad_k)))
+    num_k = cos_t.shape[1] // _k_chunk(nbin, cos_t.shape[1])
     tt = jnp.sum(template * template)
     tt_info = jnp.stack(
         [jnp.where(tt == 0, jnp.float32(1.0), tt),
          (tt == 0).astype(jnp.float32)]
     )[None, :]
     interpret = jax.devices()[0].platform != "tpu"
-    return cos_t, sin_t, tt_info, interpret
+    return cos_t, sin_t, tt_info, num_k, interpret
 
 
 def cell_diagnostics_pallas(ded, disp_base, rot_t, template, weights,
@@ -367,16 +426,16 @@ def cell_diagnostics_pallas(ded, disp_base, rot_t, template, weights,
     with the same masked-cell patches as the XLA path
     (:func:`masked_jax.surgical_scores_jax`) and DFT-flavoured rFFT
     magnitudes (:func:`masked_jax.rfft_magnitudes` mode='dft')."""
-    cos_t, sin_t, tt_info, interpret = _fused_setup(ded, template)
+    cos_t, sin_t, tt_info, num_k, interpret = _fused_setup(ded, template)
     return _cell_stats_call(ded, disp_base, rot_t, template, tt_info,
                             weights.astype(jnp.float32),
-                            cell_mask, cos_t, sin_t, interpret)
+                            cell_mask, cos_t, sin_t, num_k, interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("num_k", "interpret"))
 def _cell_stats_dedisp_call(ded, template, window, tt_info, weights,
-                            cell_mask, cos_t, sin_t, interpret):
-    sc = _FusedScaffold(*ded.shape)
+                            cell_mask, cos_t, sin_t, num_k, interpret):
+    sc = _FusedScaffold(*ded.shape, num_k)
     weights, cell_mask = sc.pad_cells(weights, cell_mask)
     return sc.launch(
         _cell_stats_dedisp_kernel,
@@ -391,11 +450,11 @@ def cell_diagnostics_pallas_dedisp(ded, template, window, weights, cell_mask):
     """Dedispersed-frame fused diagnostics: one cube read per iteration
     instead of two (engine stats_frame='dedispersed').  ``window`` is the
     (nbin,) pulse-region multiplier (all ones when inactive)."""
-    cos_t, sin_t, tt_info, interpret = _fused_setup(ded, template)
+    cos_t, sin_t, tt_info, num_k, interpret = _fused_setup(ded, template)
     return _cell_stats_dedisp_call(ded, template,
                                    window.astype(jnp.float32), tt_info,
                                    weights.astype(jnp.float32),
-                                   cell_mask, cos_t, sin_t, interpret)
+                                   cell_mask, cos_t, sin_t, num_k, interpret)
 
 
 def masked_median_pallas(values, mask, axis):

@@ -200,6 +200,19 @@ def test_batch_rejects_numpy_backend(tmp_path):
         main(["--batch", "2", "--backend", "numpy", str(tmp_path / "x.npz")])
 
 
+def test_fft_mode_flag_masks_match(archive_file, tmp_path, monkeypatch):
+    """--fft_mode dft + the explicit fused/pallas impls must reproduce the
+    default path's mask (the dft spectra are mathematically identical)."""
+    monkeypatch.chdir(tmp_path)
+    main(["-q", archive_file])
+    main(["-q", "--fft_mode", "dft", "--stats_impl", "fused",
+          "--median_impl", "pallas", "-o", str(tmp_path / "dft.npz"),
+          archive_file])
+    a = load_archive(archive_file + "_cleaned.npz")
+    b = load_archive(str(tmp_path / "dft.npz"))
+    np.testing.assert_array_equal(a.weights == 0, b.weights == 0)
+
+
 def test_model_quicklook_cleans(archive_file, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     main(["-q", "--model", "quicklook", archive_file])

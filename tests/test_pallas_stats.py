@@ -209,12 +209,13 @@ class TestFusedCellDiagnostics:
                                       np.asarray(b.final_weights))
         assert int(a.loops) == int(b.loops)
 
-    @pytest.mark.parametrize("nbin", [512, 1024])
+    @pytest.mark.parametrize("nbin", [512, 1024, 2048, 4096])
     def test_fused_long_profiles_match_xla(self, nbin):
         """VERDICT r1 weak item 2: BASELINE config 1 (512 bins) and common
         1024-bin archives must run fused instead of silently falling back.
         The scaffold shrinks the channel block (_cell_blocks) to keep VMEM
-        flat; diagnostics must still match the XLA path."""
+        flat, and past 1024 bins sweeps the DFT spectrum over a third grid
+        dimension (_k_chunk); diagnostics must still match the XLA path."""
         from iterative_cleaner_tpu.ops.dsp import (
             fit_template_amplitudes, rotate_bins, weighted_template)
         from iterative_cleaner_tpu.stats.masked_jax import cell_diagnostics_jax
