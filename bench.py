@@ -336,18 +336,19 @@ def main():
         # mistaken for "no TPU number exists".
         cap_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "benchmarks", "measured")
-        caps = sorted(f for f in (os.listdir(cap_dir)
-                                  if os.path.isdir(cap_dir) else [])
-                      if f.startswith("bench_tpu_") and f.endswith(".json"))
-        if caps:
-            try:
+        try:
+            caps = sorted(f for f in os.listdir(cap_dir)
+                          if f.startswith("bench_tpu_")
+                          and f.endswith(".json"))
+            if caps:
                 with open(os.path.join(cap_dir, caps[-1])) as fh:
-                    out["last_tpu_capture"] = {"file": f"benchmarks/measured/{caps[-1]}",
-                                               **json.load(fh)}
+                    out["last_tpu_capture"] = {
+                        "file": f"benchmarks/measured/{caps[-1]}",
+                        **json.load(fh)}
                 _log(f"fell back off-TPU; last real-TPU capture attached "
                      f"from benchmarks/measured/{caps[-1]}")
-            except (OSError, ValueError, TypeError) as e:
-                _log(f"could not attach TPU capture: {e}")
+        except (OSError, ValueError, TypeError) as e:
+            _log(f"could not attach TPU capture: {e}")
     print(json.dumps(out))
 
 

@@ -1,0 +1,39 @@
+#!/bin/sh
+# Queued real-TPU validations — run top to bottom whenever the tunnel is
+# alive (probe first: timeout 90 python -c "import jax; print(jax.devices())").
+# Each step records into benchmarks/measured/; after step 2 passes, lift
+# FUSED_STATS_AUTO_MAX_NBIN (stats/pallas_kernels.py) to 4096 and rerun
+# the bench.  2026-07-30: steps 1-2 pending since the tunnel died mid-day.
+set -ex
+cd "$(dirname "$0")/.."
+STAMP=$(date +%Y-%m-%d_%H%M)
+
+# 1. Headline bench (now includes the 4-launch batched scaler medians —
+#    expect <= the recorded 34.3 ms/iteration).
+python bench.py >  "benchmarks/measured/bench_tpu_${STAMP}.json" \
+               2> "benchmarks/measured/bench_tpu_${STAMP}.stderr.txt"
+
+# 2. Mosaic-lowering validation of the k-chunked fused kernel (the
+#    interpret-mode tests cannot check this): must print OK for 2048/4096.
+python - <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+from iterative_cleaner_tpu.stats.pallas_kernels import cell_diagnostics_pallas
+rng = np.random.default_rng(0)
+for nbin in (2048, 4096):
+    nsub, nchan = 64, 128
+    a = lambda *s: jnp.asarray(rng.normal(size=s).astype(np.float32))
+    ded, disp, rot_t, t = a(nsub, nchan, nbin), a(nsub, nchan, nbin), a(nchan, nbin), a(nbin)
+    w = jnp.asarray((rng.random((nsub, nchan)) > 0.1).astype(np.float32))
+    out = jax.jit(cell_diagnostics_pallas)(ded, disp, rot_t, t, w, w == 0)
+    jax.block_until_ready(out); print(f"nbin={nbin}: OK (compiled + ran)")
+EOF
+
+# 3. Per-stage profile (batched scaler rows) at the bench config + long bins.
+{ python benchmarks/profile_stages.py
+  python benchmarks/profile_stages.py --nbin 512  --nchan 1024
+  python benchmarks/profile_stages.py --nbin 2048 --nchan 256
+} > "benchmarks/measured/profile_stages_${STAMP}.txt" 2>&1
+
+# 4. Batched (vmap) sort-vs-pallas decision measurement: if pallas/fused
+#    wins, drop the forced-sort gate in parallel/batch.py + cli.py.
+PYTHONPATH=. python /tmp/batch_pallas_probe.py || true

@@ -182,6 +182,10 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
         )
         with mesh:
             outs = fn(*args)
+        # meshes spanning processes: gather outputs before host reads
+        from iterative_cleaner_tpu.parallel.distributed import host_fetch
+
+        outs = host_fetch(outs)
     else:
         outs = fn(*args)
 

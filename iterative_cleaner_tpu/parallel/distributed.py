@@ -91,6 +91,31 @@ def initialize(coordinator_address: Optional[str] = None,
     )
 
 
+def host_fetch(outputs):
+    """Multi-process-safe device-to-host fetch of a pytree of jax arrays.
+
+    Single-process (or fully-addressable) outputs transfer directly; an
+    array sharded across processes cannot be ``np.asarray``'d (the local
+    process only holds its shards), so every process all-gathers it to the
+    full global value via ``multihost_utils.process_allgather`` — a
+    collective, so all processes must call this in the same order (they
+    do: it sits on the shared library path).  Sizes are the per-archive
+    result matrices, tiny next to the cubes.
+    """
+    import jax
+
+    leaves = [x for x in jax.tree.leaves(outputs)
+              if isinstance(x, jax.Array)]
+    if all(x.is_fully_addressable for x in leaves):
+        return outputs
+    from jax.experimental import multihost_utils
+
+    return jax.tree.map(
+        lambda x: multihost_utils.process_allgather(x, tiled=True)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable else x,
+        outputs)
+
+
 def hybrid_batch_cell_mesh(batch: Optional[int] = None,
                            devices: Optional[Sequence] = None):
     """3-D ('batch', 'sub', 'chan') mesh: archives sharded over hosts (DCN),

@@ -130,6 +130,10 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
             jnp.asarray(centre_freq_mhz, dtype),
             jnp.asarray(period_s, dtype),
         )
+    # meshes spanning processes: gather outputs before host reads
+    from iterative_cleaner_tpu.parallel.distributed import host_fetch
+
+    outs = host_fetch(outs)
     loops = int(outs.loops)
     result = CleanResult(
         final_weights=np.asarray(outs.final_weights),

@@ -86,7 +86,56 @@ print(f"WORKER_OK pid={pid} shards={n_checked}", flush=True)
 """
 
 
-def test_two_process_sharded_clean(tmp_path):
+_HYBRID_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+from iterative_cleaner_tpu.parallel.distributed import (
+    clean_archives_hybrid, hybrid_batch_cell_mesh, initialize)
+from iterative_cleaner_tpu.parallel.sharding import clean_cube_sharded
+from iterative_cleaner_tpu.parallel.mesh import cell_mesh
+from iterative_cleaner_tpu.backends.jax_backend import clean_cube
+
+port, pid = sys.argv[1], int(sys.argv[2])
+ctx = initialize(coordinator_address=f"127.0.0.1:{port}",
+                 num_processes=2, process_id=pid)
+assert ctx.global_devices == 8, ctx
+
+cfg = CleanConfig(max_iter=2, rotation="roll", fft_mode="dft")
+archives = [make_synthetic_archive(nsub=8, nchan=16, nbin=32, seed=s,
+                                   dtype=np.float64)[0] for s in (1, 2, 3)]
+
+# library path 1: one big archive over the global ('sub','chan') mesh
+ar = archives[0]
+args = (ar.total_intensity(), ar.weights, ar.freqs_mhz, ar.dm,
+        ar.centre_freq_mhz, ar.period_s)
+ref = clean_cube(*args, cfg)  # local single-process reference
+res = clean_cube_sharded(*args, cfg, cell_mesh(8))
+assert np.array_equal(ref.final_weights, res.final_weights), "sharded"
+assert ref.loops == res.loops
+
+# library path 2: 3 archives (one padded) over the hybrid batch x cell mesh
+hmesh = hybrid_batch_cell_mesh(batch=2)
+results = clean_archives_hybrid(archives, cfg, hmesh)
+assert len(results) == 3
+for a, r in zip(archives, results):
+    args = (a.total_intensity(), a.weights, a.freqs_mhz, a.dm,
+            a.centre_freq_mhz, a.period_s)
+    want = clean_cube(*args, cfg)
+    assert np.array_equal(want.final_weights, r.final_weights), "hybrid"
+    assert want.loops == r.loops
+print(f"WORKER_OK pid={pid}", flush=True)
+"""
+
+
+def _run_two_process(worker_src):
     import socket
 
     with socket.socket() as s:  # free port for the coordinator
@@ -101,7 +150,7 @@ def test_two_process_sharded_clean(tmp_path):
         + env.get("PYTHONPATH", "").split(os.pathsep))
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _WORKER, str(port), str(pid)],
+            [sys.executable, "-c", worker_src, str(port), str(pid)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
         for pid in (0, 1)
@@ -118,3 +167,16 @@ def test_two_process_sharded_clean(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
         assert f"WORKER_OK pid={pid}" in out, out[-2000:]
+
+
+def test_two_process_sharded_clean(tmp_path):
+    _run_two_process(_WORKER)
+
+
+def test_two_process_library_paths(tmp_path):
+    """The production library entry points themselves — clean_cube_sharded
+    over the global cell mesh and clean_archives_hybrid over the
+    batch x cell hybrid mesh — must work across real process boundaries:
+    outputs sharded over both processes gather via
+    parallel.distributed.host_fetch before host reads."""
+    _run_two_process(_HYBRID_WORKER)
