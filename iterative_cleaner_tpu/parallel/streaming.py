@@ -7,10 +7,15 @@ cleaned weight tile as each fills.  The reference has no counterpart — it
 loads whole archives into RAM (``/root/reference/iterative_cleaner.py:47,111``).
 
 Semantics per tile are exactly the single-archive engine on that tile.  A
-final partial tile is padded with zero-weight subints: zero weight excludes
-the padding from every statistic (mask semantics of the engine), so a
-partial tile cleans identically to the same subints alone, modulo the
-subint-scaler median population.
+final partial tile is padded with zero-weight subints.  Zero weight
+excludes the padding from the *masked* statistics (std/mean/ptp scalers,
+templates, fits), but NOT from the rFFT diagnostic's scalers: that path is
+plain (unmasked) by reference semantics — prezapped cells' zeroed data
+enters its median populations (`/root/reference/iterative_cleaner.py:210-212`,
+masked_jax rule 5) and padding rows behave like prezapped subints there.
+So a padded partial tile can score borderline cells differently from the
+same subints cleaned alone — the same class of drift as tile-vs-whole
+scaler populations, and covered by the same measured bound (below).
 
 Tile semantics differ from whole-archive cleaning in one way: the
 channel-scaler median/MAD populations are the tile's subints, not the whole
