@@ -136,6 +136,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "program (amortises compile and dispatch for "
                              "many small archives). Incompatible with "
                              "--unload_res and --checkpoint.")
+    parser.add_argument("--mesh", choices=("off", "cell", "batch"),
+                        default="off",
+                        help="Multi-device execution: 'cell' shards each "
+                             "archive's (subint x channel) grid over all "
+                             "visible devices (parallel/sharding.py; each "
+                             "mesh axis must divide the grid); 'batch' "
+                             "shards the --batch groups across devices "
+                             "(parallel/batch.py). On CPU test meshes "
+                             "combine 'cell' with --rotation roll "
+                             "--fft_mode dft (XLA:CPU's fft rejects "
+                             "sharded layouts).")
     parser.add_argument("--model", choices=("surgical_scrub", "quicklook"),
                         default="surgical_scrub",
                         help="Cleaning strategy: the flagship iterative "
@@ -215,10 +226,18 @@ def clean_one(in_path: str, args: argparse.Namespace,
                   % ckpt.checkpoint_path(args.checkpoint, in_path))
     if result is None:
         with timer.phase("clean"):
-            from iterative_cleaner_tpu.models import get_model
+            if getattr(args, "mesh", "off") == "cell":
+                from iterative_cleaner_tpu.parallel.mesh import cell_mesh
+                from iterative_cleaner_tpu.parallel.sharding import (
+                    clean_archive_sharded,
+                )
 
-            result = get_model(getattr(args, "model", "surgical_scrub"))(
-                ar, cfg)
+                result = clean_archive_sharded(ar, cfg, cell_mesh())
+            else:
+                from iterative_cleaner_tpu.models import get_model
+
+                result = get_model(
+                    getattr(args, "model", "surgical_scrub"))(ar, cfg)
     if args.checkpoint and not resumed:
         os.makedirs(args.checkpoint, exist_ok=True)
         ckpt.save_clean_checkpoint(
@@ -316,6 +335,11 @@ def _run_batched(args) -> list:
     from iterative_cleaner_tpu.parallel.batch import clean_archives_batched
 
     cfg = config_from_args(args)
+    mesh = None
+    if getattr(args, "mesh", "off") == "batch":
+        from iterative_cleaner_tpu.parallel.mesh import batch_mesh
+
+        mesh = batch_mesh()
     paths = list(args.archive)
     failed = []
 
@@ -355,7 +379,7 @@ def _run_batched(args) -> list:
         if not group:
             continue
         try:
-            results = clean_archives_batched(ars, cfg)
+            results = clean_archives_batched(ars, cfg, mesh)
         except Exception as exc:
             record_failure(group, exc)
             continue
@@ -385,11 +409,23 @@ def main(argv=None) -> int:
     if args.model != "surgical_scrub" and (args.backend != "jax"
                                            or args.batch > 1
                                            or args.unload_res
-                                           or args.checkpoint):
+                                           or args.checkpoint
+                                           or args.mesh != "off"):
         build_parser().error(
             "--model %s requires --backend jax and is incompatible with "
-            "--batch/--unload_res/--checkpoint (single-pass, no residual; "
-            "checkpoints are keyed to the flagship strategy)" % args.model)
+            "--batch/--unload_res/--checkpoint/--mesh (single-pass, no "
+            "residual; checkpoints are keyed to the flagship strategy)"
+            % args.model)
+    if args.mesh == "cell" and (args.backend != "jax" or args.batch > 1
+                                or args.unload_res or args.record_history):
+        build_parser().error(
+            "--mesh cell requires --backend jax and is incompatible with "
+            "--batch/--unload_res/--record_history (the sharded path does "
+            "not gather residual cubes or weight histories)")
+    if args.mesh == "batch" and (args.batch <= 1 or args.backend != "jax"):
+        build_parser().error(
+            "--mesh batch shards the --batch groups over devices; pass "
+            "--batch B (B > 1) and --backend jax")
 
     # Probe the default device before the first jax computation: a dead
     # accelerator tunnel otherwise hangs PJRT init forever.  Skipped when a

@@ -213,6 +213,47 @@ def test_fft_mode_flag_masks_match(archive_file, tmp_path, monkeypatch):
     np.testing.assert_array_equal(a.weights == 0, b.weights == 0)
 
 
+def test_mesh_cell_masks_match_default(archive_file, tmp_path, monkeypatch):
+    """--mesh cell shards one archive over all 8 virtual devices; the mask
+    must match the single-device clean (CPU meshes need roll+dft)."""
+    monkeypatch.chdir(tmp_path)
+    main(["-q", "--rotation", "roll", "--fft_mode", "dft", archive_file])
+    main(["-q", "--mesh", "cell", "--rotation", "roll", "--fft_mode", "dft",
+          "-o", str(tmp_path / "meshed.npz"), archive_file])
+    a = load_archive(archive_file + "_cleaned.npz")
+    b = load_archive(str(tmp_path / "meshed.npz"))
+    np.testing.assert_array_equal(a.weights, b.weights)
+
+
+def test_mesh_batch_masks_match_plain_batch(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from iterative_cleaner_tpu.io import make_synthetic_archive, save_archive
+
+    paths = []
+    for s in range(3):
+        ar, _ = make_synthetic_archive(nsub=8, nchan=16, nbin=32, seed=s)
+        p = str(tmp_path / f"m{s}.npz")
+        save_archive(ar, p)
+        paths.append(p)
+    main(["-q", "--batch", "3", "--rotation", "roll"] + paths)
+    plain = [load_archive(p + "_cleaned.npz").weights for p in paths]
+    main(["-q", "--batch", "3", "--rotation", "roll", "--mesh", "batch"]
+         + paths)
+    for p, w in zip(paths, plain):
+        np.testing.assert_array_equal(
+            load_archive(p + "_cleaned.npz").weights, w)
+
+
+def test_mesh_incompatible_flags(tmp_path):
+    for bad in (["--mesh", "cell", "--batch", "2"],
+                ["--mesh", "cell", "-u"],
+                ["--mesh", "cell", "--backend", "numpy"],
+                ["--mesh", "batch"],                      # needs --batch
+                ["--mesh", "cell", "--model", "quicklook"]):
+        with pytest.raises(SystemExit):
+            main(bad + [str(tmp_path / "x.npz")])
+
+
 def test_model_quicklook_cleans(archive_file, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     main(["-q", "--model", "quicklook", archive_file])
