@@ -19,9 +19,8 @@ import numpy as np
 from iterative_cleaner_tpu.backends.base import CleanResult
 from iterative_cleaner_tpu.config import CleanConfig
 from iterative_cleaner_tpu.ops.dsp import (
-    dispersion_shift_bins,
     fit_template_amplitudes,
-    remove_baseline,
+    prepare_cube,
     rotate_bins,
     template_residuals,
     weighted_template,
@@ -39,17 +38,14 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
     the dispersed frame, so only the forward rotation is skipped."""
     cube = np.asarray(cube, dtype=np.float64)
     orig_weights = np.asarray(orig_weights, dtype=np.float64)
-    nbin = cube.shape[-1]
 
-    shifts = dispersion_shift_bins(
-        np.asarray(freqs_mhz, dtype=np.float64), dm, ref_freq_mhz, period_s,
-        nbin, np,
-    )
     # Iteration-invariant preamble (reference recomputes at :97-100 from
-    # identical clones; hoisted here).
-    ded = remove_baseline(cube, np, duty=config.baseline_duty)
-    if not dedispersed:
-        ded = rotate_bins(ded, -shifts, np, method=config.rotation)
+    # identical clones; hoisted here; shared semantics in ops.dsp).
+    ded, shifts = prepare_cube(
+        cube, freqs_mhz, dm, ref_freq_mhz, period_s, np,
+        baseline_duty=config.baseline_duty, rotation=config.rotation,
+        dedispersed=dedispersed,
+    )
 
     cell_mask = orig_weights == 0  # ref :115
     history = [orig_weights.copy()]  # pre-loop seed, ref :78-79

@@ -152,10 +152,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Cleaning strategy: the flagship iterative "
                              "surgical scrub (reference algorithm), or the "
                              "single-pass template-free quicklook triage "
-                             "cleaner (models/quicklook.py; jax backend "
-                             "only; no template stage, so --max_iter, "
-                             "-r/--pulse_region, --stats_impl and "
-                             "--stats_frame do not apply).")
+                             "cleaner (models/quicklook.py; no template "
+                             "stage, so --max_iter, -r/--pulse_region, "
+                             "--stats_impl and --stats_frame do not "
+                             "apply).")
     return parser
 
 
@@ -406,16 +406,16 @@ def main(argv=None) -> int:
         build_parser().error(
             "--batch is incompatible with --unload_res/--checkpoint, "
             "requires --backend jax, and uses the vmap (xla) stats path")
-    if args.model != "surgical_scrub" and (args.backend != "jax"
-                                           or args.batch > 1
+    if args.model != "surgical_scrub" and (args.batch > 1
                                            or args.unload_res
                                            or args.checkpoint
+                                           or args.record_history
                                            or args.mesh != "off"):
         build_parser().error(
-            "--model %s requires --backend jax and is incompatible with "
-            "--batch/--unload_res/--checkpoint/--mesh (single-pass, no "
-            "residual; checkpoints are keyed to the flagship strategy)"
-            % args.model)
+            "--model %s is incompatible with --batch/--unload_res/"
+            "--checkpoint/--record_history/--mesh (single-pass: no "
+            "residual, no weight history; checkpoints are keyed to the "
+            "flagship strategy)" % args.model)
     if args.mesh == "cell" and (args.backend != "jax" or args.batch > 1
                                 or args.unload_res or args.record_history):
         build_parser().error(

@@ -30,9 +30,7 @@ from jax import lax
 import numpy as np
 
 from iterative_cleaner_tpu.ops.dsp import (
-    dispersion_shift_bins,
     fit_template_amplitudes,
-    remove_baseline,
     rotate_bins,
     weighted_template,
 )
@@ -294,23 +292,13 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
 
 def prepare_cube_jax(cube, freqs_mhz, dm, ref_freq_mhz, period_s, *,
                      baseline_duty, rotation, dedispersed=False):
-    """Host-free preamble: baseline removal + dedispersion (reference
-    :90-91/:99-100, identical across iterations so hoisted out of the loop).
-
-    ``dedispersed=True`` marks a cube whose channel delays were already
-    removed (PSRFITS ``DEDISP=1``): PSRCHIVE's state-aware ``dedisperse``
-    is then a no-op (reference :91,:100 relies on that), so the forward
-    rotation is skipped — but ``dededisperse`` (reference :104) still
-    rotates *into* the dispersed frame, so the back-shifts are returned
-    unchanged.
+    """Host-free preamble on the jax path; the semantics (incl. the
+    DEDISP=1 skip rule) live in the backend-generic
+    :func:`iterative_cleaner_tpu.ops.dsp.prepare_cube`.
 
     Returns (ded_cube, back_shifts)."""
-    nbin = cube.shape[-1]
-    shifts = dispersion_shift_bins(
-        jnp.asarray(freqs_mhz, dtype=cube.dtype), dm, ref_freq_mhz, period_s,
-        nbin, jnp,
-    )
-    ded = remove_baseline(cube, jnp, duty=baseline_duty)
-    if not dedispersed:
-        ded = rotate_bins(ded, -shifts, jnp, method=rotation)
-    return ded, shifts
+    from iterative_cleaner_tpu.ops.dsp import prepare_cube
+
+    return prepare_cube(cube, freqs_mhz, dm, ref_freq_mhz, period_s, jnp,
+                        baseline_duty=baseline_duty, rotation=rotation,
+                        dedispersed=dedispersed)

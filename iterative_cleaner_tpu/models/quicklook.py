@@ -65,9 +65,41 @@ def _build_quicklook_fn(chanthresh, subintthresh, baseline_duty, rotation,
     return jax.jit(run)
 
 
+def _clean_quicklook_numpy(archive, config: CleanConfig) -> CleanResult:
+    """Float64 numpy twin of the jax quicklook path — the differential
+    oracle for the strategy, mirroring the flagship's two-backend rule."""
+    from iterative_cleaner_tpu.ops.dsp import prepare_cube
+    from iterative_cleaner_tpu.stats.masked_numpy import (
+        surgical_scores_numpy,
+    )
+
+    cube = np.asarray(archive.total_intensity(), dtype=np.float64)
+    weights = np.asarray(archive.weights, dtype=np.float64)
+    ded, _ = prepare_cube(
+        cube, archive.freqs_mhz, archive.dm, archive.centre_freq_mhz,
+        archive.period_s, np, baseline_duty=config.baseline_duty,
+        rotation=config.rotation, dedispersed=archive.dedispersed,
+    )
+    cell_mask = weights == 0
+    scores = surgical_scores_numpy(ded * weights[:, :, None], cell_mask,
+                                   config.chanthresh, config.subintthresh)
+    new_w = np.where(scores >= 1.0, 0.0, weights)
+    result = CleanResult(
+        final_weights=new_w,
+        scores=scores,
+        loops=1,
+        converged=True,
+        loop_diffs=np.asarray([(new_w != weights).sum()], dtype=np.int64),
+        loop_rfi_frac=np.asarray([(new_w == 0).mean()]),
+    )
+    return apply_bad_parts(result, config)
+
+
 def clean_archive_quicklook(archive, config: CleanConfig) -> CleanResult:
-    """Single-pass template-free clean; same signature as
-    :func:`iterative_cleaner_tpu.backends.clean_archive`."""
+    """Single-pass template-free clean; same signature (and backend
+    selection) as :func:`iterative_cleaner_tpu.backends.clean_archive`."""
+    if config.backend == "numpy":
+        return _clean_quicklook_numpy(archive, config)
     import jax.numpy as jnp
 
     from iterative_cleaner_tpu.backends.jax_backend import (

@@ -205,6 +205,31 @@ def remove_baseline(profiles, xp, duty=0.15):
     return profiles - baseline_offsets(profiles, xp, duty=duty)[..., None]
 
 
+def prepare_cube(cube, freqs_mhz, dm, ref_freq_mhz, period_s, xp, *,
+                 baseline_duty, rotation, dedispersed=False):
+    """Backend-generic cleaning preamble: baseline removal + forward
+    dedispersion (reference :90-91/:99-100; iteration-invariant, so hoisted
+    out of every loop).  The single source of the DEDISP=1 skip rule:
+    PSRCHIVE's state-aware ``dedisperse`` no-ops on an already-dedispersed
+    archive while ``dededisperse`` (:104) still rotates into the dispersed
+    frame — so ``dedispersed=True`` skips only the forward rotation and the
+    back-shifts are returned unchanged.
+
+    Returns ``(ded_cube, back_shifts)``; shared by the jax engine
+    (:func:`iterative_cleaner_tpu.engine.loop.prepare_cube_jax`), the numpy
+    oracle backend, and the quicklook strategy's numpy twin.
+    """
+    nbin = cube.shape[-1]
+    shifts = dispersion_shift_bins(
+        xp.asarray(freqs_mhz, dtype=cube.dtype), dm, ref_freq_mhz, period_s,
+        nbin, xp,
+    )
+    ded = remove_baseline(cube, xp, duty=baseline_duty)
+    if not dedispersed:
+        ded = rotate_bins(ded, -shifts, xp, method=rotation)
+    return ded, shifts
+
+
 # ---------------------------------------------------------------------------
 # Scrunching / template construction
 # ---------------------------------------------------------------------------

@@ -268,12 +268,24 @@ def test_model_quicklook_cleans(archive_file, tmp_path, monkeypatch):
 
 
 def test_model_quicklook_incompatible_flags(tmp_path):
-    for bad in (["--model", "quicklook", "--backend", "numpy"],
-                ["--model", "quicklook", "--batch", "2"],
+    for bad in (["--model", "quicklook", "--batch", "2"],
                 ["--model", "quicklook", "-u"],
                 ["--model", "quicklook", "--checkpoint", str(tmp_path)]):
         with pytest.raises(SystemExit):
             main(bad + [str(tmp_path / "x.npz")])
+
+
+def test_model_quicklook_numpy_backend_matches_jax(archive_file, tmp_path,
+                                                   monkeypatch):
+    """quicklook has a float64 numpy oracle twin; at float64 the two
+    backends must produce identical masks (the flagship's parity rule)."""
+    monkeypatch.chdir(tmp_path)
+    main(["-q", "--model", "quicklook", "--backend", "numpy",
+          "-o", str(tmp_path / "np.npz"), archive_file])
+    main(["-q", "--model", "quicklook", archive_file])
+    a = load_archive(str(tmp_path / "np.npz"))
+    b = load_archive(archive_file + "_cleaned.npz")
+    np.testing.assert_array_equal(a.weights == 0, b.weights == 0)
 
 
 def test_batch_keep_going_isolates_bad_archive(tmp_path, monkeypatch,

@@ -45,6 +45,18 @@ def test_quicklook_zaps_injected_rfi():
     assert ((full.final_weights == 0) & expected).sum() >= caught
 
 
+def test_quicklook_backend_parity_float64():
+    """Bit-identical masks between the jax and numpy quicklook paths at
+    float64 — the same differential rule the flagship holds to."""
+    ar, _ = make_synthetic_archive(nsub=12, nchan=24, nbin=64, seed=8,
+                                   n_prezapped=4)
+    jx = get_model(QUICKLOOK)(ar, CleanConfig(dtype="float64"))
+    npy = get_model(QUICKLOOK)(ar, CleanConfig(backend="numpy",
+                                               dtype="float64"))
+    np.testing.assert_array_equal(jx.final_weights, npy.final_weights)
+    np.testing.assert_allclose(jx.scores, npy.scores, rtol=1e-9, atol=1e-9)
+
+
 def test_quicklook_preserves_prezapped_cells():
     ar, _ = make_synthetic_archive(nsub=8, nchan=16, nbin=32, seed=5,
                                    n_prezapped=6)
