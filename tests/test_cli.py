@@ -254,6 +254,52 @@ def test_mesh_incompatible_flags(tmp_path):
             main(bad + [str(tmp_path / "x.npz")])
 
 
+def test_stream_flag_matches_library_streaming(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io import make_synthetic_archive, save_archive
+    from iterative_cleaner_tpu.parallel.streaming import clean_streaming
+
+    ar, _ = make_synthetic_archive(nsub=24, nchan=16, nbin=32, seed=6)
+    p = str(tmp_path / "long.npz")
+    save_archive(ar, p)
+    main(["-q", "--stream", "8", "--rotation", "roll", "--fft_mode", "dft",
+          p])
+    want = clean_streaming(
+        ar, 8, CleanConfig(rotation="roll", fft_mode="dft"))
+    got = load_archive(p + "_cleaned.npz")
+    np.testing.assert_array_equal(got.weights == 0,
+                                  want.final_weights == 0)
+
+
+def test_stream_with_cell_mesh(tmp_path, monkeypatch):
+    """--stream 8 --mesh cell: every tile sharded over the 8 virtual
+    devices; masks match the unsharded streaming run."""
+    monkeypatch.chdir(tmp_path)
+    from iterative_cleaner_tpu.io import make_synthetic_archive, save_archive
+
+    ar, _ = make_synthetic_archive(nsub=32, nchan=16, nbin=32, seed=7)
+    p = str(tmp_path / "long2.npz")
+    save_archive(ar, p)
+    main(["-q", "--stream", "8", "--rotation", "roll", "--fft_mode", "dft",
+          p])
+    plain = load_archive(p + "_cleaned.npz").weights
+    main(["-q", "--stream", "8", "--mesh", "cell", "--rotation", "roll",
+          "--fft_mode", "dft", "-o", str(tmp_path / "meshed.npz"), p])
+    np.testing.assert_array_equal(
+        load_archive(str(tmp_path / "meshed.npz")).weights, plain)
+
+
+def test_stream_incompatible_flags(tmp_path):
+    for bad in (["--stream", "8", "--batch", "2"],
+                ["--stream", "8", "-u"],
+                ["--stream", "8", "--record_history"],
+                ["--stream", "8", "--model", "quicklook"],
+                ["--stream", "8", "--checkpoint", str(tmp_path)]):
+        with pytest.raises(SystemExit):
+            main(bad + [str(tmp_path / "x.npz")])
+
+
 def test_model_quicklook_cleans(archive_file, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     main(["-q", "--model", "quicklook", archive_file])
