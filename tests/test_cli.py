@@ -355,6 +355,17 @@ def test_batch_keep_going_isolates_bad_archive(tmp_path, monkeypatch,
 
 
 class TestTools:
+    def test_selftest_passes(self, capsys, monkeypatch):
+        from iterative_cleaner_tpu.tools import main as tools_main
+
+        # skip the dead-tunnel subprocess probe (the suite is pinned to
+        # CPU anyway; without this the probe burns its full timeout when
+        # the machine's accelerator tunnel is down)
+        monkeypatch.setenv("ICLEAN_PLATFORM", "cpu")
+        assert tools_main(["selftest"]) == 0
+        out = capsys.readouterr().out
+        assert "OK: masks bit-identical" in out
+
     def test_info_and_convert_and_diff(self, tmp_path, monkeypatch, capsys):
         import json
 
