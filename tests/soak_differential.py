@@ -1,0 +1,87 @@
+"""Extended randomized differential soak — run manually, not collected.
+
+300 random (geometry, RFI mix, thresholds, pulse region, bad-parts)
+draws; for each, the upstream reference script is EXECUTED against the
+fake psrchive backend and both framework backends (numpy oracle and jax
+float64) must reproduce its final weights exactly.  A 25x longer sweep
+than the CI fuzz (tests/test_upstream_differential.py::test_randomized_upstream_fuzz)
+for release-style confidence:
+
+    python tests/soak_differential.py          # ~12 min on one CPU
+
+Last full run 2026-07-30: 300/300 clean.
+"""
+import os, sys, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+
+from tests import test_upstream_differential as T
+from iterative_cleaner_tpu.backends import clean_archive
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+class _Up:  # mimic the module-scoped fixture
+    pass
+
+upstream = None
+for name in ("upstream",):
+    # replicate the fixture body
+    import importlib.util, types
+    from tests import fake_psrchive
+    spec = importlib.util.spec_from_file_location("upstream_ref", T.REF_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["psrchive"] = fake_psrchive
+    saved_plt = sys.modules.get("matplotlib.pyplot")
+    import matplotlib
+    matplotlib.use("Agg")
+    spec.loader.exec_module(mod)
+    upstream = mod
+
+t0 = time.time()
+fail = 0
+for trial in range(300):
+    rng = np.random.default_rng(90000 + trial)
+    nsub = int(rng.integers(2, 14)); nchan = int(rng.integers(2, 18))
+    nbin = int(rng.choice([8, 16, 32, 64]))
+    ar, _ = make_synthetic_archive(
+        nsub=nsub, nchan=nchan, nbin=nbin,
+        n_rfi_cells=int(rng.integers(0, 5)),
+        n_rfi_channels=int(rng.integers(0, 2)),
+        n_rfi_subints=int(rng.integers(0, 2)),
+        n_prezapped=int(rng.integers(0, max(1, nsub * nchan // 4))),
+        rfi_strength=float(rng.uniform(10, 80)),
+        pulse_snr=float(rng.uniform(3, 50)),
+        seed=int(rng.integers(0, 2 ** 31)))
+    pulse_region = [0, 0, 1]
+    if rng.random() < 0.4:
+        a, b = sorted(rng.integers(0, nbin, size=2).tolist())
+        pulse_region = [float(rng.uniform(0, 1)), float(a), float(b)]
+    args = T.ref_args(
+        chanthresh=float(rng.uniform(2.5, 8)),
+        subintthresh=float(rng.uniform(2.5, 8)),
+        max_iter=int(rng.integers(1, 7)),
+        pulse_region=pulse_region,
+        bad_chan=float(rng.choice([1.0, rng.uniform(0.2, 0.9)])),
+        bad_subint=float(rng.choice([1.0, rng.uniform(0.2, 0.9)])))
+    try:
+        ref_w = T.run_upstream(upstream, ar, args)
+        cfg = T._config_from_args(args)
+        res_np = clean_archive(ar.clone(), cfg)
+        assert np.array_equal(res_np.final_weights, ref_w), "numpy vs upstream"
+        import dataclasses
+        cfg_jax = dataclasses.replace(cfg, backend="jax", dtype="float64")
+        res_jx = clean_archive(ar.clone(), cfg_jax)
+        assert np.array_equal(res_jx.final_weights, ref_w), "jax vs upstream"
+    except Exception as e:
+        fail += 1
+        print(f"TRIAL {trial} FAILED: {type(e).__name__}: {e}", flush=True)
+    if trial % 25 == 24:
+        print(f"{trial+1}/300 done, {fail} failures, {time.time()-t0:.0f}s",
+              flush=True)
+        # every trial compiles fresh programs (unique geometry x 2 backends);
+        # without this the accumulated executables exhaust RAM ~trial 230
+        jax.clear_caches()
+print(f"SOAK DONE: {fail} failures of 300 in {time.time()-t0:.0f}s", flush=True)
