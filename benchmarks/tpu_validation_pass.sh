@@ -36,4 +36,4 @@ EOF
 
 # 4. Batched (vmap) sort-vs-pallas decision measurement: if pallas/fused
 #    wins, drop the forced-sort gate in parallel/batch.py + cli.py.
-PYTHONPATH=. python /tmp/batch_pallas_probe.py || true
+PYTHONPATH=. python benchmarks/batch_pallas_probe.py || true
