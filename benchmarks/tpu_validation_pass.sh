@@ -37,3 +37,9 @@ EOF
 # 4. Batched (vmap) sort-vs-pallas decision measurement: if pallas/fused
 #    wins, drop the forced-sort gate in parallel/batch.py + cli.py.
 PYTHONPATH=. python benchmarks/batch_pallas_probe.py || true
+
+# 5. (experiment) Fused-kernel sublane tier: _S_BLK=8 is the floor; at
+#    nbin<=256 VMEM has room for 16/32-row cell blocks -> bigger MXU
+#    matmuls in the DFT stage. Edit stats/pallas_kernels.py:_S_BLK, rerun
+#    step 3's first profile line, keep whichever "cell diagnostics
+#    (fused pallas)" row is faster (revert on VMEM compile failures).
