@@ -137,6 +137,33 @@ def test_sharded_pallas_clean_matches_single_device(stats_frame):
     assert sharded.converged == single.converged
 
 
+def test_sharded_honours_dedispersed_flag():
+    """DEDISP=1 archives through the sharded path: the forward rotation
+    must be skipped exactly as on the single-device path (VERDICT r1 item
+    5 covered the unsharded backends; the sharded builder compiles the
+    flag in separately)."""
+    from iterative_cleaner_tpu.backends.jax_backend import clean_cube
+    from iterative_cleaner_tpu.parallel.sharding import clean_archive_sharded
+
+    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+    # dm=300 spans many bins: a path that spuriously rotated a second time
+    # would smear the pulse and change the masks
+    ded_ar, _ = make_synthetic_archive(nsub=8, nchan=16, nbin=64, seed=17,
+                                       dm=300.0, dtype=np.float32,
+                                       disperse=False)
+    ded_ar.dedispersed = True
+
+    cfg = CleanConfig(max_iter=3, rotation="roll", fft_mode="dft",
+                      dtype="float32")
+    single = clean_cube(ded_ar.total_intensity(), ded_ar.weights,
+                        ded_ar.freqs_mhz, ded_ar.dm, ded_ar.centre_freq_mhz,
+                        ded_ar.period_s, cfg, dedispersed=True)
+    sharded = clean_archive_sharded(ded_ar, cfg, _mesh())
+    np.testing.assert_array_equal(single.final_weights,
+                                  sharded.final_weights)
+
+
 def test_uneven_grid_fails_fast():
     """NamedSharding rejects uneven shards deep inside jit; the sharded
     entry point surfaces that as an immediate, actionable error instead."""
