@@ -1,15 +1,20 @@
 """Extended randomized differential soak — run manually, not collected.
 
-300 random (geometry, RFI mix, thresholds, pulse region, bad-parts)
-draws; for each, the upstream reference script is EXECUTED against the
-fake psrchive backend and both framework backends (numpy oracle and jax
-float64) must reproduce its final weights exactly.  A 25x longer sweep
-than the CI fuzz (tests/test_upstream_differential.py::test_randomized_upstream_fuzz)
-for release-style confidence:
+Phase 1: 300 random (geometry, RFI mix, thresholds, pulse region,
+bad-parts) draws; for each, the upstream reference script is EXECUTED
+against the fake psrchive backend and both framework backends (numpy
+oracle and jax float64) must reproduce its final weights exactly.  A 25x
+longer sweep than the CI fuzz
+(tests/test_upstream_differential.py::test_randomized_upstream_fuzz).
 
-    python tests/soak_differential.py          # ~12 min on one CPU
+Phase 2: 200 hostile-value draws (subnormals, +-inf, NaN, heavy ties,
+60-decade magnitude spreads, random masks incl. dead lines) against the
+Pallas radix-bisection median — must stay bit-identical to the sort path
+on every one (the total-order claim of stats/pallas_kernels.py).
 
-Last full run 2026-07-30: 300/300 clean.
+    python tests/soak_differential.py          # ~13 min on one CPU
+
+Last full run 2026-07-30: phase 1 300/300 clean, phase 2 200/200 clean.
 """
 import os, sys, time
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
@@ -84,4 +89,47 @@ for trial in range(300):
         # every trial compiles fresh programs (unique geometry x 2 backends);
         # without this the accumulated executables exhaust RAM ~trial 230
         jax.clear_caches()
-print(f"SOAK DONE: {fail} failures of 300 in {time.time()-t0:.0f}s", flush=True)
+print(f"PHASE 1 DONE: {fail} failures of 300 in {time.time()-t0:.0f}s",
+      flush=True)
+
+# ---- phase 2: hostile-value Pallas median fuzz ---------------------------
+from iterative_cleaner_tpu.stats.masked_jax import masked_median  # noqa: E402
+
+t1 = time.time()
+kfail = 0
+rng = np.random.default_rng(0)
+for t in range(200):
+    n = int(rng.integers(1, 40)); m = int(rng.integers(1, 40))
+    kind = t % 5
+    if kind == 0:
+        v = rng.normal(size=(n, m)).astype(np.float32)
+    elif kind == 1:  # subnormals + signed zeros + extremes
+        v = rng.choice([0.0, -0.0, 1e-44, -1e-44, 1e-38, -1e38, 1e38],
+                       size=(n, m)).astype(np.float32)
+    elif kind == 2:  # infs and NaNs sprinkled
+        v = rng.normal(size=(n, m)).astype(np.float32)
+        v[rng.random((n, m)) < 0.1] = np.inf
+        v[rng.random((n, m)) < 0.1] = -np.inf
+        v[rng.random((n, m)) < 0.05] = np.nan
+    elif kind == 3:  # heavy ties
+        v = rng.choice([-2.0, -1.0, 0.0, 1.0, 2.0],
+                       size=(n, m)).astype(np.float32)
+    else:            # huge magnitude spread
+        v = (rng.normal(size=(n, m))
+             * 10.0 ** rng.integers(-30, 30, size=(n, m))).astype(np.float32)
+    mask = rng.random((n, m)) < rng.uniform(0, 1)
+    if rng.random() < 0.3:
+        mask[:, rng.integers(0, m)] = True
+    axis = int(rng.integers(0, 2))
+    a = np.asarray(jax.jit(
+        lambda v, mm, ax=axis: masked_median(v, mm, ax, "sort"))(v, mask))
+    b = np.asarray(jax.jit(
+        lambda v, mm, ax=axis: masked_median(v, mm, ax, "pallas"))(v, mask))
+    if not np.array_equal(a, b, equal_nan=True):
+        kfail += 1
+        print(f"PHASE 2 trial {t} kind {kind} MISMATCH", flush=True)
+    if t % 50 == 49:
+        jax.clear_caches()
+print(f"PHASE 2 DONE: {kfail} mismatches of 200 in {time.time()-t1:.0f}s",
+      flush=True)
+print(f"SOAK DONE: {fail + kfail} total failures", flush=True)
