@@ -55,9 +55,27 @@ def _log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-# Full-size float64 oracle, 1024x4096x128, one CPU socket: 273.3 s/iteration
-# (BASELINE.md "Measured baselines", measured in-repo round 1).
-ORACLE_FULL_RATE = 1024 * 4096 / 273.3  # ~1.54e4 cell-iters/s
+def oracle_full_rate():
+    """Recorded full-size oracle rate (cell-iters/s), single-sourced from
+    BASELINE.md's "Measured baselines" table (the config-3 row's
+    "NNN s/iteration" figure — ~273.3 s/iteration as of round 1) so a
+    re-measured oracle cannot silently diverge from the bench denominator.
+    Resolved lazily: only the full-size headline branch needs it, and a
+    small/fallback run must not die on a missing/reworded BASELINE.md.
+    tests/test_bench_config.py guards the parse."""
+    import re
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.md")
+    with open(path) as fh:
+        text = fh.read()
+    m = re.search(r"config 3, full size[^\n]*?\(([\d.]+) s/iteration\)", text)
+    if not m:
+        raise RuntimeError(
+            "could not parse the full-size oracle s/iteration figure from "
+            "BASELINE.md 'Measured baselines' (config 3 row); bench.py's "
+            "vs_baseline denominator is single-sourced there")
+    return 1024 * 4096 / float(m.group(1))
 
 # Peak HBM bandwidth by device_kind substring, bytes/s (public chip specs).
 _HBM_PEAK = {
@@ -311,10 +329,11 @@ def main():
         # Headline methodology (BASELINE.md "Measured baselines"): divide by
         # the recorded FULL-SIZE oracle rate; the live 1/16-slice run above
         # is an environment sanity check (cache-friendlier, so faster).
-        denom = ORACLE_FULL_RATE
+        denom = oracle_full_rate()
         _log(f"denominator: recorded full-size oracle rate {denom:.3e} "
-             f"cell-iters/s (273.3 s/iteration, BASELINE.md); live 1/16 "
-             f"slice sanity check measured {np_rate:.3e}")
+             f"cell-iters/s ({1024 * 4096 / denom:.1f} s/iteration, "
+             f"BASELINE.md); live 1/16 slice sanity check measured "
+             f"{np_rate:.3e}")
     else:
         denom = np_rate
         _log(f"denominator: live-measured oracle rate {np_rate:.3e} "

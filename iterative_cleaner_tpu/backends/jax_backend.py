@@ -82,13 +82,26 @@ def resolve_stats_impl(stats_impl: str, dtype, nbin: int,
     stricter than the kernel's VMEM limit of FUSED_STATS_MAX_NBIN because
     the k-chunked long-profile path is interpret-verified only; explicit
     stats_impl='fused' reaches the full range)."""
-    if stats_impl != "auto":
+    if stats_impl not in ("auto", "fused"):
+        # explicit non-fused choices must stay jax-free: touching
+        # jax.devices() here would initialise (and possibly hang on) an
+        # unreachable accelerator the caller explicitly routed around
         return stats_impl
     from iterative_cleaner_tpu.stats.pallas_kernels import (
         FUSED_STATS_AUTO_MAX_NBIN,
     )
 
     on_tpu = jax.devices()[0].platform == "tpu"
+    if stats_impl == "fused":
+        if on_tpu and nbin > FUSED_STATS_AUTO_MAX_NBIN:
+            import warnings
+
+            warnings.warn(
+                f"stats_impl='fused' at nbin={nbin} uses the k-chunked "
+                f"Mosaic lowering, which has only been hardware-validated "
+                f"up to {FUSED_STATS_AUTO_MAX_NBIN} bins; if the compile "
+                "fails, fall back to stats_impl='xla'", stacklevel=2)
+        return stats_impl
     ok = (on_tpu and jnp.dtype(dtype) == jnp.float32
           and fft_mode_resolved == "dft"
           and nbin <= FUSED_STATS_AUTO_MAX_NBIN)

@@ -144,7 +144,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "with --mesh cell (each tile sharded). Tile "
                              "scaler populations see only their own "
                              "subints; measured mask drift vs "
-                             "whole-archive cleaning is <0.1%.")
+                             "whole-archive cleaning is <0.1%. Drift grows "
+                             "with the final tile's zero-weight padding "
+                             "fraction — prefer a CHUNK near a divisor of "
+                             "the observation's subint count.")
     parser.add_argument("--mesh", choices=("off", "cell", "batch"),
                         default="off",
                         help="Multi-device execution: 'cell' shards each "

@@ -41,7 +41,12 @@ from iterative_cleaner_tpu.backends.base import CleanResult
 from iterative_cleaner_tpu.config import CleanConfig
 
 
-@functools.lru_cache(maxsize=None)
+# Bounded: quicklook's triage use case sweeps thresholds in long-lived
+# processes, and every distinct float config is a separately compiled jax
+# program — an unbounded cache would grow monotonically there.  32 recent
+# configs cover any realistic sweep's working set; evicted entries only
+# cost a recompile.
+@functools.lru_cache(maxsize=32)
 def _build_quicklook_fn(chanthresh, subintthresh, baseline_duty, rotation,
                         fft_mode, median_impl, dedispersed):
     import jax

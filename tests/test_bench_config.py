@@ -1,0 +1,22 @@
+"""Guards for bench.py's recorded-baseline plumbing.
+
+bench.py single-sources its full-size vs_baseline denominator from
+BASELINE.md's "Measured baselines" table; this pins the parse so an edit
+to the table cannot silently break (or stale-out) the bench at driver
+run time.
+"""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_oracle_full_rate_parses_and_matches_record():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    # The round-1 record: 273.3 s/iteration.  If the oracle is re-measured,
+    # update BASELINE.md and this pin together.
+    assert abs(1024 * 4096 / bench.oracle_full_rate() - 273.3) < 0.05
