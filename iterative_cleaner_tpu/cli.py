@@ -304,6 +304,11 @@ def clean_one(in_path: str, args: argparse.Namespace,
             ar,
             data=result.residual[:, None, :, :].astype(ar.data.dtype),
             pol_state="Intensity",
+            # a derived product, not the source archive: filename="" keeps
+            # io.save_archive off the TIMER clone-and-set path, which would
+            # skip the residual amplitudes for a multi-pol source (the
+            # residual is always single-pol)
+            filename="",
         )
         res_ext = os.path.splitext(o_name)[1]
         ar_io.save_archive(

@@ -64,12 +64,54 @@ def load_ar(path: str) -> Archive:  # pragma: no cover - needs psrchive
     )
 
 
-def save_ar(archive: Archive, path: str) -> None:  # pragma: no cover
-    raise NotImplementedError(
-        "Writing .ar requires an original psrchive Archive to carry the full "
-        "header; use apply_weights_to_ar() to write cleaned weights back "
-        "into a loaded archive instead."
-    )
+def save_ar(archive: Archive, path: str) -> None:
+    """Write the model back to a psrchive-format archive (reference :60).
+
+    A PSRCHIVE file (TIMER or otherwise) carries far more header state than
+    the framework's Archive model, so the write is clone-and-set: reload the
+    model's source file (``archive.filename``), overwrite its (nsub, nchan)
+    weights, write per-profile amplitudes back when the model still has the
+    source's full (nsub, npol, nchan, nbin) shape (a pscrunched model keeps
+    the source's pols — the reference's full-pol output path, :149-153),
+    and ``unload`` to ``path``.
+    """
+    psr = _psrchive()
+    if not archive.filename:
+        raise ValueError(
+            "save_ar writes via clone-and-set and needs archive.filename to "
+            "point at the psrchive-readable source file; for archives born "
+            "in-framework use io.save_archive (.npz/PSRFITS) instead.")
+    ar = psr.Archive_load(archive.filename)
+    nsub, nchan = ar.get_nsubint(), ar.get_nchan()
+    weights = np.asarray(archive.weights, dtype=np.float64)
+    if weights.shape != (nsub, nchan):
+        raise ValueError(
+            f"weights shape {weights.shape} does not match the source "
+            f"archive's ({nsub}, {nchan}); save_ar cannot clone-and-set "
+            "across a reshaped cell grid")
+    _set_weights(ar, weights)
+    data = np.asarray(archive.data)
+    if data.shape == (nsub, ar.get_npol(), nchan, ar.get_nbin()):
+        # amplitude write-back (the reference's residual unload mutates
+        # profiles the same way, :272,:161-162); a scrunched model no
+        # longer matches and keeps the source's amplitudes instead.  The
+        # common weights-only save carries the source data untouched — one
+        # cube comparison is far cheaper than nsub*npol*nchan per-profile
+        # binding calls that would rewrite identical values.
+        src_data = np.asarray(ar.get_data(), dtype=data.dtype)
+        if not np.array_equal(data, src_data):
+            for isub, ipol, ichan in np.ndindex(*data.shape[:3]):
+                prof = ar.get_Profile(isub, ipol, ichan)
+                prof.get_amps()[:] = data[isub, ipol, ichan]
+    ar.unload(path)
+
+
+def _set_weights(ar, weights: np.ndarray) -> None:
+    """Overwrite a loaded psrchive Archive's (nsub, nchan) weights in place."""
+    for isub in range(ar.get_nsubint()):
+        integ = ar.get_Integration(isub)
+        for ichan in range(ar.get_nchan()):
+            integ.set_weight(ichan, float(weights[isub, ichan]))
 
 
 def apply_weights_to_ar(ar_path: str, out_path: str,
@@ -78,8 +120,5 @@ def apply_weights_to_ar(ar_path: str, out_path: str,
     and unload to ``out_path`` (reference :153,:60 combined)."""
     psr = _psrchive()
     ar = psr.Archive_load(ar_path)
-    for isub in range(ar.get_nsubint()):
-        integ = ar.get_Integration(isub)
-        for ichan in range(ar.get_nchan()):
-            integ.set_weight(ichan, float(weights[isub, ichan]))
+    _set_weights(ar, weights)
     ar.unload(out_path)

@@ -60,10 +60,87 @@ def test_map_state():
     assert bridge._map_state("Stokes", 4) == "Stokes"
 
 
-def test_save_ar_refuses():
+def test_save_ar_roundtrips_weights_and_data(ar_file, tmp_path):
+    """Clone-and-set write path (reference :60): cleaned weights and edited
+    amplitudes land in the output; untouched metadata rides the source."""
+    path, _ = ar_file
+    model = bridge.load_ar(path)
+    model.weights[1, 2] = 0.0
+    model.data[0, 0, 1, :] = 7.25  # e.g. a residual write-back
+    out = str(tmp_path / "saved.npz")
+    bridge.save_ar(model, out)
+    got = load_archive(out)
+    np.testing.assert_array_equal(got.weights, model.weights)
+    np.testing.assert_array_equal(got.data, model.data)
+    assert got.source == model.source
+
+
+def test_save_ar_scrunched_model_keeps_source_amplitudes(tmp_path):
+    """A pscrunched model no longer matches a multi-pol source's shape:
+    weights still write through, amplitudes stay the source's (the
+    reference's full-pol output semantics, :149-153)."""
+    src, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, npol=2,
+                                    seed=9, n_prezapped=2)
+    path = str(tmp_path / "obs.npz")
+    save_archive(src, path)
+    model = bridge.load_ar(path)
+    model.pscrunch()
+    assert model.npol == 1 and src.npol == 2  # the gate under test
+    new_w = model.weights.copy()
+    new_w[3, 4] = 0.0
+    model.weights[:] = new_w
+    out = str(tmp_path / "saved2.npz")
+    bridge.save_ar(model, out)
+    got = load_archive(out)
+    np.testing.assert_array_equal(got.weights, new_w)
+    np.testing.assert_array_equal(got.data, np.asarray(src.data))
+
+
+def test_save_ar_needs_source_file():
     ar, _ = make_synthetic_archive(nsub=2, nchan=4, nbin=8)
-    with pytest.raises(NotImplementedError):
+    assert ar.filename == ""
+    with pytest.raises(ValueError, match="filename"):
         bridge.save_ar(ar, "x.ar")
+
+
+def test_save_ar_rejects_reshaped_cell_grid(ar_file, tmp_path):
+    path, _ = ar_file
+    model = bridge.load_ar(path)
+    import dataclasses
+
+    model = dataclasses.replace(model, data=model.data[:-1],
+                                weights=model.weights[:-1],
+                                filename=model.filename)
+    with pytest.raises(ValueError, match="cell grid"):
+        bridge.save_ar(model, str(tmp_path / "bad.npz"))
+
+
+def test_save_archive_routes_timer_source_via_bridge(tmp_path, monkeypatch):
+    """io.save_archive keeps a TIMER-sourced .ar in TIMER format: the
+    reference's unload writes the source's own format class (ref :60)."""
+    import dataclasses
+
+    src = tmp_path / "src.ar"
+    src.write_bytes(b"not a FITS file")  # no FITS magic => TIMER-format
+    ar, _ = make_synthetic_archive(nsub=2, nchan=4, nbin=8)
+    ar = dataclasses.replace(ar, filename=str(src))
+    calls = {}
+    monkeypatch.setattr(bridge, "save_ar",
+                        lambda a, p: calls.setdefault("path", p))
+    out = str(tmp_path / "out.ar")
+    save_archive(ar, out)
+    assert calls["path"] == out
+
+
+def test_save_archive_fits_ar_stays_psrfits(tmp_path):
+    """A PSRFITS-sourced (or source-less) .ar write keeps the built-in
+    PSRFITS layout — the bridge is only for TIMER sources."""
+    ar, _ = make_synthetic_archive(nsub=2, nchan=4, nbin=8)
+    out = str(tmp_path / "out.ar")
+    save_archive(ar, out)
+    from iterative_cleaner_tpu.io import psrfits
+
+    assert psrfits.is_fits(out)
 
 
 def test_clear_error_without_psrchive(monkeypatch, ar_file):
