@@ -34,26 +34,25 @@ def save_archive(ar: Archive, path: str) -> None:
         from iterative_cleaner_tpu.io import psrfits
 
         src = ar.filename
-        if (ext == ".ar" and src and src.lower().endswith(".ar")
-                and not os.path.exists(src)):
-            import warnings
+        if ext == ".ar" and src and src.lower().endswith(".ar"):
+            if not os.path.exists(src):
+                import warnings
 
-            warnings.warn(
-                f"source archive {src} is no longer on disk; writing {path} "
-                "in the built-in PSRFITS layout (a TIMER-format source "
-                "would otherwise round-trip through the psrchive bridge)",
-                stacklevel=2)
-        if (ext == ".ar" and src and src.lower().endswith(".ar")
-                and os.path.exists(src) and not psrfits.is_fits(src)):
-            # TIMER-format source: PSRCHIVE's unload keeps the source's
-            # format class (reference :60), so a cleaned TIMER archive
-            # writes back through the bridge's clone-and-set path rather
-            # than being converted to PSRFITS.  The bridge loaded it, so
-            # the bindings are importable here.
-            from iterative_cleaner_tpu.io import psrchive_bridge
+                warnings.warn(
+                    f"source archive {src} is no longer on disk; writing "
+                    f"{path} in the built-in PSRFITS layout (a TIMER-format "
+                    "source would otherwise round-trip through the psrchive "
+                    "bridge)", stacklevel=2)
+            elif not psrfits.is_fits(src):
+                # TIMER-format source: PSRCHIVE's unload keeps the source's
+                # format class (reference :60), so a cleaned TIMER archive
+                # writes back through the bridge's clone-and-set path rather
+                # than being converted to PSRFITS.  The bridge loaded it, so
+                # the bindings are importable here.
+                from iterative_cleaner_tpu.io import psrchive_bridge
 
-            psrchive_bridge.save_ar(ar, path)
-            return
+                psrchive_bridge.save_ar(ar, path)
+                return
         # modern .ar archives are PSRFITS; write the standard layout
         psrfits.save_psrfits(ar, path)
         return

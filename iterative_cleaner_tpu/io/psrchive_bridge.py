@@ -82,6 +82,11 @@ def save_ar(archive: Archive, path: str) -> None:
             "point at the psrchive-readable source file; for archives born "
             "in-framework use io.save_archive (.npz/PSRFITS) instead.")
     ar = psr.Archive_load(archive.filename)
+    if archive.npol == 1 and ar.get_npol() > 1:
+        # a pscrunched model must write a pscrunched archive (the
+        # reference's -p output is single-pol); scrunching the reload makes
+        # the shapes line up so the amplitudes below write through
+        ar.pscrunch()
     nsub, nchan = ar.get_nsubint(), ar.get_nchan()
     weights = np.asarray(archive.weights, dtype=np.float64)
     if weights.shape != (nsub, nchan):

@@ -75,21 +75,42 @@ def test_save_ar_roundtrips_weights_and_data(ar_file, tmp_path):
     assert got.source == model.source
 
 
-def test_save_ar_scrunched_model_keeps_source_amplitudes(tmp_path):
-    """A pscrunched model no longer matches a multi-pol source's shape:
-    weights still write through, amplitudes stay the source's (the
-    reference's full-pol output semantics, :149-153)."""
+def test_save_ar_pscrunched_model_writes_pscrunched_archive(tmp_path):
+    """A pscrunched model of a multi-pol source writes a pscrunched archive
+    (the reference's -p output is single-pol): save_ar scrunches the
+    reload so the model's amplitudes line up and write through."""
     src, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, npol=2,
                                     seed=9, n_prezapped=2)
     path = str(tmp_path / "obs.npz")
     save_archive(src, path)
     model = bridge.load_ar(path)
     model.pscrunch()
-    assert model.npol == 1 and src.npol == 2  # the gate under test
+    assert model.npol == 1 and src.npol == 2
     new_w = model.weights.copy()
     new_w[3, 4] = 0.0
     model.weights[:] = new_w
     out = str(tmp_path / "saved2.npz")
+    bridge.save_ar(model, out)
+    got = load_archive(out)
+    assert got.npol == 1
+    np.testing.assert_array_equal(got.weights, new_w)
+    np.testing.assert_array_equal(got.data, model.data)
+
+
+def test_save_ar_reshaped_bins_keep_source_amplitudes(tmp_path):
+    """A model whose bin axis no longer matches the source cannot write
+    amplitudes back: weights write through, data stays the source's."""
+    import dataclasses
+
+    src, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=9)
+    path = str(tmp_path / "obs.npz")
+    save_archive(src, path)
+    model = bridge.load_ar(path)
+    model = dataclasses.replace(model, data=model.data[:, :, :, :16])
+    new_w = model.weights.copy()
+    new_w[1, 1] = 0.0
+    model.weights[:] = new_w
+    out = str(tmp_path / "saved3.npz")
     bridge.save_ar(model, out)
     got = load_archive(out)
     np.testing.assert_array_equal(got.weights, new_w)
@@ -109,8 +130,7 @@ def test_save_ar_rejects_reshaped_cell_grid(ar_file, tmp_path):
     import dataclasses
 
     model = dataclasses.replace(model, data=model.data[:-1],
-                                weights=model.weights[:-1],
-                                filename=model.filename)
+                                weights=model.weights[:-1])
     with pytest.raises(ValueError, match="cell grid"):
         bridge.save_ar(model, str(tmp_path / "bad.npz"))
 
