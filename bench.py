@@ -135,7 +135,7 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=4):
     )
     from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
 
-    ar, _ = make_synthetic_archive(
+    ar, truth = make_synthetic_archive(
         nsub=nsub, nchan=nchan, nbin=nbin,
         n_rfi_cells=max(8, nsub * nchan // 2048),
         n_rfi_channels=max(1, nchan // 512),
@@ -170,6 +170,17 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=4):
     loops = int(outs.loops)
     _log(f"compile+first run: {compile_and_first:.2f}s, loops={loops}, "
          f"rfi_frac={float((np.asarray(outs.final_weights) == 0).mean()):.4f}")
+
+    # cleaning-quality scorecard against the injected truth (the run just
+    # happened; scoring the mask is free) — reported alongside throughput
+    # so a fast-but-wrong regression cannot hide in the headline number
+    from iterative_cleaner_tpu.utils.quality import zap_quality
+
+    quality = {
+        k: (None if v is None else round(v, 4))
+        for k, v in zap_quality(np.asarray(outs.final_weights), truth).items()
+    }
+    _log(f"zap quality vs injected truth: {quality}")
 
     # --- differential timing, robust to the tunnel ---------------------
     # The axon tunnel adds a large, *jittery* fixed cost per execute+fetch
@@ -259,7 +270,7 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=4):
              f"peak = {hbm_util:.2f} HBM utilisation")
     elif per_iter is None:
         _log("hbm_util omitted: no clean differential per-iteration time")
-    return rate, dev.platform, hbm_util
+    return rate, dev.platform, hbm_util, quality
 
 
 def bench_numpy(nsub, nchan, nbin, max_iter=5):
@@ -314,10 +325,10 @@ def main():
 
     np_rate = bench_numpy(*np_cfg)
 
-    jax_rate = platform = hbm_util = None
+    jax_rate = platform = hbm_util = quality = None
     for cfg in (jax_cfg, (512, 4096, 128), (512, 2048, 128)):
         try:
-            jax_rate, platform, hbm_util = bench_jax(*cfg)
+            jax_rate, platform, hbm_util, quality = bench_jax(*cfg)
             jax_cfg = cfg
             break
         except Exception as e:  # OOM fallback ladder
@@ -348,6 +359,7 @@ def main():
         "vs_baseline": round(jax_rate / denom, 2),
         "platform": platform,
         "hbm_util": None if hbm_util is None else round(hbm_util, 3),
+        "quality": quality,
     }
     if platform != "tpu":
         # Dead-tunnel fallback: surface the most recent committed real-TPU

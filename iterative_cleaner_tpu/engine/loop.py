@@ -125,8 +125,40 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
         raise ValueError(
             "stats_impl='fused' computes DFT-flavoured rFFT magnitudes; "
             "pass fft_mode='dft'")
-    nsub, nchan, nbin = ded_cube.shape
     template = weighted_template(ded_cube, weights, jnp) * 10000.0  # ref :94
+    diags = diagnostics_given_template(
+        ded_cube, disp_base, template, orig_weights, cell_mask, back_shifts,
+        pulse_slice=pulse_slice, pulse_scale=pulse_scale,
+        pulse_active=pulse_active, rotation=rotation, fft_mode=fft_mode,
+        stats_impl=stats_impl, stats_frame=stats_frame,
+        shard_mesh=shard_mesh,
+    )
+    if shard_mesh is not None and median_impl == "pallas":
+        from iterative_cleaner_tpu.parallel.shard_stats import (
+            sharded_scale_and_combine,
+        )
+
+        scores = sharded_scale_and_combine(shard_mesh, diags, cell_mask,
+                                           chanthresh, subintthresh,
+                                           median_impl)
+    else:
+        scores = scale_and_combine(diags, cell_mask, chanthresh,
+                                   subintthresh, median_impl)
+    new_weights = jnp.where(scores >= 1.0, 0.0, orig_weights)  # ref :300-305
+    return new_weights, scores
+
+
+def diagnostics_given_template(ded_cube, disp_base, template, orig_weights,
+                               cell_mask, back_shifts, *, pulse_slice,
+                               pulse_scale, pulse_active, rotation,
+                               fft_mode="fft", stats_impl="xla",
+                               stats_frame="dispersed", shard_mesh=None):
+    """The per-cell half of an iteration for an already-built template:
+    fit, residual, weighting, four diagnostics.  Everything here is
+    cell-local (bin-axis reductions only), which is what lets the exact
+    streaming mode (:mod:`iterative_cleaner_tpu.parallel.streaming_exact`)
+    evaluate it per subint tile and concatenate."""
+    nsub, nchan, nbin = ded_cube.shape
     m = _pulse_window(nbin, pulse_slice, pulse_scale, pulse_active,
                       ded_cube.dtype)
     if stats_frame == "dedispersed":
@@ -181,19 +213,7 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
             resid = amps[:, :, None] * rot_t[None] - disp_base  # ref :277-279
             weighted = resid * orig_weights[:, :, None]  # apply_weights :291-297
             diags = cell_diagnostics_jax(weighted, cell_mask, fft_mode)
-    if shard_mesh is not None and median_impl == "pallas":
-        from iterative_cleaner_tpu.parallel.shard_stats import (
-            sharded_scale_and_combine,
-        )
-
-        scores = sharded_scale_and_combine(shard_mesh, diags, cell_mask,
-                                           chanthresh, subintthresh,
-                                           median_impl)
-    else:
-        scores = scale_and_combine(diags, cell_mask, chanthresh,
-                                   subintthresh, median_impl)
-    new_weights = jnp.where(scores >= 1.0, 0.0, orig_weights)  # ref :300-305
-    return new_weights, scores
+    return diags
 
 
 def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,

@@ -129,7 +129,15 @@ def make_synthetic_archive(
         sorted(rng.choice(free_ch, size=n_ch, replace=False)) if n_ch else [],
         dtype=np.int64)
     for c in rfi_channels:
-        cube[:, c, :] += rfi_strength * noise_sigma * rng.normal(1.0, 0.2, (nsub, 1))
+        # persistent narrowband RFI: an elevated noise floor (folded
+        # non-stationary interference) riding a DC power jump.  The DC part
+        # alone would vanish under baseline subtraction (here and in the
+        # reference alike) — the variance bump is what the std/ptp
+        # diagnostics can actually see, so quality gates stay meaningful
+        cube[:, c, :] += (
+            rfi_strength * noise_sigma * rng.normal(1.0, 0.2, (nsub, 1))
+            + rng.normal(0.0, rfi_strength * noise_sigma / 4.0, (nsub, nbin))
+        )
 
     taken_sub = set(rfi_cells[:, 0]) if len(rfi_cells) else set()
     free_sub = [s for s in range(nsub) if s not in taken_sub]

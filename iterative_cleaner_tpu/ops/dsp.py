@@ -234,16 +234,11 @@ def prepare_cube(cube, freqs_mhz, dm, ref_freq_mhz, period_s, xp, *,
 # Scrunching / template construction
 # ---------------------------------------------------------------------------
 
-def weighted_template(cube, weights, xp):
-    """Weight-aware fscrunch+tscrunch to a single (nbin,) profile.
-
-    PSRCHIVE's fscrunch-then-tscrunch (reference :92-93) accumulates
-    weighted profile sums at both stages, which composes to a single global
-    weighted sum over (subint, channel); any normalisation only rescales the
-    template, and the fitted amplitude absorbs scale (reference :94 already
-    multiplies by 10000 arbitrarily).  We use the weighted mean for numeric
-    conditioning.
-    """
+def weighted_template_numerator(cube, weights, xp):
+    """The un-normalised weighted profile sum over all (subint, channel)
+    cells — the cube-sized half of :func:`weighted_template`.  Exposed so
+    the exact streaming mode can accumulate it per subint tile with the
+    same contraction (and precision) as the whole-archive path."""
     if xp is not np:
         import jax
 
@@ -255,9 +250,21 @@ def weighted_template(cube, weights, xp):
             weights[:, None, :], cube, (((2,), (1,)), ((0,), (0,))),
             precision=jax.lax.Precision.HIGHEST,
         )  # (nsub, 1, nbin)
-        num = xp.sum(per_sub, axis=0)[0]
-    else:
-        num = xp.einsum("sc,scb->b", weights, cube)
+        return xp.sum(per_sub, axis=0)[0]
+    return xp.einsum("sc,scb->b", weights, cube)
+
+
+def weighted_template(cube, weights, xp):
+    """Weight-aware fscrunch+tscrunch to a single (nbin,) profile.
+
+    PSRCHIVE's fscrunch-then-tscrunch (reference :92-93) accumulates
+    weighted profile sums at both stages, which composes to a single global
+    weighted sum over (subint, channel); any normalisation only rescales the
+    template, and the fitted amplitude absorbs scale (reference :94 already
+    multiplies by 10000 arbitrarily).  We use the weighted mean for numeric
+    conditioning.
+    """
+    num = weighted_template_numerator(cube, weights, xp)
     den = xp.sum(weights)
     safe = xp.where(den == 0, xp.ones_like(den), den)
     return xp.where(den == 0, xp.zeros_like(num), num / safe)

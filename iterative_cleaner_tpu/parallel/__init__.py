@@ -21,3 +21,6 @@ from iterative_cleaner_tpu.parallel.streaming import (  # noqa: F401
     StreamingCleaner,
     clean_streaming,
 )
+from iterative_cleaner_tpu.parallel.streaming_exact import (  # noqa: F401
+    clean_streaming_exact,
+)

@@ -1,0 +1,308 @@
+"""Drift-free ("exact") streaming: whole-archive semantics in subint tiles.
+
+The online mode (:mod:`iterative_cleaner_tpu.parallel.streaming`) cleans
+each tile independently, so its scaler medians see only the tile's subints
+and masks can drift ~0.01-0.02% from whole-archive cleaning.  This module
+removes the drift by restructuring the iteration instead of the data:
+
+- The template is a *global* weighted sum (reference :88-94): pass 1 sweeps
+  the tiles accumulating per-tile partial numerators
+  (:func:`~iterative_cleaner_tpu.ops.dsp.weighted_template_numerator`, the
+  same contraction the whole-archive path runs); the denominator and every
+  other scaler input live on the tiny (nsub, nchan) plane, never tiled.
+- The four diagnostics reduce only the bin axis (reference :206-217), so
+  they are cell-local: pass 2 evaluates them per tile
+  (:func:`~iterative_cleaner_tpu.engine.loop.diagnostics_given_template` /
+  :func:`~iterative_cleaner_tpu.stats.masked_numpy.cell_diagnostics_numpy`)
+  and concatenates.
+- The channel/subint scalers then run over the *full* (nsub, nchan)
+  diagnostic matrices — exactly the populations the reference's scalers see
+  (:229-256) — and convergence is cycle detection on the full weight
+  matrix, mirroring the whole-archive engines.
+
+Memory: prepared tiles live in HOST RAM; the device holds one tile at a
+time (the jax path pays one H2D per tile per pass — the price of exact
+semantics on observations larger than HBM).  Cost: two passes over the
+cube per iteration (template + diagnostics) instead of the online mode's
+single pass per tile.
+
+Exactness: every per-cell quantity is computed by the same code as the
+whole-archive path on identical inputs; the only re-grouped reduction is
+the template's cross-tile sum, which can differ from the one-shot reduction
+at the last-ulp level (numpy's einsum and XLA's reduce both use
+non-sequential accumulation), so scores can shift by ~1e-12 relative
+(float64) while the *masks* come out identical — asserted bit-equal across
+seeds, geometries and backends in tests/test_parallel.py.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from iterative_cleaner_tpu.archive import Archive
+from iterative_cleaner_tpu.backends.base import CleanResult, apply_bad_parts
+from iterative_cleaner_tpu.config import CleanConfig
+
+
+def _tile_slices(nsub: int, chunk: int) -> List[slice]:
+    return [slice(s, min(s + chunk, nsub)) for s in range(0, nsub, chunk)]
+
+
+def _run_iterations(orig_weights, config: CleanConfig, step) -> CleanResult:
+    """Host-side convergence driver shared by both backends' exact modes.
+
+    ``step(cur_weights) -> (new_weights, scores)`` is one full iteration
+    (both tile passes).  Control flow mirrors the whole-archive engines:
+    history seeded with the original weights (reference :78-79), cycle
+    detection against every earlier matrix (:135-141), per-loop telemetry
+    (:129-134), loops set on convergence or exhaustion (:139/:146).
+    """
+    history = [orig_weights.copy()]
+    cur = orig_weights
+    scores = np.zeros_like(orig_weights)
+    converged = False
+    loops = config.max_iter
+    loop_diffs, loop_rfi = [], []
+    for x in range(1, config.max_iter + 1):
+        new_w, scores = step(cur)
+        loop_diffs.append(int(np.sum(new_w != cur)))
+        loop_rfi.append(float(np.mean(new_w == 0)))
+        if any(np.array_equal(new_w, old) for old in history):
+            converged, loops, cur = True, x, new_w
+            history.append(new_w)
+            break
+        history.append(new_w)
+        cur = new_w
+    return CleanResult(
+        final_weights=cur, scores=scores, loops=loops, converged=converged,
+        loop_diffs=np.asarray(loop_diffs),
+        loop_rfi_frac=np.asarray(loop_rfi),
+        weight_history=np.stack(history) if config.record_history else None,
+    )
+
+
+def _clean_exact_numpy(cube, weights, freqs, dm, ref_freq, period, config,
+                       tiles, dedispersed):
+    from iterative_cleaner_tpu.ops.dsp import (
+        fit_template_amplitudes,
+        prepare_cube,
+        rotate_bins,
+        template_residuals,
+        weighted_template_numerator,
+    )
+    from iterative_cleaner_tpu.stats.masked_numpy import (
+        cell_diagnostics_numpy,
+        scale_and_combine_numpy,
+    )
+
+    cube = np.asarray(cube, dtype=np.float64)
+    orig_weights = np.asarray(weights, dtype=np.float64)
+    ded_tiles = []
+    shifts = None
+    for sl in tiles:
+        ded_t, shifts = prepare_cube(
+            cube[sl], freqs, dm, ref_freq, period, np,
+            baseline_duty=config.baseline_duty, rotation=config.rotation,
+            dedispersed=dedispersed,
+        )
+        ded_tiles.append(ded_t)
+    cell_mask = orig_weights == 0
+
+    def step(cur):
+        # pass 1: global template (cross-tile accumulation; regrouping the
+        # einsum reduction can move the template by an ulp — masks are
+        # unaffected, see module docstring)
+        num = np.zeros(cube.shape[-1], dtype=np.float64)
+        for sl, ded_t in zip(tiles, ded_tiles):
+            num += weighted_template_numerator(ded_t, cur[sl], np)
+        den = np.sum(cur)
+        template = (np.zeros_like(num) if den == 0 else num / den) * 10000.0
+
+        # pass 2: cell-local diagnostics per tile, scalers on the full plane
+        diag_tiles = []
+        for sl, ded_t in zip(tiles, ded_tiles):
+            amps = fit_template_amplitudes(ded_t, template, np)
+            resid = template_residuals(
+                ded_t, template, amps, config.pulse_slice,
+                config.pulse_scale, np, config.pulse_region_active,
+            )
+            resid = rotate_bins(resid, shifts, np, method=config.rotation)
+            weighted = resid * orig_weights[sl][:, :, None]
+            diag_tiles.append(
+                cell_diagnostics_numpy(weighted, cell_mask[sl]))
+        # the first three diagnostics are numpy.ma (masked semantics must
+        # survive the concat); the rFFT one is deliberately PLAIN (quirk 9)
+        # and must stay plain — np.ma.concatenate would promote it and flip
+        # robust_scale_lines onto the masked branch, changing zero-MAD
+        # lines from inf/nan to finite values (regression-tested against a
+        # majority-prezapped subint in tests/test_parallel.py)
+        diags = [np.ma.concatenate([t[i] for t in diag_tiles], axis=0)
+                 for i in range(3)]
+        diags.append(np.concatenate([np.asarray(t[3]) for t in diag_tiles],
+                                    axis=0))
+        scores = scale_and_combine_numpy(diags, config.chanthresh,
+                                         config.subintthresh)
+        return np.where(scores >= 1.0, 0.0, orig_weights), scores
+
+    return _run_iterations(orig_weights, config, step)
+
+
+def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool):
+    """Jitted per-tile programs for one static config (cached on the jit
+    side by shape/dtype)."""
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fft_mode,
+        resolve_median_impl,
+        resolve_stats_frame,
+        resolve_stats_impl,
+    )
+    from iterative_cleaner_tpu.engine.loop import (
+        diagnostics_given_template,
+        prepare_cube_jax,
+    )
+    from iterative_cleaner_tpu.ops.dsp import weighted_template_numerator
+    from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
+
+    dtype = jnp.dtype(config.dtype)
+    fft_mode = resolve_fft_mode(config.fft_mode, dtype)
+    median_impl = resolve_median_impl(config.median_impl, dtype)
+    stats_impl = resolve_stats_impl(config.stats_impl, dtype, nbin, fft_mode)
+    stats_frame = resolve_stats_frame(config.stats_frame, dtype)
+
+    @jax.jit
+    def prep(cube_t, freqs, dm, ref_freq, period):
+        return prepare_cube_jax(
+            cube_t, freqs, dm, ref_freq, period,
+            baseline_duty=config.baseline_duty, rotation=config.rotation,
+            dedispersed=dedispersed,
+        )
+
+    @jax.jit
+    def template_partial(ded_t, w_t):
+        return weighted_template_numerator(ded_t, w_t, jnp)
+
+    @jax.jit
+    def diag_tile(ded_t, template, w_orig_t, mask_t, shifts):
+        from iterative_cleaner_tpu.engine.loop import dispersed_residual_base
+
+        disp_base = None
+        if stats_frame != "dedispersed":
+            disp_base = dispersed_residual_base(
+                ded_t, shifts, pulse_slice=config.pulse_slice,
+                pulse_scale=config.pulse_scale,
+                pulse_active=config.pulse_region_active,
+                rotation=config.rotation,
+            )
+        return diagnostics_given_template(
+            ded_t, disp_base, template, w_orig_t, mask_t, shifts,
+            pulse_slice=config.pulse_slice, pulse_scale=config.pulse_scale,
+            pulse_active=config.pulse_region_active,
+            rotation=config.rotation, fft_mode=fft_mode,
+            stats_impl=stats_impl, stats_frame=stats_frame,
+        )
+
+    @jax.jit
+    def combine(diags, cell_mask, orig_weights):
+        scores = scale_and_combine(diags, cell_mask, config.chanthresh,
+                                   config.subintthresh, median_impl)
+        return jnp.where(scores >= 1.0, 0.0, orig_weights), scores
+
+    return prep, template_partial, diag_tile, combine
+
+
+def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
+                     tiles, dedispersed):
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(config.dtype)
+    chunk = tiles[0].stop - tiles[0].start
+    prep, template_partial, diag_tile, combine = _jax_tile_fns(
+        config, cube.shape[-1], bool(dedispersed))
+
+    freqs_d = jnp.asarray(freqs, dtype=dtype)
+    dm_d = jnp.asarray(dm, dtype=dtype)
+    ref_d = jnp.asarray(ref_freq, dtype=dtype)
+    per_d = jnp.asarray(period, dtype=dtype)
+
+    def pad_tile(a):
+        # zero-pad the final partial tile so every tile shares one compiled
+        # program; padded rows carry zero weight and are sliced off after
+        if a.shape[0] == chunk:
+            return a
+        pad = chunk - a.shape[0]
+        return np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+
+    orig_weights = np.asarray(weights, dtype=np.float64)
+    # prepared tiles spill to HOST RAM: the device only ever holds the tile
+    # being processed, so the exact mode stays usable on observations whose
+    # cube exceeds HBM (each pass below pays one H2D per tile)
+    ded_tiles = []
+    shifts = None
+    for sl in tiles:
+        ded_t, shifts = prep(
+            jnp.asarray(pad_tile(np.asarray(cube[sl]).astype(dtype))),
+            freqs_d, dm_d, ref_d, per_d)
+        ded_tiles.append(np.asarray(ded_t))
+
+    cell_mask_full = orig_weights == 0
+    w_host = [pad_tile(orig_weights[sl]).astype(dtype) for sl in tiles]
+    m_host = [pad_tile(cell_mask_full[sl]) for sl in tiles]
+    nsub = cube.shape[0]
+
+    def step(cur):
+        num = None
+        for sl, ded_t in zip(tiles, ded_tiles):
+            part = template_partial(jnp.asarray(ded_t),
+                                    jnp.asarray(pad_tile(cur[sl])
+                                                .astype(dtype)))
+            num = part if num is None else num + part
+        # the denominator's operand is the full (nsub, nchan) plane — never
+        # tiled — so it is the same device reduction the whole path runs
+        den = jnp.sum(jnp.asarray(cur.astype(dtype)))
+        template = jnp.where(den == 0, jnp.zeros_like(num),
+                             num / jnp.where(den == 0, 1.0, den)) * 10000.0
+
+        diag_tiles = [
+            diag_tile(jnp.asarray(ded_t), template, jnp.asarray(w_t),
+                      jnp.asarray(m_t), shifts)
+            for ded_t, w_t, m_t in zip(ded_tiles, w_host, m_host)]
+        diags = tuple(
+            jnp.concatenate([t[i] for t in diag_tiles], axis=0)[:nsub]
+            for i in range(4))
+        new_w_d, scores_d = combine(
+            diags, jnp.asarray(cell_mask_full),
+            jnp.asarray(orig_weights.astype(dtype)))
+        return (np.asarray(new_w_d, dtype=np.float64),
+                np.asarray(scores_d))
+
+    return _run_iterations(orig_weights, config, step)
+
+
+def clean_streaming_exact(archive: Archive, chunk_nsub: int,
+                          config: CleanConfig) -> CleanResult:
+    """Clean in subint tiles with whole-archive semantics (VERDICT r2 #4).
+
+    Masks are drift-free against whole-archive cleaning — asserted
+    bit-equal for both backends in tests/test_parallel.py (scores may move
+    at the last ulp; see module docstring).
+    """
+    if config.unload_res:
+        raise ValueError(
+            "unload_res is not supported in exact streaming mode (the "
+            "residual cube is never materialised whole); use mode='online' "
+            "or whole-archive cleaning")
+    if chunk_nsub <= 0:
+        raise ValueError(f"chunk_nsub must be positive, got {chunk_nsub}")
+    cube = archive.total_intensity()
+    tiles = _tile_slices(cube.shape[0], int(chunk_nsub))
+    fn = _clean_exact_numpy if config.backend == "numpy" else _clean_exact_jax
+    result = fn(cube, archive.weights, archive.freqs_mhz, archive.dm,
+                archive.centre_freq_mhz, archive.period_s, config, tiles,
+                archive.dedispersed)
+    return apply_bad_parts(result, config)

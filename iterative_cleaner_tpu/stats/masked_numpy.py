@@ -41,12 +41,15 @@ def robust_scale_lines(diag, axis):
         return centred / mad
 
 
-def surgical_scores_numpy(resid_weighted, cell_mask, chanthresh, subintthresh):
-    """Zap scores for every (subint, channel) cell; score >= 1 means zap.
+def cell_diagnostics_numpy(resid_weighted, cell_mask):
+    """The four per-cell diagnostics (reference :206-217) as a list of
+    (nsub, nchan) arrays — three ``numpy.ma`` masked, the rFFT one plain
+    (its mask is dropped by ``np.fft.rfft``, quirk 9).
 
-    Inputs: the weighted residual cube (already multiplied by the original
-    weights, reference :112) and the boolean cell mask (original weight == 0,
-    reference :115-117).  Implements reference :202-226.
+    Every diagnostic reduces only the bin axis, so it is cell-local: tiles
+    of subints can be computed independently and ``np.ma.concatenate``-d —
+    the property the drift-free streaming mode
+    (:mod:`iterative_cleaner_tpu.parallel.streaming_exact`) builds on.
     """
     mask3 = np.broadcast_to(cell_mask[:, :, None], resid_weighted.shape)
     cube = np.ma.masked_array(resid_weighted, mask=mask3)
@@ -59,7 +62,12 @@ def surgical_scores_numpy(resid_weighted, cell_mask, chanthresh, subintthresh):
     centred = cube - np.expand_dims(cube.mean(axis=2), axis=2)
     # np.fft.rfft operates on .data and returns a plain ndarray (quirk 9).
     diagnostics.append(np.max(np.abs(np.fft.rfft(centred, axis=2)), axis=2))
+    return diagnostics
 
+
+def scale_and_combine_numpy(diagnostics, chanthresh, subintthresh):
+    """Channel/subint scaling + 4-way median (reference :220-226) over
+    precomputed diagnostics."""
     per_diag = []
     for diag in diagnostics:
         chan_side = np.abs(robust_scale_lines(diag, axis=0)) / chanthresh
@@ -67,3 +75,16 @@ def surgical_scores_numpy(resid_weighted, cell_mask, chanthresh, subintthresh):
         # Stacking through np.max drops masks; raw .data flows on (quirk 6).
         per_diag.append(np.max((chan_side, subint_side), axis=0))
     return np.median(per_diag, axis=0)
+
+
+def surgical_scores_numpy(resid_weighted, cell_mask, chanthresh, subintthresh):
+    """Zap scores for every (subint, channel) cell; score >= 1 means zap.
+
+    Inputs: the weighted residual cube (already multiplied by the original
+    weights, reference :112) and the boolean cell mask (original weight == 0,
+    reference :115-117).  Implements reference :202-226.
+    """
+    return scale_and_combine_numpy(
+        cell_diagnostics_numpy(resid_weighted, cell_mask),
+        chanthresh, subintthresh,
+    )

@@ -1,0 +1,62 @@
+"""Cleaning-quality metrics against synthetic ground truth.
+
+The reference's cleaning quality was established externally (the author's
+thesis and the coast_guard paper — SURVEY.md §4); the framework carries its
+own regression gate instead: :mod:`iterative_cleaner_tpu.io.synthetic`
+knows exactly which cells carry injected RFI, so every cleaning run can be
+scored for zap precision and per-morphology recall.  Used by
+tests/test_quality.py (asserted floors) and bench.py (reported metrics).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def zap_quality(final_weights: np.ndarray, truth) -> dict:
+    """Precision/recall of a cleaned weight matrix against injected truth.
+
+    ``truth`` is the :class:`~iterative_cleaner_tpu.io.synthetic.SyntheticTruth`
+    accompanying the archive.  Cells prezapped on input are excluded from
+    both sides: the cleaner never un-zaps them (reference :300-305 only
+    zeroes weights), so counting them would inflate every metric.
+
+    Returns a dict with:
+
+    - ``precision``: of the cells the cleaner zapped, the fraction that
+      carry injected RFI (any morphology).
+    - ``recall_cell`` / ``recall_channel`` / ``recall_subint``: the zapped
+      fraction of the impulsive (isub, ichan) cells / of all cells in the
+      persistent-RFI channels / of all cells in the broadband-RFI subints.
+      ``None`` when the archive has no injections of that morphology.
+    - ``false_zap_frac``: zapped clean cells as a fraction of all clean
+      cells (the operator-facing "how much good data did I lose").
+    """
+    zapped = np.asarray(final_weights) == 0
+    nsub, nchan = zapped.shape
+    live = ~np.asarray(truth.prezapped, dtype=bool)
+    rfi = truth.expected_zap(nsub, nchan) & live
+    zapped = zapped & live
+
+    def _frac(num_mask, den_mask):
+        den = int(den_mask.sum())
+        return None if den == 0 else float((num_mask & den_mask).sum() / den)
+
+    cell_mask = np.zeros((nsub, nchan), dtype=bool)
+    if len(truth.rfi_cells):
+        cell_mask[truth.rfi_cells[:, 0], truth.rfi_cells[:, 1]] = True
+    chan_mask = np.zeros((nsub, nchan), dtype=bool)
+    chan_mask[:, np.asarray(truth.rfi_channels, dtype=int)] = True
+    sub_mask = np.zeros((nsub, nchan), dtype=bool)
+    sub_mask[np.asarray(truth.rfi_subints, dtype=int), :] = True
+
+    n_zapped = int(zapped.sum())
+    clean = live & ~rfi
+    return {
+        "precision": None if n_zapped == 0
+        else float((zapped & rfi).sum() / n_zapped),
+        "recall_cell": _frac(zapped, cell_mask & live),
+        "recall_channel": _frac(zapped, chan_mask & live),
+        "recall_subint": _frac(zapped, sub_mask & live),
+        "false_zap_frac": _frac(zapped, clean),
+    }

@@ -263,10 +263,10 @@ def test_stream_flag_matches_library_streaming(tmp_path, monkeypatch):
     ar, _ = make_synthetic_archive(nsub=24, nchan=16, nbin=32, seed=6)
     p = str(tmp_path / "long.npz")
     save_archive(ar, p)
-    main(["-q", "--stream", "8", "--rotation", "roll", "--fft_mode", "dft",
-          p])
+    main(["-q", "--stream", "8", "--stream_mode", "online", "--rotation",
+          "roll", "--fft_mode", "dft", p])
     want = clean_streaming(
-        ar, 8, CleanConfig(rotation="roll", fft_mode="dft"))
+        ar, 8, CleanConfig(rotation="roll", fft_mode="dft"), mode="online")
     got = load_archive(p + "_cleaned.npz")
     np.testing.assert_array_equal(got.weights == 0,
                                   want.final_weights == 0)
@@ -281,13 +281,33 @@ def test_stream_with_cell_mesh(tmp_path, monkeypatch):
     ar, _ = make_synthetic_archive(nsub=32, nchan=16, nbin=32, seed=7)
     p = str(tmp_path / "long2.npz")
     save_archive(ar, p)
-    main(["-q", "--stream", "8", "--rotation", "roll", "--fft_mode", "dft",
-          p])
+    main(["-q", "--stream", "8", "--stream_mode", "online", "--rotation",
+          "roll", "--fft_mode", "dft", p])
     plain = load_archive(p + "_cleaned.npz").weights
-    main(["-q", "--stream", "8", "--mesh", "cell", "--rotation", "roll",
-          "--fft_mode", "dft", "-o", str(tmp_path / "meshed.npz"), p])
+    main(["-q", "--stream", "8", "--stream_mode", "online", "--mesh", "cell",
+          "--rotation", "roll", "--fft_mode", "dft",
+          "-o", str(tmp_path / "meshed.npz"), p])
     np.testing.assert_array_equal(
         load_archive(str(tmp_path / "meshed.npz")).weights, plain)
+
+
+def test_stream_exact_default_matches_whole(tmp_path, monkeypatch):
+    """--stream's default mode is drift-free: masks identical to the
+    whole-archive run; --mesh cell without --stream_mode online errors."""
+    monkeypatch.chdir(tmp_path)
+    from iterative_cleaner_tpu.io import make_synthetic_archive, save_archive
+
+    ar, _ = make_synthetic_archive(nsub=32, nchan=16, nbin=32, seed=7)
+    p = str(tmp_path / "long3.npz")
+    save_archive(ar, p)
+    main(["-q", "--backend", "numpy", p])
+    whole = load_archive(p + "_cleaned.npz").weights
+    main(["-q", "--backend", "numpy", "--stream", "8",
+          "-o", str(tmp_path / "exact.npz"), p])
+    np.testing.assert_array_equal(
+        load_archive(str(tmp_path / "exact.npz")).weights, whole)
+    with pytest.raises(SystemExit):
+        main(["-q", "--stream", "8", "--mesh", "cell", p])
 
 
 def test_stream_incompatible_flags(tmp_path):

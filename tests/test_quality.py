@@ -1,0 +1,82 @@
+"""Cleaning-quality regression gate (VERDICT r2 #6).
+
+The parity suite proves the framework matches the reference; these tests
+prove the cleaning is *good*: zap precision and per-morphology recall
+against the synthetic generator's injected truth
+(iterative_cleaner_tpu/utils/quality.py), asserted as floors for both
+models on both backends.  The reference relied on external thesis
+validation for this (SURVEY.md §4); the framework gates it in CI.
+
+Floors are set from measured behaviour (2026-07-30): at the default
+40-sigma injections every model/backend scores 1.0 across the board; at
+5-sigma the detector starts missing borderline cells (worst measured
+recall ~0.82).  The floors leave slack so the gate catches detector
+regressions, not noise.
+"""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.backends import clean_archive
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+from iterative_cleaner_tpu.models.quicklook import clean_archive_quicklook
+from iterative_cleaner_tpu.utils.quality import zap_quality
+
+MODELS = {
+    "surgical_scrub": clean_archive,
+    "quicklook": clean_archive_quicklook,
+}
+
+
+def _quality(model, backend, seed, **gen_kw):
+    ar, truth = make_synthetic_archive(
+        nsub=32, nchan=64, nbin=128, seed=seed, n_rfi_cells=20,
+        n_rfi_channels=3, n_rfi_subints=2, n_prezapped=30, **gen_kw)
+    cfg = CleanConfig(backend=backend,
+                      **({"dtype": "float64"} if backend == "jax" else {}))
+    res = MODELS[model](ar.clone(), cfg)
+    return zap_quality(res.final_weights, truth)
+
+
+@pytest.mark.parametrize("model", sorted(MODELS))
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_quality_floors_strong_rfi(model, backend):
+    """Default-strength injections: every morphology essentially fully
+    zapped, nothing clean lost."""
+    for seed in (0, 1):
+        q = _quality(model, backend, seed)
+        assert q["precision"] >= 0.95, q
+        assert q["recall_cell"] >= 0.95, q
+        assert q["recall_channel"] >= 0.95, q
+        assert q["recall_subint"] >= 0.95, q
+        assert q["false_zap_frac"] <= 0.01, q
+
+
+def test_quality_floors_borderline_rfi_surgical():
+    """5-sigma injections sit at the detection edge: the gate demands the
+    flagship iterative model still catches a solid majority without false
+    zaps.  quicklook is deliberately excluded here — its single template-
+    free pass leaves the pulse inflating the scaler populations, so
+    borderline RFI is out of its design envelope (measured recall collapses
+    below ~8 sigma; that triage tradeoff is documented in models/quicklook)
+    — its gate is the strong-RFI test above."""
+    for seed in (0, 1):
+        q = _quality("surgical_scrub", "numpy", seed, rfi_strength=5.0)
+        assert q["precision"] >= 0.9, q
+        assert q["recall_cell"] >= 0.6, q
+        assert q["recall_channel"] >= 0.6, q
+        assert q["recall_subint"] >= 0.6, q
+        assert q["false_zap_frac"] <= 0.02, q
+
+
+def test_quality_excludes_prezapped_cells():
+    """Prezapped cells stay out of both sides of every metric: an archive
+    whose only 'zaps' are the prezaps scores no precision hit."""
+    ar, truth = make_synthetic_archive(nsub=8, nchan=8, nbin=32, seed=3,
+                                       n_rfi_cells=0, n_rfi_channels=0,
+                                       n_rfi_subints=0, n_prezapped=10)
+    q = zap_quality(ar.weights, truth)  # uncleaned: only prezaps are zero
+    assert q["precision"] is None       # no live cells zapped at all
+    assert q["recall_cell"] is None and q["recall_channel"] is None
+    assert q["false_zap_frac"] == 0.0
