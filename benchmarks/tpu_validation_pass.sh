@@ -8,8 +8,26 @@ set -ex
 cd "$(dirname "$0")/.."
 STAMP=$(date +%Y-%m-%d_%H%M)
 
-# 1. Headline bench (now includes the 4-launch batched scaler medians —
-#    expect <= the recorded 34.3 ms/iteration).
+# 0. (round 3) Mosaic-lowering validation of the fused scaler kernel
+#    (scaled_sides_pallas: median+MAD+epilogue in one launch; interpret
+#    tests prove bit-parity but not lowering legality) at the full-size
+#    scaler shapes.  Must print OK for both orientations.
+python - <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+from iterative_cleaner_tpu.stats.pallas_kernels import scaled_sides_pallas
+rng = np.random.default_rng(0)
+nsub, nchan = 1024, 4096
+diags = tuple(jnp.asarray(rng.normal(size=(nsub, nchan)).astype(np.float32))
+              for _ in range(4))
+mask = jnp.asarray(rng.random((nsub, nchan)) < 0.1)
+for axis in (0, 1):
+    out = jax.jit(lambda d, m, ax=axis: scaled_sides_pallas(d, m, ax, 5.0))(diags, mask)
+    jax.block_until_ready(out); print(f"scaled_sides axis={axis}: OK")
+EOF
+
+# 1. Headline bench (round 3: ONE fused scaler launch per orientation +
+#    34-pass adjacent-rank selection — expect well under the recorded
+#    34.3 ms/iteration; also emits the zap-quality scorecard).
 python bench.py >  "benchmarks/measured/bench_tpu_${STAMP}.json" \
                2> "benchmarks/measured/bench_tpu_${STAMP}.stderr.txt"
 
@@ -38,8 +56,14 @@ EOF
 #    wins, drop the forced-sort gate in parallel/batch.py + cli.py.
 PYTHONPATH=. python benchmarks/batch_pallas_probe.py || true
 
-# 5. (experiment) Fused-kernel sublane tier: _S_BLK=8 is the floor; at
-#    nbin<=256 VMEM has room for 16/32-row cell blocks -> bigger MXU
-#    matmuls in the DFT stage. Edit stats/pallas_kernels.py:_S_BLK, rerun
-#    step 3's first profile line, keep whichever "cell diagnostics
-#    (fused pallas)" row is faster (revert on VMEM compile failures).
+# 5. (experiment) Fused-diagnostics block-tier sweep — no source edits
+#    needed: ICLEAN_FUSED_SBLK multiplies the sublane block,
+#    ICLEAN_FUSED_CBLK_SCALE the channel tier (both padded-correct; only
+#    compile legality + throughput change).  Keep the fastest
+#    "cell diagnostics (fused pallas)" rows; VMEM overflows surface as
+#    remote_compile HTTP 500 -> that combination is illegal, move on.
+for SBLK in 8 16 32; do for CSCALE in 1 2; do
+  echo "=== SBLK=$SBLK CSCALE=$CSCALE ==="
+  ICLEAN_FUSED_SBLK=$SBLK ICLEAN_FUSED_CBLK_SCALE=$CSCALE \
+    python benchmarks/profile_stages.py || true
+done; done > "benchmarks/measured/tier_sweep_${STAMP}.txt" 2>&1

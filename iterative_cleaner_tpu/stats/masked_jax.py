@@ -67,8 +67,10 @@ def masked_median(values, mask, axis, impl="sort"):
 def _masked_side(centred, mad, mask, n, thresh):
     """Shared masked-path epilogue (rules 1-4): zero-MAD/empty lines go
     dead (centred data passes through undivided), live entries are
-    ``|centred/mad| / thresh``.  Single source of truth for both the
-    per-diagnostic route and the batched pallas route."""
+    ``|centred/mad| / thresh``.  Single source of truth for the
+    per-diagnostic route AND the fused scaler kernel, which traces this
+    same function inside the Pallas launch
+    (pallas_kernels._scaled_sides_kernel)."""
     line_dead = (mad == 0) | (n == 0)
     safe_mad = jnp.where(line_dead, jnp.ones_like(mad), mad)
     dead = mask | line_dead
@@ -178,44 +180,18 @@ def cell_diagnostics_jax(resid_weighted, cell_mask, fft_mode="fft"):
     return d_std, d_mean, d_ptp, d_fft
 
 
-def _scaled_sides_batched_pallas(diagnostics, cell_mask, axis, thresh):
-    """One orientation of all four scalers in TWO Pallas launches.
+def _scaled_sides_fused_pallas(diagnostics, cell_mask, axis, thresh):
+    """One orientation of all four scalers in ONE Pallas launch
+    (:func:`iterative_cleaner_tpu.stats.pallas_kernels.scaled_sides_pallas`):
+    median, centring, MAD and epilogue fused in VMEM.  The kernel
+    replicates the `_masked_side`/`_patch_nan_lines` op sequences exactly,
+    so it stays bit-identical to the unfused route (locked in by
+    tests/test_pallas_stats.py)."""
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        scaled_sides_pallas,
+    )
 
-    The radix-bisection kernel is line-local, so the four (nsub, nchan)
-    diagnostics concatenate along the *lines* axis into one launch for the
-    medians and one for the MADs (instead of 2 launches x 4 diagnostics).
-    Per-line math is untouched — bit-identical to the unbatched route —
-    and the 4x-wider lane dimension feeds the kernel better at small
-    nchan.  The rFFT diagnostic rides along with an all-false mask (the
-    kernel equals the plain median then) plus the same NaN patch
-    :func:`_plain_median` applies.
-    """
-    d_std, d_mean, d_ptp, d_fft = diagnostics
-    m = cell_mask
-    cat_axis = 1 - axis  # lines run along the non-reduced axis
-    no_mask = jnp.zeros_like(m)
-
-    def batch(vals4, mask4):
-        cat_v = jnp.concatenate(vals4, axis=cat_axis)
-        cat_m = jnp.concatenate(mask4, axis=cat_axis)
-        out = masked_median(cat_v, cat_m, axis, impl="pallas")
-        return jnp.split(out, 4, axis=cat_axis)
-
-    meds = batch((d_std, d_mean, d_ptp, d_fft), (m, m, m, no_mask))
-    # epilogues are the shared helpers of the unbatched routes
-    # (_masked_side / _patch_nan_lines), so the two paths cannot drift
-    centred = [jnp.where(m, d, d - med)
-               for d, med in zip((d_std, d_mean, d_ptp), meds[:3])]
-    centred_fft = d_fft - _patch_nan_lines(meds[3], d_fft, axis)
-    mads = batch(tuple(jnp.abs(c) for c in centred) + (jnp.abs(centred_fft),),
-                 (m, m, m, no_mask))
-
-    n = jnp.sum(~m, axis=axis, keepdims=True)
-    sides = [_masked_side(c, mad, m, n, thresh)
-             for c, mad in zip(centred, mads[:3])]
-    mad_fft = _patch_nan_lines(mads[3], jnp.abs(centred_fft), axis)
-    sides.append(jnp.abs(centred_fft / mad_fft) / thresh)
-    return sides
+    return list(scaled_sides_pallas(diagnostics, cell_mask, axis, thresh))
 
 
 def scale_and_combine(diagnostics, cell_mask, chanthresh, subintthresh,
@@ -226,9 +202,9 @@ def scale_and_combine(diagnostics, cell_mask, chanthresh, subintthresh,
     d_std, d_mean, d_ptp, d_fft = diagnostics
     m = cell_mask
     if median_impl == "pallas" and d_fft.dtype == jnp.float32:
-        chan = _scaled_sides_batched_pallas(diagnostics, m, 0, chanthresh)
-        subint = _scaled_sides_batched_pallas(diagnostics, m, 1,
-                                              subintthresh)
+        chan = _scaled_sides_fused_pallas(diagnostics, m, 0, chanthresh)
+        subint = _scaled_sides_fused_pallas(diagnostics, m, 1,
+                                            subintthresh)
         per_diag = [jnp.maximum(c, s) for c, s in zip(chan, subint)]
         return jnp.median(jnp.stack(per_diag), axis=0)
     per_diag = []

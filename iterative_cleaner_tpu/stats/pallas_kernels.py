@@ -82,16 +82,145 @@ def _select_kth(keys, k):
     return lo
 
 
-def _median_kernel(v_ref, m_ref, out_ref):
-    mask = m_ref[:]
-    keys = jnp.where(mask, _KEY_MASKED, _ordered_key(v_ref[:]))
+def _select_adjacent(keys, k_lo, k_hi):
+    """The ``k_lo``-th and ``k_hi``-th smallest keys where ``k_hi`` is
+    ``k_lo`` or ``k_lo + 1`` (the median's two middle ranks).
+
+    One 32-step bisection finds the ``k_lo``-th key; the successor rank
+    then needs only two more passes: if more than ``k_hi`` keys are <= the
+    found key, rank ``k_hi`` is the same key (duplicates straddle the
+    middle), otherwise it is the smallest key strictly greater.  ~34 passes
+    over the tile instead of the 64 two independent bisections cost — the
+    dominant VPU work of every median/MAD launch."""
+    lo_key = _select_kth(keys, k_lo)
+    cnt_le = jnp.sum((keys <= lo_key[None, :]).astype(jnp.int32), axis=0,
+                     dtype=jnp.int32)
+    above = jnp.where(keys > lo_key[None, :], keys, _INT32_MAX)
+    succ = jnp.min(above, axis=0)
+    hi_key = jnp.where(cnt_le > k_hi, lo_key, succ)
+    return lo_key, hi_key
+
+
+def _masked_median_lanes(values, mask):
+    """Median of the unmasked entries down the sublane axis of one tile:
+    the shared core of the standalone median kernel and the fused scaler
+    kernel.  Returns the (t,) medians (0.0 where a line is fully masked)."""
+    keys = jnp.where(mask, _KEY_MASKED, _ordered_key(values))
     n_valid = jnp.sum((~mask).astype(jnp.int32), axis=0, dtype=jnp.int32)
     k_lo = jnp.maximum(n_valid - 1, 0) // 2
     k_hi = n_valid // 2
-    f_lo = _key_to_float(_select_kth(keys, k_lo))
-    f_hi = _key_to_float(_select_kth(keys, k_hi))
-    med = np.float32(0.5) * (f_lo + f_hi)
-    out_ref[0, :] = jnp.where(n_valid == 0, np.float32(0.0), med)
+    lo_key, hi_key = _select_adjacent(keys, k_lo, k_hi)
+    med = np.float32(0.5) * (_key_to_float(lo_key) + _key_to_float(hi_key))
+    return jnp.where(n_valid == 0, np.float32(0.0), med), n_valid
+
+
+def _median_kernel(v_ref, m_ref, out_ref):
+    med, _ = _masked_median_lanes(v_ref[:], m_ref[:])
+    out_ref[0, :] = med
+
+
+def _scaled_sides_kernel(d0_ref, d1_ref, d2_ref, d3_ref, m_ref,
+                         o0_ref, o1_ref, o2_ref, o3_ref, *, thresh):
+    """One orientation of the whole scaler stage for all four diagnostics:
+    median -> centring -> MAD -> epilogue, entirely in VMEM.
+
+    The epilogues are the *shared* helpers of the XLA route
+    (:func:`masked_jax._masked_side` rules 1-4 for the three masked
+    diagnostics; :func:`masked_jax._patch_nan_lines` + the plain IEEE
+    inf/nan flow for the rFFT one — they are pure jnp ops and trace fine
+    inside the kernel), so the outputs are bit-identical to the unfused
+    kernel+XLA route by construction, while collapsing two median launches
+    plus the XLA elementwise middle into a single pass over the tile."""
+    from iterative_cleaner_tpu.stats.masked_jax import (
+        _masked_side,
+        _patch_nan_lines,
+    )
+
+    mask = m_ref[0]
+    t = np.float32(thresh)
+    for d_ref, o_ref in ((d0_ref, o0_ref), (d1_ref, o1_ref),
+                         (d2_ref, o2_ref)):
+        d = d_ref[0]
+        med, n_valid = _masked_median_lanes(d, mask)
+        centred = jnp.where(mask, d, d - med[None, :])
+        mad, _ = _masked_median_lanes(jnp.abs(centred), mask)
+        o_ref[0] = _masked_side(centred, mad[None, :], mask,
+                                n_valid[None, :], t)
+    # the rFFT diagnostic: plain path (quirk 5) — no mask, NaN-bearing
+    # lines median to NaN (matching jnp.median propagation), zero MAD
+    # yields IEEE inf/nan that flow onward
+    d = d3_ref[0]
+    no_mask = jnp.zeros_like(mask)
+    med, _ = _masked_median_lanes(d, no_mask)
+    centred = d - _patch_nan_lines(med[None, :], d, 0)
+    absc = jnp.abs(centred)
+    mad, _ = _masked_median_lanes(absc, no_mask)
+    o3_ref[0] = jnp.abs(centred / _patch_nan_lines(mad[None, :], absc, 0)) / t
+
+
+def _scaler_tile_lines(n: int) -> int:
+    """Lane-tile width for the fused scaler launch.  VMEM per grid step is
+    ~12 full-height (n, T) float32 arrays (5 in + 4 out blocks + bisection
+    temporaries), so T shrinks as the reduction axis grows — at n=4096
+    (the full-size subint scaler) T=32 keeps the step ~7 MB."""
+    if n <= 1024:
+        return _TILE_LINES
+    if n <= 2048:
+        return 64
+    return 32
+
+
+@functools.partial(jax.jit, static_argnames=("thresh", "interpret"))
+def _scaled_sides_axis0(d0, d1, d2, d3, mask, thresh, interpret):
+    n, m = d0.shape
+    tile = _scaler_tile_lines(n)
+    pad = (-m) % tile
+    if pad:
+        d0, d1, d2, d3 = (jnp.pad(d, ((0, 0), (0, pad)))
+                          for d in (d0, d1, d2, d3))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)), constant_values=True)
+    mp = m + pad
+    grid = mp // tile
+
+    def chunked(x):
+        # (n, mp) -> (mp/T, n, T): blocks (1, n, T) keep the last dim equal
+        # to the full (reshaped) array dim, satisfying Mosaic's lane-tiling
+        # rule for T < 128 (same trick as _FusedScaffold.to_cellrows)
+        return x.reshape(n, grid, tile).swapaxes(0, 1)
+
+    spec = pl.BlockSpec((1, n, tile), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        functools.partial(_scaled_sides_kernel, thresh=thresh),
+        out_shape=[jax.ShapeDtypeStruct((grid, n, tile), jnp.float32)] * 4,
+        grid=(grid,),
+        in_specs=[spec] * 5,
+        out_specs=[spec] * 4,
+        interpret=interpret,
+    )(*(chunked(d) for d in (d0, d1, d2, d3)), chunked(mask))
+    return tuple(o.swapaxes(0, 1).reshape(n, mp)[:, :m] for o in outs)
+
+
+def scaled_sides_pallas(diagnostics, cell_mask, axis, thresh):
+    """All four scaled sides of one orientation in ONE launch (float32).
+
+    ``axis=0`` scales every channel's line down the subint axis (the
+    channel scaler); ``axis=1`` the transpose.  Bit-identical to routing
+    each diagnostic through :func:`masked_median_pallas` + the XLA
+    epilogues (locked in by tests/test_pallas_stats.py)."""
+    if diagnostics[0].dtype != jnp.float32:
+        raise TypeError("scaled_sides_pallas requires float32, got %s"
+                        % diagnostics[0].dtype)
+    interpret = jax.devices()[0].platform != "tpu"
+    thresh = float(thresh)
+    if axis == 0:
+        return _scaled_sides_axis0(*diagnostics, cell_mask, thresh,
+                                   interpret)
+    if axis == 1:
+        outs = _scaled_sides_axis0(*(d.T for d in diagnostics), cell_mask.T,
+                                   thresh, interpret)
+        return tuple(o.T for o in outs)
+    raise ValueError("axis must be 0 or 1 for 2-D diagnostics")
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -133,7 +262,15 @@ def _median_axis0(values, mask, interpret):
 # spectra materialisations); the rFFT magnitudes ride the MXU against
 # cos/sin bases and their max never leaves VMEM.
 
-_S_BLK = 8      # subints per block (sublane-friendly)
+# Subints per fused-kernel block (sublane-friendly).  Overridable for the
+# hardware tier sweep (benchmarks/tpu_validation_pass.sh step 5): larger
+# blocks mean more rows per DFT matmul — better MXU utilisation at long
+# nbin where the C_BLK tiers shrink — until the VMEM budget trips the
+# Mosaic compile.  Only the default has been hardware-validated.
+import os as _os
+
+_S_BLK = int(_os.environ.get("ICLEAN_FUSED_SBLK", "8"))
+_C_BLK_SCALE = int(_os.environ.get("ICLEAN_FUSED_CBLK_SCALE", "1"))
 
 
 def _cell_blocks(nbin: int):
@@ -162,14 +299,19 @@ def _cell_blocks(nbin: int):
     axis, and C_BLK sits second-to-last where a multiple of 8 suffices.
     """
     if nbin <= 256:
-        return _S_BLK, 128
-    if nbin <= 512:
-        return _S_BLK, 64
-    if nbin <= 1024:
-        return _S_BLK, 32
-    if nbin <= 2048:
-        return _S_BLK, 16
-    return _S_BLK, 8
+        c = 128
+    elif nbin <= 512:
+        c = 64
+    elif nbin <= 1024:
+        c = 32
+    elif nbin <= 2048:
+        c = 16
+    else:
+        c = 8
+    # the sweep knob multiplies the tier (capped at one lane tile); padding
+    # keeps correctness for any block shape, so the sweep is purely a
+    # compile-legality + throughput question
+    return _S_BLK, min(128, c * max(1, _C_BLK_SCALE))
 
 
 def _k_chunk(nbin: int, nk_pad: int) -> int:

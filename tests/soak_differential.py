@@ -12,9 +12,14 @@ Phase 2: 200 hostile-value draws (subnormals, +-inf, NaN, heavy ties,
 Pallas radix-bisection median — must stay bit-identical to the sort path
 on every one (the total-order claim of stats/pallas_kernels.py).
 
-    python tests/soak_differential.py          # ~13 min on one CPU
+Phase 3: 100 hostile-diagnostic draws against the fused scaler kernel
+(scale_and_combine median_impl='pallas' vs 'sort'): inf/NaN injections,
+zero-MAD lines, dead channels/subints — bit-identical scores required.
 
-Last full run 2026-07-30: phase 1 300/300 clean, phase 2 200/200 clean.
+    python tests/soak_differential.py          # ~18 min on one CPU
+
+Last full run 2026-07-30: phase 1 300/300 clean, phase 2 200/200 clean;
+phase 3 added round 3 (60-draw spot run clean; full run pending).
 """
 import os, sys, time
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
@@ -132,4 +137,40 @@ for t in range(200):
         jax.clear_caches()
 print(f"PHASE 2 DONE: {kfail} mismatches of 200 in {time.time()-t1:.0f}s",
       flush=True)
-print(f"SOAK DONE: {fail + kfail} total failures", flush=True)
+
+# ---- phase 3: fused scaler kernel hostile fuzz ---------------------------
+from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine  # noqa: E402
+
+t2 = time.time()
+sfail = 0
+rng = np.random.default_rng(3)
+for t in range(100):
+    n = int(rng.integers(2, 40)); m = int(rng.integers(2, 40))
+    diags = []
+    for i in range(4):
+        v = rng.normal(size=(n, m)).astype(np.float32)
+        if t % 3 == 1:  # IEEE specials reach the plain rFFT path
+            v[rng.random((n, m)) < 0.08] = np.inf
+            v[rng.random((n, m)) < 0.04] = np.nan
+        if t % 4 == 2:  # zero-MAD (constant) lines
+            v[:, rng.integers(0, m)] = 1.5
+            v[rng.integers(0, n), :] = -0.5
+        diags.append(v)
+    mask = rng.random((n, m)) < rng.uniform(0, 0.6)
+    if rng.random() < 0.3:
+        mask[:, rng.integers(0, m)] = True
+    if rng.random() < 0.3:
+        mask[rng.integers(0, n), :] = True
+    ct, st = float(rng.uniform(2, 8)), float(rng.uniform(2, 8))
+    a = np.asarray(jax.jit(lambda d, mm: scale_and_combine(
+        tuple(d), mm, ct, st, "sort"))(diags, mask))
+    b = np.asarray(jax.jit(lambda d, mm: scale_and_combine(
+        tuple(d), mm, ct, st, "pallas"))(diags, mask))
+    if not np.array_equal(a, b, equal_nan=True):
+        sfail += 1
+        print(f"PHASE 3 trial {t} MISMATCH", flush=True)
+    if t % 25 == 24:
+        jax.clear_caches()
+print(f"PHASE 3 DONE: {sfail} mismatches of 100 in {time.time()-t2:.0f}s",
+      flush=True)
+print(f"SOAK DONE: {fail + kfail + sfail} total failures", flush=True)

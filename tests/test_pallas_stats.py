@@ -117,12 +117,35 @@ def test_full_clean_parity_sort_vs_pallas():
     assert res["sort"].loops == res["pallas"].loops
 
 
+def test_scaled_sides_multi_tile_and_tier():
+    """The fused scaler's grid path beyond one lane tile, and the shrunken
+    lane tier for long reduction axes: (1030, 260) forces tile index >= 1
+    AND the T=64 tier's chunked reshape — bit-parity with the sort route
+    must hold through both."""
+    import jax
+
+    from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
+
+    rng = np.random.default_rng(11)
+    nsub, nchan = 1030, 260
+    diags = tuple(rng.normal(size=(nsub, nchan)).astype(np.float32)
+                  for _ in range(4))
+    mask = rng.random((nsub, nchan)) < 0.15
+    mask[:, 7] = True            # dead channel
+    a = np.asarray(jax.jit(lambda d, m: scale_and_combine(
+        d, m, 5.0, 5.0, "sort"))(diags, mask))
+    b = np.asarray(jax.jit(lambda d, m: scale_and_combine(
+        d, m, 5.0, 5.0, "pallas"))(diags, mask))
+    np.testing.assert_array_equal(a, b)
+
+
 def test_scale_and_combine_batched_pallas_adversarial():
-    """The pallas route batches the four diagnostics into shared median
-    launches (masked_jax._scaled_sides_batched_pallas); its epilogue must
-    stay bit-identical to the sort route on the nasty lines: fully-masked
-    channels/subints, zero-MAD (constant) lines, and NaN-bearing rFFT
-    lines (where the plain path must propagate NaN, quirks 5-8)."""
+    """The pallas route fuses each orientation's four scalers into one
+    launch (masked_jax._scaled_sides_fused_pallas -> pallas_kernels.
+    scaled_sides_pallas); its in-kernel epilogue must stay bit-identical
+    to the sort route on the nasty lines: fully-masked channels/subints,
+    zero-MAD (constant) lines, and NaN-bearing rFFT lines (where the
+    plain path must propagate NaN, quirks 5-8)."""
     from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
 
     rng = np.random.default_rng(7)
