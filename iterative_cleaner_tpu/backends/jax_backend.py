@@ -36,7 +36,8 @@ def resolve_median_impl(median_impl: str, dtype) -> str:
     route the kernel through shard_map (parallel/shard_stats); a cell grid
     that does not divide the mesh is rejected up front by
     clean_cube_sharded (no sharding layout supports it).  The vmap-batched
-    path stays on 'sort' (vmap serialises a pallas_call over a grid axis)."""
+    path keeps the kernels too: their custom_vmap rules fold the batch
+    into the launch grid (stats/pallas_kernels)."""
     if median_impl != "auto":
         return median_impl
     on_tpu = jax.devices()[0].platform == "tpu"

@@ -437,13 +437,12 @@ def main(argv=None) -> int:
     )
 
     if args.batch > 1 and (args.unload_res or args.checkpoint
-                           or args.backend != "jax"
-                           or args.stats_impl == "fused"):
+                           or args.backend != "jax"):
         # pure-argument validation first: never make a bad invocation wait
         # out the device probe below before erroring
         build_parser().error(
-            "--batch is incompatible with --unload_res/--checkpoint, "
-            "requires --backend jax, and uses the vmap (xla) stats path")
+            "--batch is incompatible with --unload_res/--checkpoint and "
+            "requires --backend jax")
     if args.model != "surgical_scrub" and (args.batch > 1
                                            or args.unload_res
                                            or args.checkpoint

@@ -24,9 +24,13 @@ from iterative_cleaner_tpu.config import CleanConfig
 def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                            pulse_scale, pulse_active, rotation, baseline_duty,
                            fft_mode, median_impl="sort",
-                           stats_frame="dispersed", dedispersed=False):
+                           stats_frame="dispersed", dedispersed=False,
+                           stats_impl="xla"):
     """Jitted batched cleaner: every per-archive input gains a leading batch
-    axis; scalars (dm, period, ref freq) are per-archive vectors."""
+    axis; scalars (dm, period, ref freq) are per-archive vectors.  The
+    Pallas kernels (median/fused stats) batch through their custom_vmap
+    rules — the batch folds into each launch's grid instead of vmap
+    serialising the pallas_call."""
     import jax
 
     from iterative_cleaner_tpu.engine.loop import (
@@ -45,7 +49,7 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             subintthresh=subintthresh, pulse_slice=pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
-            stats_frame=stats_frame,
+            stats_frame=stats_frame, stats_impl=stats_impl,
         )
 
     return jax.jit(jax.vmap(one))
@@ -151,20 +155,45 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
 
     from iterative_cleaner_tpu.backends.jax_backend import (
         resolve_fft_mode,
+        resolve_median_impl,
         resolve_stats_frame,
+        resolve_stats_impl,
     )
 
-    # 'auto' stays on the sort path here: vmap batches a pallas_call by
-    # serialising over a grid axis, which forfeits the kernel's advantage.
-    median_impl = "sort" if config.median_impl == "auto" else config.median_impl
+    # same 'auto' resolution as the single-archive path: the kernels'
+    # custom_vmap rules fold the batch into their launch grids, so the
+    # fast paths survive batching (round 3; previously forced to 'sort').
+    # Under a device mesh the kernels stay OFF: a bare pallas_call in a
+    # GSPMD-sharded program gathers its operands onto every device (the
+    # same constraint shard_stats routes around for cell meshes; shard_map
+    # routing for the batch mesh is not built yet).
+    dtype = jnp.dtype(config.dtype)
+    fft_mode = resolve_fft_mode(config.fft_mode, dtype)
+    if mesh is None:
+        median_impl = resolve_median_impl(config.median_impl, dtype)
+        stats_impl = resolve_stats_impl(config.stats_impl, dtype,
+                                        archives[0].nbin, fft_mode)
+    else:
+        if config.median_impl == "pallas" or config.stats_impl == "fused":
+            raise ValueError(
+                "explicit median_impl='pallas'/stats_impl='fused' cannot "
+                "run under a batch mesh: a bare pallas_call in the sharded "
+                "program would all-gather the folded cubes onto every "
+                "device; use 'auto' (resolves to sort/xla here) or drop "
+                "the mesh")
+        median_impl = "sort" if config.median_impl == "auto" \
+            else config.median_impl
+        stats_impl = "xla" if config.stats_impl == "auto" \
+            else config.stats_impl
     fn = build_batched_clean_fn(
         config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
         config.rotation, config.baseline_duty,
-        resolve_fft_mode(config.fft_mode, jnp.dtype(config.dtype)),
+        fft_mode,
         median_impl,
-        resolve_stats_frame(config.stats_frame, jnp.dtype(config.dtype)),
+        resolve_stats_frame(config.stats_frame, dtype),
         bool(archives[0].dedispersed),
+        stats_impl,
     )
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -60,6 +60,21 @@ def _key_to_float(o):
     return jax.lax.bitcast_convert_type(b, jnp.float32)
 
 
+def _line_fold(axis, B, S, C, keepdims=False):
+    """(fold, unfold) for batching a line-local (B, S, C) launch: the batch
+    folds into the LINE axis of one 2-D launch (lines are independent, so
+    B archives are just B times the lanes).  ``keepdims`` unfolds a
+    reduced (1, lines) output (the median) instead of a full one."""
+    n_keep = 1 if keepdims else (S if axis == 0 else C)
+    if axis == 0:   # reduce subints; lines = B*C channels
+        fold = lambda x: x.transpose(1, 0, 2).reshape(S, B * C)
+        unfold = lambda o: o.reshape(n_keep, B, C).transpose(1, 0, 2)
+    else:           # reduce channels; lines = B*S subints
+        fold = lambda x: x.transpose(2, 0, 1).reshape(C, B * S)
+        unfold = lambda o: o.reshape(n_keep, B, S).transpose(1, 2, 0)
+    return fold, unfold
+
+
 def _select_kth(keys, k):
     """Exact k-th (0-indexed) smallest int32 key per lane.
 
@@ -201,26 +216,57 @@ def _scaled_sides_axis0(d0, d1, d2, d3, mask, thresh, interpret):
     return tuple(o.swapaxes(0, 1).reshape(n, mp)[:, :m] for o in outs)
 
 
+@functools.lru_cache(maxsize=64)
+def _scaled_sides_fn(axis: int, thresh: float):
+    """The one-orientation scaler launch wrapped in ``custom_vmap``: under
+    ``vmap`` (the batched-archive engine, parallel/batch.py) the batch
+    axis FOLDS INTO THE LINE AXIS of a single launch instead of
+    serialising the pallas_call over a grid axis — per-line math is
+    line-local, so B archives' scalers are just B times the lanes."""
+    from jax.custom_batching import custom_vmap
+
+    @custom_vmap
+    def f(d0, d1, d2, d3, mask):
+        interpret = jax.devices()[0].platform != "tpu"
+        if axis == 0:
+            return _scaled_sides_axis0(d0, d1, d2, d3, mask, thresh,
+                                       interpret)
+        outs = _scaled_sides_axis0(d0.T, d1.T, d2.T, d3.T, mask.T, thresh,
+                                   interpret)
+        return tuple(o.T for o in outs)
+
+    @f.def_vmap
+    def _rule(axis_size, in_batched, *args):
+        d0, d1, d2, d3, mask = _batch_args(axis_size, in_batched, *args)
+        B, S, C = d0.shape
+        fold, unfold = _line_fold(axis, B, S, C)
+        interpret = jax.devices()[0].platform != "tpu"
+        outs = _scaled_sides_axis0(fold(d0), fold(d1), fold(d2), fold(d3),
+                                   fold(mask), thresh, interpret)
+        return tuple(unfold(o) for o in outs), (True,) * 4
+
+    return f
+
+
 def scaled_sides_pallas(diagnostics, cell_mask, axis, thresh):
     """All four scaled sides of one orientation in ONE launch (float32).
 
     ``axis=0`` scales every channel's line down the subint axis (the
     channel scaler); ``axis=1`` the transpose.  Bit-identical to routing
     each diagnostic through :func:`masked_median_pallas` + the XLA
-    epilogues (locked in by tests/test_pallas_stats.py)."""
+    epilogues *under jit* — the production mode; the engine compiles
+    everything — and locked in by tests/test_pallas_stats.py.  (Eager XLA
+    simplifies scalar divisions differently from its own jitted output at
+    the 1-ulp level, so eager-vs-kernel comparisons can wobble for
+    non-power-of-two thresholds; that is an XLA eager/jit artifact, not a
+    kernel property.)  Batches under ``vmap`` by folding the batch into
+    the line axis (one launch for the whole batch)."""
     if diagnostics[0].dtype != jnp.float32:
         raise TypeError("scaled_sides_pallas requires float32, got %s"
                         % diagnostics[0].dtype)
-    interpret = jax.devices()[0].platform != "tpu"
-    thresh = float(thresh)
-    if axis == 0:
-        return _scaled_sides_axis0(*diagnostics, cell_mask, thresh,
-                                   interpret)
-    if axis == 1:
-        outs = _scaled_sides_axis0(*(d.T for d in diagnostics), cell_mask.T,
-                                   thresh, interpret)
-        return tuple(o.T for o in outs)
-    raise ValueError("axis must be 0 or 1 for 2-D diagnostics")
+    if axis not in (0, 1):
+        raise ValueError("axis must be 0 or 1 for 2-D diagnostics")
+    return _scaled_sides_fn(axis, float(thresh))(*diagnostics, cell_mask)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -411,7 +457,7 @@ def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
     # closed-form fit (dsp.fit_template_amplitudes, same ops/order)
     tp = jnp.sum(ded * t[None, None, :], axis=2)
     amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
-    resid = amp[:, :, None] * rott_ref[:][None] - disp_ref[:]
+    resid = amp[:, :, None] * rott_ref[0][None] - disp_ref[:]
     wres = resid * w_ref[0][:, :, None]             # apply_weights
     _write_diags(wres, m_ref[0], cos_ref, sin_ref,
                  std_ref, mean_ref, ptp_ref, fft_ref, num_k)
@@ -444,16 +490,28 @@ class _FusedScaffold:
     (nc/C_BLK, nsub_padded, C_BLK) so their (1, S_BLK, C_BLK) blocks keep
     the last dim equal to the full (reshaped) array dim — Mosaic's lane
     tiling otherwise demands a multiple of 128, which the VMEM-driven
-    C_BLK tiers of :func:`_cell_blocks` break past 256 bins."""
+    C_BLK tiers of :func:`_cell_blocks` break past 256 bins.
 
-    def __init__(self, nsub, nchan, nbin, num_k):
+    ``batch > 1`` folds B archives into the subint axis of ONE launch
+    (each archive's subints padded to a block multiple first, so no block
+    straddles archives); the per-archive inputs — template, rotated
+    template, tt_info — carry a leading batch dim and their index maps
+    select the owning archive from the subint-block index.  This is how
+    the batched engine (parallel/batch.py) keeps the fused kernel instead
+    of letting ``vmap`` serialise the pallas_call."""
+
+    def __init__(self, nsub, nchan, nbin, num_k, batch=1):
+        self.batch = batch
         self.nsub, self.nchan, self.nbin = nsub, nchan, nbin
         self.num_k = num_k
         s_blk, c_blk = _cell_blocks(nbin)
         self.c_blk = c_blk
         self.pad_s = (-nsub) % s_blk
         self.pad_c = (-nchan) % c_blk
-        self.ns, self.nc = nsub + self.pad_s, nchan + self.pad_c
+        self.s_pad = nsub + self.pad_s          # per-archive padded subints
+        self.ns = batch * self.s_pad            # folded subint axis
+        self.nc = nchan + self.pad_c
+        bpa = self.s_pad // s_blk               # subint blocks per archive
         # kk innermost: the cube/cell blocks' index maps ignore it, so
         # those blocks stay resident in VMEM across the spectrum sweep
         self.grid = (self.ns // s_blk, self.nc // c_blk, num_k)
@@ -463,18 +521,25 @@ class _FusedScaffold:
         self.cube_spec = pl.BlockSpec((s_blk, c_blk, nbin),
                                       lambda i, j, kk: (i, j, 0),
                                       memory_space=pltpu.VMEM)
-        self.chan_row_spec = pl.BlockSpec((c_blk, nbin),
-                                          lambda i, j, kk: (j, 0),
+        self.chan_row_spec = pl.BlockSpec((1, c_blk, nbin),
+                                          lambda i, j, kk: (i // bpa, j, 0),
                                           memory_space=pltpu.VMEM)
-        self.row_spec = pl.BlockSpec((1, nbin), lambda i, j, kk: (0, 0),
+        self.row_spec = pl.BlockSpec((1, nbin),
+                                     lambda i, j, kk: (i // bpa, 0),
                                      memory_space=pltpu.VMEM)
+        self.tt_spec = pl.BlockSpec((1, 2), lambda i, j, kk: (i // bpa, 0),
+                                    memory_space=pltpu.SMEM)
 
     def pad_cube(self, x):
-        return jnp.pad(x, ((0, self.pad_s), (0, self.pad_c), (0, 0))) \
+        """(B, S, C, nbin) -> folded (B*S_pad, nc, nbin)."""
+        x = jnp.pad(x, ((0, 0), (0, self.pad_s), (0, self.pad_c), (0, 0))) \
             if self.pad_s or self.pad_c else x
+        return x.reshape(self.ns, self.nc, self.nbin)
 
     def pad_chan_row(self, x):
-        return jnp.pad(x, ((0, self.pad_c), (0, 0))) if self.pad_c else x
+        """(B, C, nbin) per-archive channel rows, channel-padded."""
+        return jnp.pad(x, ((0, 0), (0, self.pad_c), (0, 0))) \
+            if self.pad_c else x
 
     def to_cellrows(self, x):
         """(ns, nc) cell plane -> (nc/C_BLK, ns, C_BLK) chunk-major form."""
@@ -482,11 +547,14 @@ class _FusedScaffold:
                          self.c_blk).swapaxes(0, 1)
 
     def pad_cells(self, weights, cell_mask):
+        """(B, S, C) planes -> folded chunk-major; padding cells masked."""
+        pads = ((0, 0), (0, self.pad_s), (0, self.pad_c))
         if self.pad_s or self.pad_c:
-            pads = ((0, self.pad_s), (0, self.pad_c))
             weights = jnp.pad(weights, pads)
             cell_mask = jnp.pad(cell_mask, pads, constant_values=True)
-        return self.to_cellrows(weights), self.to_cellrows(cell_mask)
+        fold = (self.ns, self.nc)
+        return (self.to_cellrows(weights.reshape(fold)),
+                self.to_cellrows(cell_mask.reshape(fold)))
 
     def launch(self, kernel, inputs, in_specs, cos_t, sin_t, tt_info,
                interpret):
@@ -498,8 +566,7 @@ class _FusedScaffold:
             pl.BlockSpec((sin_t.shape[0], k_chunk),
                          lambda i, j, kk: (0, kk),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 2), lambda i, j, kk: (0, 0),
-                         memory_space=pltpu.SMEM),
+            self.tt_spec,
         ]
         outs = pl.pallas_call(
             functools.partial(kernel, num_k=self.num_k),
@@ -512,33 +579,34 @@ class _FusedScaffold:
             interpret=interpret,
         )(*inputs, cos_t, sin_t, tt_info)
         return tuple(
-            o.swapaxes(0, 1).reshape(self.ns, self.nc)[: self.nsub,
-                                                       : self.nchan]
+            o.swapaxes(0, 1).reshape(self.batch, self.s_pad, self.nc)
+            [:, : self.nsub, : self.nchan]
             for o in outs)
 
 
 @functools.partial(jax.jit, static_argnames=("num_k", "interpret"))
 def _cell_stats_call(ded, disp_base, rot_t, template, tt_info, weights,
                      cell_mask, cos_t, sin_t, num_k, interpret):
-    sc = _FusedScaffold(*ded.shape, num_k)
+    """Batched-shape launch: ded/disp (B, S, C, nbin), rot_t (B, C, nbin),
+    template/tt per archive; B archives fold into one grid."""
+    sc = _FusedScaffold(*ded.shape[1:], num_k, batch=ded.shape[0])
     weights, cell_mask = sc.pad_cells(weights, cell_mask)
     return sc.launch(
         _cell_stats_kernel,
         (sc.pad_cube(ded), sc.pad_cube(disp_base), sc.pad_chan_row(rot_t),
-         template[None, :], weights, cell_mask),
+         template, weights, cell_mask),
         (sc.cube_spec, sc.cube_spec, sc.chan_row_spec, sc.row_spec,
          sc.cell_spec, sc.cell_spec),
         cos_t, sin_t, tt_info, interpret,
     )
 
 
-def _fused_setup(ded, template):
-    """Shared validation + DFT tables + template-norm info for the fused
-    kernels.  Returns (cos_t, sin_t, tt_info, num_k, interpret)."""
-    if ded.dtype != jnp.float32:
+def _fused_tables(nbin, dtype):
+    """Shared validation + DFT tables for the fused kernels.
+    Returns (cos_t, sin_t, num_k, interpret)."""
+    if dtype != jnp.float32:
         raise TypeError("fused cell diagnostics require float32, got %s"
-                        % ded.dtype)
-    nbin = ded.shape[-1]
+                        % dtype)
     if nbin > FUSED_STATS_MAX_NBIN:
         raise ValueError(
             f"fused cell diagnostics support nbin <= {FUSED_STATS_MAX_NBIN} "
@@ -552,13 +620,51 @@ def _fused_setup(ded, template):
     cos_t = jnp.pad(jnp.cos(ang), ((0, 0), (0, pad_k)))
     sin_t = jnp.pad(jnp.sin(ang), ((0, 0), (0, pad_k)))
     num_k = cos_t.shape[1] // _k_chunk(nbin, cos_t.shape[1])
-    tt = jnp.sum(template * template)
-    tt_info = jnp.stack(
-        [jnp.where(tt == 0, jnp.float32(1.0), tt),
-         (tt == 0).astype(jnp.float32)]
-    )[None, :]
     interpret = jax.devices()[0].platform != "tpu"
-    return cos_t, sin_t, tt_info, num_k, interpret
+    return cos_t, sin_t, num_k, interpret
+
+
+def _tt_info(template):
+    """(B, nbin) templates -> (B, 2) [safe ||t||^2, is-zero] SMEM rows."""
+    tt = jnp.sum(template * template, axis=-1)
+    return jnp.stack(
+        [jnp.where(tt == 0, jnp.float32(1.0), tt),
+         (tt == 0).astype(jnp.float32)], axis=-1)
+
+
+def _batch_args(axis_size, in_batched, *args):
+    """Broadcast any unbatched custom_vmap operand to the batch."""
+    return tuple(
+        x if b else jnp.broadcast_to(x[None], (axis_size,) + x.shape)
+        for x, b in zip(args, in_batched))
+
+
+def _fused_dispersed_batched(ded, disp_base, rot_t, template, weights,
+                             cell_mask):
+    cos_t, sin_t, num_k, interpret = _fused_tables(ded.shape[-1], ded.dtype)
+    return _cell_stats_call(ded, disp_base, rot_t, template,
+                            _tt_info(template),
+                            weights.astype(jnp.float32), cell_mask,
+                            cos_t, sin_t, num_k, interpret)
+
+
+from jax.custom_batching import custom_vmap  # noqa: E402
+
+
+@custom_vmap
+def _fused_dispersed(ded, disp_base, rot_t, template, weights, cell_mask):
+    outs = _fused_dispersed_batched(
+        ded[None], disp_base[None], rot_t[None], template[None],
+        weights[None], cell_mask[None])
+    return tuple(o[0] for o in outs)
+
+
+@_fused_dispersed.def_vmap
+def _fused_dispersed_rule(axis_size, in_batched, *args):
+    # the batched-archive engine lands here: B archives become ONE launch
+    # with the batch folded into the subint grid (see _FusedScaffold)
+    return (_fused_dispersed_batched(
+        *_batch_args(axis_size, in_batched, *args)), (True,) * 4)
 
 
 def cell_diagnostics_pallas(ded, disp_base, rot_t, template, weights,
@@ -567,49 +673,90 @@ def cell_diagnostics_pallas(ded, disp_base, rot_t, template, weights,
     elsewhere).  Returns (d_std, d_mean, d_ptp, d_fft), each (nsub, nchan),
     with the same masked-cell patches as the XLA path
     (:func:`masked_jax.surgical_scores_jax`) and DFT-flavoured rFFT
-    magnitudes (:func:`masked_jax.rfft_magnitudes` mode='dft')."""
-    cos_t, sin_t, tt_info, num_k, interpret = _fused_setup(ded, template)
-    return _cell_stats_call(ded, disp_base, rot_t, template, tt_info,
-                            weights.astype(jnp.float32),
-                            cell_mask, cos_t, sin_t, num_k, interpret)
+    magnitudes (:func:`masked_jax.rfft_magnitudes` mode='dft').  Under
+    ``vmap`` the batch folds into the launch grid instead of serialising
+    the pallas_call."""
+    return _fused_dispersed(ded, disp_base, rot_t, template,
+                            weights.astype(jnp.float32), cell_mask)
 
 
 @functools.partial(jax.jit, static_argnames=("num_k", "interpret"))
 def _cell_stats_dedisp_call(ded, template, window, tt_info, weights,
                             cell_mask, cos_t, sin_t, num_k, interpret):
-    sc = _FusedScaffold(*ded.shape, num_k)
+    sc = _FusedScaffold(*ded.shape[1:], num_k, batch=ded.shape[0])
     weights, cell_mask = sc.pad_cells(weights, cell_mask)
     return sc.launch(
         _cell_stats_dedisp_kernel,
-        (sc.pad_cube(ded), template[None, :], window[None, :],
-         weights, cell_mask),
+        (sc.pad_cube(ded), template, window, weights, cell_mask),
         (sc.cube_spec, sc.row_spec, sc.row_spec, sc.cell_spec, sc.cell_spec),
         cos_t, sin_t, tt_info, interpret,
     )
 
 
+def _fused_dedisp_batched(ded, template, window, weights, cell_mask):
+    cos_t, sin_t, num_k, interpret = _fused_tables(ded.shape[-1], ded.dtype)
+    return _cell_stats_dedisp_call(ded, template, window,
+                                   _tt_info(template),
+                                   weights.astype(jnp.float32), cell_mask,
+                                   cos_t, sin_t, num_k, interpret)
+
+
+@custom_vmap
+def _fused_dedisp(ded, template, window, weights, cell_mask):
+    outs = _fused_dedisp_batched(ded[None], template[None], window[None],
+                                 weights[None], cell_mask[None])
+    return tuple(o[0] for o in outs)
+
+
+@_fused_dedisp.def_vmap
+def _fused_dedisp_rule(axis_size, in_batched, *args):
+    return (_fused_dedisp_batched(
+        *_batch_args(axis_size, in_batched, *args)), (True,) * 4)
+
+
 def cell_diagnostics_pallas_dedisp(ded, template, window, weights, cell_mask):
     """Dedispersed-frame fused diagnostics: one cube read per iteration
     instead of two (engine stats_frame='dedispersed').  ``window`` is the
-    (nbin,) pulse-region multiplier (all ones when inactive)."""
-    cos_t, sin_t, tt_info, num_k, interpret = _fused_setup(ded, template)
-    return _cell_stats_dedisp_call(ded, template,
-                                   window.astype(jnp.float32), tt_info,
-                                   weights.astype(jnp.float32),
-                                   cell_mask, cos_t, sin_t, num_k, interpret)
+    (nbin,) pulse-region multiplier (all ones when inactive).  Batches
+    under ``vmap`` like :func:`cell_diagnostics_pallas`."""
+    return _fused_dedisp(ded, template, window.astype(jnp.float32),
+                         weights.astype(jnp.float32), cell_mask)
+
+
+@functools.lru_cache(maxsize=8)
+def _masked_median_fn(axis: int):
+    """``masked_median_pallas`` for one axis under ``custom_vmap``: a
+    vmapped call folds the batch into the line axis of a single launch
+    (same scheme as :func:`_scaled_sides_fn`)."""
+    from jax.custom_batching import custom_vmap
+
+    @custom_vmap
+    def f(values, mask):
+        interpret = jax.devices()[0].platform != "tpu"
+        if axis == 0:
+            return _median_axis0(values, mask, interpret)
+        return _median_axis0(values.T, mask.T, interpret).T
+
+    @f.def_vmap
+    def _rule(axis_size, in_batched, values, mask):
+        values, mask = _batch_args(axis_size, in_batched, values, mask)
+        B, S, C = values.shape
+        fold, unfold = _line_fold(axis, B, S, C, keepdims=True)
+        interpret = jax.devices()[0].platform != "tpu"
+        out = _median_axis0(fold(values), fold(mask), interpret)
+        return unfold(out), True
+
+    return f
 
 
 def masked_median_pallas(values, mask, axis):
     """Drop-in for :func:`masked_jax.masked_median` (keepdims semantics),
     float32 only.  axis 0 reduces down subints (channel scaler), axis 1 down
-    channels (subint scaler; handled by transposing the tile)."""
+    channels (subint scaler; handled by transposing the tile).  Batches
+    under ``vmap`` by folding the batch into the line axis."""
     if values.dtype != jnp.float32:
         raise TypeError("masked_median_pallas requires float32, got %s"
                         % values.dtype)
-    interpret = jax.devices()[0].platform != "tpu"
-    if axis == 0:
-        return _median_axis0(values, mask, interpret)
-    if axis == 1:
-        out = _median_axis0(values.T, mask.T, interpret)
-        return out.T
-    raise ValueError("axis must be 0 or 1 for 2-D diagnostics")
+    if axis not in (0, 1):
+        raise ValueError("axis must be 0 or 1 for 2-D diagnostics")
+    return _masked_median_fn(axis)(values, mask)

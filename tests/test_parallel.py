@@ -133,6 +133,43 @@ def test_batched_sharded_with_padding():
         np.testing.assert_array_equal(single.final_weights, b.final_weights)
 
 
+def test_batched_pallas_fused_matches_individual():
+    """Round 3: the batch path keeps the Pallas kernels — the custom_vmap
+    rules fold the batch into each launch's grid.  Explicit pallas median
+    + fused stats, batched vs individual, must agree bit-for-bit (both
+    float32, both fused)."""
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.parallel import clean_archives_batched
+
+    cfg = CleanConfig(rotation="roll", fft_mode="dft", dtype="float32",
+                      median_impl="pallas", stats_impl="fused")
+    archives = [_mk(s) for s in range(3)]
+    batched = clean_archives_batched(archives, cfg)
+    for ar, b in zip(archives, batched):
+        single = clean_archive(ar.clone(), cfg)
+        np.testing.assert_array_equal(single.final_weights, b.final_weights)
+        np.testing.assert_array_equal(single.scores, b.scores)
+        assert single.loops == b.loops
+
+
+def test_batched_mesh_rejects_explicit_kernels():
+    """A bare pallas_call in the batch-mesh-sharded program would gather
+    the folded cubes onto every device; explicit pallas/fused must be
+    rejected up front, 'auto' resolves safely."""
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.parallel import (
+        batch_mesh,
+        clean_archives_batched,
+    )
+
+    archives = [_mk(s) for s in range(2)]
+    cfg = CleanConfig(rotation="roll", fft_mode="dft", dtype="float32",
+                      median_impl="pallas")
+    with pytest.raises(ValueError, match="batch mesh"):
+        clean_archives_batched(archives, cfg, mesh=batch_mesh(8))
+
+
 def test_batched_rejects_ragged_shapes():
     from iterative_cleaner_tpu.parallel import clean_archives_batched
 
