@@ -93,15 +93,19 @@ def _hbm_peak(device_kind: str):
     return None
 
 
-def _cube_passes(stats_impl, stats_frame):
+def _cube_passes(stats_impl, stats_frame, baseline_mode="integration"):
     """HBM cube reads per iteration for the bytes-moved model: the template
     einsum always reads the cube once; the fused kernel reads ded+disp_base
     (dispersed frame) or just ded (dedispersed frame); the XLA path
     additionally materialises the residual cube (write + two stat-pass
-    reads on top of the fit/base reads)."""
+    reads on top of the fit/base reads).  The integration baseline mode
+    adds one pass: the per-iteration consensus correction smooths the
+    current-weights total of the baseline-removed cube."""
+    base = 1.0 if baseline_mode == "integration" else 0.0
     if stats_impl == "fused":
-        return 2.0 if stats_frame == "dedispersed" else 3.0
-    return 6.0  # template + fit read + base read + resid write + 2 stat reads
+        return base + (2.0 if stats_frame == "dedispersed" else 3.0)
+    # template + fit read + base read + resid write + 2 stat reads
+    return base + 6.0
 
 
 def _arm_watchdog(seconds: float):
@@ -147,8 +151,10 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=4):
     stats_impl = resolve_stats_impl("auto", jnp.float32, nbin, fft_mode)
     _log(f"median impl: {median_impl}, fft mode: {fft_mode}, "
          f"stats impl: {stats_impl}")
+    # defaults of CleanConfig: dispersed stats frame, integration baseline
     fn = build_clean_fn(max_iter, 5.0, 5.0, (0, 0), 1.0, False, "fourier",
-                        0.15, False, fft_mode, median_impl, stats_impl)
+                        0.15, False, fft_mode, median_impl, stats_impl,
+                        "dispersed", False, "integration")
     dev = jax.devices()[0]
     _log(f"jax device: {dev.platform} {getattr(dev, 'device_kind', '?')}")
 
@@ -260,7 +266,7 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=4):
         # contains the ~20-100 ms fixed dispatch/D2H cost that would
         # silently skew the utilisation figure low.
         stats_frame = "dispersed"  # build_clean_fn default above
-        passes = _cube_passes(stats_impl, stats_frame)
+        passes = _cube_passes(stats_impl, stats_frame, "integration")
         bytes_per_iter = passes * cube.nbytes
         achieved = bytes_per_iter / per_iter
         hbm_util = achieved / peak

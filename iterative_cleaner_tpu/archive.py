@@ -19,7 +19,13 @@ import os
 import numpy as np
 
 # Dispersion constant: delay(s) = KDM_S * DM * f_MHz^-2, DM in pc cm^-3.
-KDM_S = 4.148808e3
+# The tempo/PSRCHIVE convention 1/2.41e-4 (the value the reference's
+# dedisperse inherits through PSRCHIVE) rather than the "precise" CODATA
+# derivation 4.148808e3 — pulsar timing standardised on the former, and
+# matching it keeps the framework's channel rotations aligned with
+# archives dedispersed by the reference toolchain.  Pinned by
+# tests/test_dsp.py::test_dispersion_constant_is_tempo_convention.
+KDM_S = 1.0 / 2.41e-4
 
 # Polarisation states.  "Intensity" = already total-intensity (npol==1).
 # "Stokes" = (I, Q, U, V): total intensity is component 0.

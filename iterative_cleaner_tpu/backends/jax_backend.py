@@ -114,15 +114,19 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, baseline_duty,
                    unload_res, fft_mode="fft", median_impl="sort",
                    stats_impl="xla", stats_frame="dispersed",
-                   dedispersed=False):
+                   dedispersed=False, baseline_mode="profile"):
     """Build (and cache) the jitted whole-archive cleaning program for one
     static configuration."""
 
     def run(cube, weights, freqs_mhz, dm, ref_freq_mhz, period_s):
-        ded, shifts = prepare_cube_jax(
-            cube, freqs_mhz, dm, ref_freq_mhz, period_s,
+        from iterative_cleaner_tpu.ops.dsp import (
+            prepare_cube_with_correction,
+        )
+
+        ded, shifts, baseline_corr = prepare_cube_with_correction(
+            cube, weights, freqs_mhz, dm, ref_freq_mhz, period_s, jnp,
             baseline_duty=baseline_duty, rotation=rotation,
-            dedispersed=dedispersed,
+            dedispersed=dedispersed, baseline_mode=baseline_mode,
         )
         outs = clean_dedispersed_jax(
             ded, weights, shifts,
@@ -131,12 +135,22 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
             stats_impl=stats_impl, stats_frame=stats_frame,
+            baseline_corr=baseline_corr,
         )
         if not unload_res:
             return outs, None
         # Reconstruct the last iteration's pulse-free residual (the reference
         # clones it mid-loop at :106-108); one extra template+fit pass.
-        template = weighted_template(ded, outs.template_weights, jnp) * 10000.0
+        template = weighted_template(ded, outs.template_weights, jnp)
+        if baseline_corr is not None:
+            from iterative_cleaner_tpu.ops.psrchive_baseline import (
+                template_correction,
+            )
+
+            template = template + template_correction(
+                baseline_corr[0], baseline_corr[1], outs.template_weights,
+                baseline_duty, jnp)
+        template = template * 10000.0
         amps = fit_template_amplitudes(ded, template, jnp)
         resid = template_residuals(
             ded, template, amps, pulse_slice, pulse_scale, jnp, pulse_active
@@ -164,6 +178,7 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
                            fft_mode),
         resolve_stats_frame(config.stats_frame, dtype),
         bool(dedispersed),
+        config.baseline_mode,
     )
     outs, resid = fn(
         jnp.asarray(cube, dtype=dtype),

@@ -20,7 +20,6 @@ from iterative_cleaner_tpu.backends.base import CleanResult
 from iterative_cleaner_tpu.config import CleanConfig
 from iterative_cleaner_tpu.ops.dsp import (
     fit_template_amplitudes,
-    prepare_cube,
     rotate_bins,
     template_residuals,
     weighted_template,
@@ -41,10 +40,15 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
 
     # Iteration-invariant preamble (reference recomputes at :97-100 from
     # identical clones; hoisted here; shared semantics in ops.dsp).
-    ded, shifts = prepare_cube(
-        cube, freqs_mhz, dm, ref_freq_mhz, period_s, np,
+    from iterative_cleaner_tpu.ops.dsp import prepare_cube_with_correction
+    from iterative_cleaner_tpu.ops.psrchive_baseline import (
+        template_correction,
+    )
+
+    ded, shifts, baseline_corr = prepare_cube_with_correction(
+        cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s, np,
         baseline_duty=config.baseline_duty, rotation=config.rotation,
-        dedispersed=dedispersed,
+        dedispersed=dedispersed, baseline_mode=config.baseline_mode,
     )
 
     cell_mask = orig_weights == 0  # ref :115
@@ -58,7 +62,13 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
     loop_rfi_frac = []
 
     for x in range(1, config.max_iter + 1):
-        template = weighted_template(ded, weights, np) * 10000.0  # ref :94
+        template = weighted_template(ded, weights, np)
+        if baseline_corr is not None:
+            # integration mode: current-weights consensus correction (the
+            # reference recomputes baselines each template build, :88-94)
+            template = template + template_correction(
+                *baseline_corr[:2], weights, baseline_corr[2], np)
+        template = template * 10000.0  # ref :94
         amps = fit_template_amplitudes(ded, template, np)
         resid = template_residuals(
             ded, template, amps, config.pulse_slice, config.pulse_scale, np,

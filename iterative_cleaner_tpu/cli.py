@@ -106,6 +106,16 @@ def build_parser() -> argparse.ArgumentParser:
                              "memory traffic, but with the default fourier "
                              "rotation borderline cells (scores near 1) can "
                              "zap differently from the reference.")
+    parser.add_argument("--baseline_mode",
+                        choices=("integration", "profile"),
+                        default="integration",
+                        help="Baseline estimator: 'integration' (default) "
+                             "is the PSRCHIVE-spec scheme the reference's "
+                             "remove_baseline runs — one window per "
+                             "subintegration placed by the weighted total "
+                             "profile's smoothed minimum; 'profile' is the "
+                             "cheaper per-profile min-mean window (no "
+                             "per-iteration consensus recomputation).")
     parser.add_argument("--checkpoint", type=str, default="",
                         metavar="DIR",
                         help="Checkpoint directory: each archive's cleaning "
@@ -195,6 +205,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         stats_impl=args.stats_impl,
         stats_frame=args.stats_frame,
         fft_mode=args.fft_mode,
+        baseline_mode=args.baseline_mode,
         unload_res=args.unload_res,
         record_history=args.record_history,
     )

@@ -58,6 +58,16 @@ class CleanConfig:
     # thresholds, all with dispersed-frame scores in (0.9, 1.2).
     stats_frame: str = "auto"
     baseline_duty: float = 0.15  # off-pulse window fraction for baseline find
+    # baseline estimator (ops/psrchive_baseline.py).  "integration" (the
+    # default) is the PSRCHIVE-spec scheme the reference's remove_baseline
+    # actually runs: ONE window per subintegration, placed by the
+    # weighted total profile's smoothed minimum, every channel subtracting
+    # its own mean over the shared bins.  "profile" keeps round 2's
+    # framework-defined per-profile min-mean window (cheaper: no
+    # per-iteration template correction, one less cube pass per iteration,
+    # and exact streaming does not retain raw tiles —
+    # parallel/streaming_exact's host-RAM note).
+    baseline_mode: str = "integration"
     dtype: str = "float32"       # compute dtype on the jax path
     unload_res: bool = False     # -u: also produce the pulse-free residual
     # keep the per-iteration weight matrices in the result (checkpoint/
@@ -95,6 +105,8 @@ class CleanConfig:
             raise ValueError(f"unknown stats impl {self.stats_impl!r}")
         if self.stats_frame not in ("auto", "dispersed", "dedispersed"):
             raise ValueError(f"unknown stats frame {self.stats_frame!r}")
+        if self.baseline_mode not in ("integration", "profile"):
+            raise ValueError(f"unknown baseline mode {self.baseline_mode!r}")
         if self.stats_impl == "fused" and self.dtype != "float32":
             raise ValueError("stats_impl='fused' requires dtype='float32'")
         if self.stats_impl == "fused" and self.fft_mode == "fft":

@@ -99,7 +99,8 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
                    back_shifts, *, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, fft_mode="fft",
                    median_impl="sort", stats_impl="xla",
-                   stats_frame="dispersed", shard_mesh=None):
+                   stats_frame="dispersed", shard_mesh=None,
+                   baseline_corr=None):
     """One cleaning iteration: template -> fit -> residual stats -> new weights.
 
     ``weights`` are the previous iteration's (template) weights;
@@ -125,7 +126,20 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
         raise ValueError(
             "stats_impl='fused' computes DFT-flavoured rFFT magnitudes; "
             "pass fft_mode='dft'")
-    template = weighted_template(ded_cube, weights, jnp) * 10000.0  # ref :94
+    template = weighted_template(ded_cube, weights, jnp)
+    if baseline_corr is not None:
+        # integration baseline mode: the reference recomputes baselines on
+        # every template build with the CURRENT weights (:88-94); the
+        # hoisted preamble used the original weights, and the difference
+        # is exactly a scalar template shift (ops/psrchive_baseline)
+        from iterative_cleaner_tpu.ops.psrchive_baseline import (
+            template_correction,
+        )
+
+        disp_clean, base_offsets, duty = baseline_corr
+        template = template + template_correction(
+            disp_clean, base_offsets, weights, duty, jnp)
+    template = template * 10000.0  # ref :94
     diags = diagnostics_given_template(
         ded_cube, disp_base, template, orig_weights, cell_mask, back_shifts,
         pulse_slice=pulse_slice, pulse_scale=pulse_scale,
@@ -223,12 +237,19 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
                           median_impl="sort",
                           stats_impl="xla",
                           stats_frame="dispersed",
-                          shard_mesh=None) -> CleanOutputs:
+                          shard_mesh=None,
+                          baseline_corr=None) -> CleanOutputs:
     """Run the full iteration loop on an already-prepared cube.
 
     ``ded_cube``: baseline-removed, dedispersed (nsub, nchan, nbin) cube.
     ``back_shifts``: per-channel bin shifts that restore the dispersed frame.
     Keyword arguments are static (compiled in).
+
+    ``baseline_corr``: under the integration baseline mode, the
+    ``(disp_clean, base_offsets, duty)`` triple from
+    :func:`iterative_cleaner_tpu.ops.dsp.prepare_cube_integration` — the
+    per-iteration template then gets the current-weights consensus
+    correction; ``None`` (profile mode) keeps templates purely hoisted.
     """
     nsub, nchan, _ = ded_cube.shape
     wdtype = orig_weights.dtype
@@ -269,6 +290,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
             pulse_active=pulse_active, rotation=rotation, fft_mode=fft_mode,
             median_impl=median_impl, stats_impl=stats_impl,
             stats_frame=stats_frame, shard_mesh=shard_mesh,
+            baseline_corr=baseline_corr,
         )
         seen = jnp.arange(max_iter + 1) < c.count
         matches = jnp.all(c.history == new_w[None], axis=(1, 2)) & seen
@@ -311,7 +333,8 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
 
 
 def prepare_cube_jax(cube, freqs_mhz, dm, ref_freq_mhz, period_s, *,
-                     baseline_duty, rotation, dedispersed=False):
+                     baseline_duty, rotation, dedispersed=False,
+                     baseline_mode="profile", weights=None):
     """Host-free preamble on the jax path; the semantics (incl. the
     DEDISP=1 skip rule) live in the backend-generic
     :func:`iterative_cleaner_tpu.ops.dsp.prepare_cube`.
@@ -321,4 +344,5 @@ def prepare_cube_jax(cube, freqs_mhz, dm, ref_freq_mhz, period_s, *,
 
     return prepare_cube(cube, freqs_mhz, dm, ref_freq_mhz, period_s, jnp,
                         baseline_duty=baseline_duty, rotation=rotation,
-                        dedispersed=dedispersed)
+                        dedispersed=dedispersed,
+                        baseline_mode=baseline_mode, weights=weights)

@@ -48,7 +48,8 @@ from iterative_cleaner_tpu.config import CleanConfig
 # cost a recompile.
 @functools.lru_cache(maxsize=32)
 def _build_quicklook_fn(chanthresh, subintthresh, baseline_duty, rotation,
-                        fft_mode, median_impl, dedispersed):
+                        fft_mode, median_impl, dedispersed,
+                        baseline_mode="integration"):
     import jax
     import jax.numpy as jnp
 
@@ -56,9 +57,13 @@ def _build_quicklook_fn(chanthresh, subintthresh, baseline_duty, rotation,
     from iterative_cleaner_tpu.stats.masked_jax import surgical_scores_jax
 
     def run(cube, weights, freqs, dm, ref_freq, period):
+        # single-pass: the archive's own weights place the consensus
+        # windows, and with no template loop there is no weight drift to
+        # correct for
         ded, _ = prepare_cube_jax(
             cube, freqs, dm, ref_freq, period, baseline_duty=baseline_duty,
             rotation=rotation, dedispersed=dedispersed,
+            baseline_mode=baseline_mode, weights=weights,
         )
         cell_mask = weights == 0
         weighted = ded * weights[:, :, None]
@@ -84,6 +89,7 @@ def _clean_quicklook_numpy(archive, config: CleanConfig) -> CleanResult:
         cube, archive.freqs_mhz, archive.dm, archive.centre_freq_mhz,
         archive.period_s, np, baseline_duty=config.baseline_duty,
         rotation=config.rotation, dedispersed=archive.dedispersed,
+        baseline_mode=config.baseline_mode, weights=weights,
     )
     cell_mask = weights == 0
     scores = surgical_scores_numpy(ded * weights[:, :, None], cell_mask,
@@ -118,6 +124,7 @@ def clean_archive_quicklook(archive, config: CleanConfig) -> CleanResult:
         config.rotation, resolve_fft_mode(config.fft_mode, dtype),
         resolve_median_impl(config.median_impl, dtype),
         bool(archive.dedispersed),
+        config.baseline_mode,
     )
     new_w, scores = fn(
         jnp.asarray(archive.total_intensity(), dtype=dtype),

@@ -164,40 +164,53 @@ def dedisperse_cube(cube, freqs_mhz, dm, ref_freq_mhz, period_s, xp,
 # Baseline removal
 # ---------------------------------------------------------------------------
 
-def baseline_offsets(profiles, xp, duty=0.15):
-    """Per-profile baseline level: mean of the cyclic window (width =
-    round(duty * nbin)) with the smallest mean.
+def circular_window_sums(profiles, w, xp, centred=False):
+    """Sliding circular window sums along the last axis.
 
-    This is the framework's definition of the off-pulse baseline, standing in
-    for PSRCHIVE's minimum-duty-cycle baseline estimator behind
-    ``Archive::remove_baseline`` (reference :90,:99).  Deterministic, static
-    shape, vectorised over all leading axes.
+    ``centred=False``: the window at position ``c`` covers bins
+    ``[c, c+w)``; ``centred=True``: ``[c - w//2, c - w//2 + w)`` (the
+    BaselineWindow/SmoothMean convention of ops/psrchive_baseline).
+
+    TPU float32 path: one 0/1 circulant matmul — lax.cumsum lowers to a
+    sequential scan on TPU (~30x slower than this single MXU pass at
+    profile sizes).  float32 only: the matmul rounds differently from the
+    cumsum form at ulp level, and float64 is the oracle-bit-parity mode
+    where both backends must share one algorithm.
     """
     nbin = profiles.shape[-1]
-    w = max(1, int(round(duty * nbin)))
+    shift = (w // 2) if centred else 0
     if (xp is not np and nbin <= 1024
             and np.dtype(profiles.dtype) == np.float32):
         import jax
 
-        # TPU path: circular window sums as one 0/1 circulant matmul —
-        # lax.cumsum lowers to a sequential scan on TPU (~30x slower than
-        # this single MXU pass at profile sizes).  float32 only: the matmul
-        # rounds differently from the cumsum form at ulp level, and float64
-        # is the oracle-bit-parity mode where both backends must share one
-        # algorithm
         j = xp.arange(nbin)
-        box = (((j[:, None] - j[None, :]) % nbin) < w).astype(profiles.dtype)
-        win_sums = jax.lax.dot_general(
+        box = (((j[:, None] - j[None, :] + shift) % nbin) < w).astype(
+            profiles.dtype)
+        return jax.lax.dot_general(
             profiles, box, (((profiles.ndim - 1,), (0,)), ((), ())),
             precision=jax.lax.Precision.HIGHEST,
         )
-        return xp.min(win_sums, axis=-1) / w
-    ext = xp.concatenate([profiles, profiles[..., : w - 1]], axis=-1) if w > 1 else profiles
+    ext = xp.concatenate([profiles, profiles[..., : w - 1]], axis=-1) \
+        if w > 1 else profiles
     cs = xp.cumsum(ext, axis=-1)
     zero = xp.zeros_like(cs[..., :1])
     cz = xp.concatenate([zero, cs], axis=-1)
-    win_sums = cz[..., w : w + nbin] - cz[..., :nbin]
-    return xp.min(win_sums, axis=-1) / w
+    sums = cz[..., w: w + nbin] - cz[..., :nbin]
+    return xp.roll(sums, shift, axis=-1) if shift else sums
+
+
+def baseline_offsets(profiles, xp, duty=0.15):
+    """Per-profile baseline level: mean of the cyclic window (width =
+    round(duty * nbin)) with the smallest mean.
+
+    The legacy (``baseline_mode='profile'``) definition of the off-pulse
+    baseline; the default integration-consensus estimator lives in
+    :mod:`iterative_cleaner_tpu.ops.psrchive_baseline`.  Deterministic,
+    static shape, vectorised over all leading axes.
+    """
+    nbin = profiles.shape[-1]
+    w = max(1, int(round(duty * nbin)))
+    return xp.min(circular_window_sums(profiles, w, xp), axis=-1) / w
 
 
 def remove_baseline(profiles, xp, duty=0.15):
@@ -206,7 +219,8 @@ def remove_baseline(profiles, xp, duty=0.15):
 
 
 def prepare_cube(cube, freqs_mhz, dm, ref_freq_mhz, period_s, xp, *,
-                 baseline_duty, rotation, dedispersed=False):
+                 baseline_duty, rotation, dedispersed=False,
+                 baseline_mode="profile", weights=None):
     """Backend-generic cleaning preamble: baseline removal + forward
     dedispersion (reference :90-91/:99-100; iteration-invariant, so hoisted
     out of every loop).  The single source of the DEDISP=1 skip rule:
@@ -215,10 +229,27 @@ def prepare_cube(cube, freqs_mhz, dm, ref_freq_mhz, period_s, xp, *,
     frame — so ``dedispersed=True`` skips only the forward rotation and the
     back-shifts are returned unchanged.
 
+    ``baseline_mode="integration"`` (the default cleaning configuration)
+    uses the PSRCHIVE-spec integration-consensus estimator
+    (:mod:`iterative_cleaner_tpu.ops.psrchive_baseline`) with ``weights``
+    (the archive's weights — the residual path's baselines, reference
+    :97-100, which are weight-invariant across iterations);
+    ``"profile"`` keeps the legacy per-profile min-mean window.
+
     Returns ``(ded_cube, back_shifts)``; shared by the jax engine
     (:func:`iterative_cleaner_tpu.engine.loop.prepare_cube_jax`), the numpy
-    oracle backend, and the quicklook strategy's numpy twin.
+    oracle backend, and the quicklook strategy's numpy twin.  Engines that
+    also need the pre-rotation cube and offsets (the iterative loop's
+    template correction) call :func:`prepare_cube_integration` instead.
     """
+    if baseline_mode == "integration":
+        ded, shifts, _, _ = prepare_cube_integration(
+            cube, weights, freqs_mhz, dm, ref_freq_mhz, period_s, xp,
+            baseline_duty=baseline_duty, rotation=rotation,
+            dedispersed=dedispersed)
+        return ded, shifts
+    if baseline_mode != "profile":
+        raise ValueError(f"unknown baseline mode {baseline_mode!r}")
     nbin = cube.shape[-1]
     shifts = dispersion_shift_bins(
         xp.asarray(freqs_mhz, dtype=cube.dtype), dm, ref_freq_mhz, period_s,
@@ -228,6 +259,60 @@ def prepare_cube(cube, freqs_mhz, dm, ref_freq_mhz, period_s, xp, *,
     if not dedispersed:
         ded = rotate_bins(ded, -shifts, xp, method=rotation)
     return ded, shifts
+
+
+def prepare_cube_with_correction(cube, weights, freqs_mhz, dm, ref_freq_mhz,
+                                 period_s, xp, *, baseline_duty, rotation,
+                                 dedispersed=False,
+                                 baseline_mode="profile"):
+    """The engines' shared preamble dispatch: returns
+    ``(ded_cube, back_shifts, baseline_corr)`` where ``baseline_corr`` is
+    the ``(disp_clean, base_offsets, duty)`` triple the iterative engines
+    feed to :func:`~iterative_cleaner_tpu.ops.psrchive_baseline.template_correction`
+    under the integration mode, and ``None`` under profile mode (purely
+    hoisted templates).  Single source for the mode branch the jax/numpy
+    backends and the batched/sharded builders all need."""
+    if baseline_mode == "integration":
+        ded, shifts, disp_clean, offsets = prepare_cube_integration(
+            cube, weights, freqs_mhz, dm, ref_freq_mhz, period_s, xp,
+            baseline_duty=baseline_duty, rotation=rotation,
+            dedispersed=dedispersed)
+        return ded, shifts, (disp_clean, offsets, baseline_duty)
+    ded, shifts = prepare_cube(
+        cube, freqs_mhz, dm, ref_freq_mhz, period_s, xp,
+        baseline_duty=baseline_duty, rotation=rotation,
+        dedispersed=dedispersed, baseline_mode=baseline_mode)
+    return ded, shifts, None
+
+
+def prepare_cube_integration(cube, weights, freqs_mhz, dm, ref_freq_mhz,
+                             period_s, xp, *, baseline_duty, rotation,
+                             dedispersed=False):
+    """Integration-baseline preamble, also returning what the iterative
+    engines' per-iteration template correction needs
+    (:func:`iterative_cleaner_tpu.ops.psrchive_baseline.template_correction`):
+
+    Returns ``(ded_cube, back_shifts, disp_clean, base_offsets)`` where
+    ``disp_clean = cube - offsets`` is the baseline-removed cube in the
+    archive's own frame (before any rotation) and ``base_offsets`` the
+    (nsub, nchan) consensus levels under ``weights``.
+    """
+    from iterative_cleaner_tpu.ops.psrchive_baseline import (
+        baseline_offsets_integration,
+    )
+
+    nbin = cube.shape[-1]
+    shifts = dispersion_shift_bins(
+        xp.asarray(freqs_mhz, dtype=cube.dtype), dm, ref_freq_mhz, period_s,
+        nbin, xp,
+    )
+    offsets, _ = baseline_offsets_integration(
+        cube, xp.asarray(weights, dtype=cube.dtype), baseline_duty, xp)
+    disp_clean = cube - offsets[..., None]
+    ded = disp_clean
+    if not dedispersed:
+        ded = rotate_bins(ded, -shifts, xp, method=rotation)
+    return ded, shifts, disp_clean, offsets
 
 
 # ---------------------------------------------------------------------------

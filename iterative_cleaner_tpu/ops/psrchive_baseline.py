@@ -1,0 +1,147 @@
+"""PSRCHIVE-spec baseline estimation (the "minimum window" strategy).
+
+The reference's ``remove_baseline`` (:90,:99 of
+``/root/reference/iterative_cleaner.py``) is PSRCHIVE's
+``Archive::remove_baseline``.  Round 2 stood in a framework-defined
+per-profile min-mean window for it; this module implements the estimator
+PSRCHIVE documents, so the framework's baseline semantics match the tool
+the reference actually calls (VERDICT r2 #3, option b):
+
+1. ``Archive::remove_baseline`` delegates per subintegration to
+   ``Integration::remove_baseline``.
+2. ``Integration::remove_baseline`` computes ONE phase window per
+   integration — ``Integration::baseline()`` runs the Profile baseline
+   strategy on the integration's *total* profile (frequency-scrunched with
+   the channel weights, polarisation-scrunched) — then subtracts from
+   every channel profile that profile's own mean over the shared window
+   bins.  A channel with RFI therefore cannot drag its own window onto the
+   pulse: the window placement is a per-subint consensus.
+3. The default Profile baseline strategy is "minimum":
+   ``Pulsar::BaselineWindow`` with a ``SmoothMean`` of duty cycle 0.15
+   (``Profile::default_duty_cycle``) — smooth the profile with a circular
+   boxcar mean of width ``w = round(duty * nbin)`` bins, take the phase of
+   the smoothed minimum, and select the ``w``-bin window centred there.
+
+Conventions pinned here (and recorded in the goldens,
+tests/test_psrchive_baseline.py): ``w = max(1, round(duty * nbin))``; the
+window centred at ``c`` covers bins ``(c - w//2 + j) % nbin`` for
+``j in [0, w)``; ties in the smoothed minimum resolve to the lowest bin
+index (argmin).  The smoothed value at ``c`` is the mean over exactly that
+window, so the chosen window is the global min-mean window — the same
+quantity the legacy per-profile mode minimises, now computed once per
+subint on the weighted total profile.
+
+Everything is xp-generic (numpy / jax.numpy), static-shaped and
+trace-friendly; the cleaning engines share these functions so the oracle
+and the compiled path cannot drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def window_width(nbin: int, duty: float) -> int:
+    """``w = max(1, round(duty * nbin))`` — BaselineWindow's bin count."""
+    return max(1, int(round(duty * nbin)))
+
+
+def centred_window_means(profiles, w: int, xp):
+    """Mean of the ``w``-bin circular window centred at every bin.
+
+    ``out[..., c] = mean(profiles[..., (c - w//2 + j) % nbin], j in [0, w))``
+    — the SmoothMean profile BaselineWindow searches.  Shares the legacy
+    mode's window-sum scheme (incl. its TPU circulant-matmul fast path)
+    via :func:`iterative_cleaner_tpu.ops.dsp.circular_window_sums`.
+    """
+    from iterative_cleaner_tpu.ops.dsp import circular_window_sums
+
+    return circular_window_sums(profiles, w, xp, centred=True) / w
+
+
+def integration_window_centres(total_profiles, duty: float, xp):
+    """Per-subint smoothed-minimum bin of the (nsub, nbin) total profiles.
+
+    Ties resolve to the lowest bin (argmin), matching the goldens."""
+    w = window_width(total_profiles.shape[-1], duty)
+    sm = centred_window_means(total_profiles, w, xp)
+    return xp.argmin(sm, axis=-1)
+
+
+def baseline_offsets_integration(cube, weights, duty: float, xp):
+    """Per-(subint, channel) baseline levels under the PSRCHIVE scheme.
+
+    ``cube``: (nsub, nchan, nbin) total-intensity data (the dispersed
+    frame the reference's remove_baseline sees, :88-100).  ``weights``:
+    the (nsub, nchan) weights the integration total is scrunched with —
+    the archive the baseline runs on carries them (original weights on the
+    residual path :97-100; the previous iteration's on the template path
+    :88-94).
+
+    Returns (offsets (nsub, nchan), centres (nsub,)).
+    """
+    nbin = cube.shape[-1]
+    w = window_width(nbin, duty)
+    total = xp.einsum("sc,scb->sb", weights, cube)
+    centres = integration_window_centres(total, duty, xp)
+    # per-channel mean over the shared window = the channel's centred
+    # window mean at the integration's centre bin
+    wm = centred_window_means(cube, w, xp)          # (nsub, nchan, nbin)
+    offsets = xp.take_along_axis(
+        wm, centres[:, None, None], axis=-1)[..., 0]
+    return offsets, centres
+
+
+def remove_baseline_integration(cube, weights, duty: float, xp):
+    """Subtract the integration-consensus baseline from every profile."""
+    offsets, _ = baseline_offsets_integration(cube, weights, duty, xp)
+    return cube - offsets[..., None]
+
+
+def template_correction(disp_clean, base_offsets, weights, duty: float, xp):
+    """Per-iteration template shift for the engines' hoisted preamble.
+
+    The reference recomputes baselines on EVERY template build with the
+    *current* weights (:88-94 runs on the patient carrying the previous
+    iteration's weights), while the engines hoist one baseline removal —
+    with the *original* weights — out of the loop (the residual path's,
+    :97-100, which really is weight-invariant).  Under the integration
+    scheme the template-path baseline depends on the weights through the
+    consensus window, but only as a bin-constant per (subint, channel), so
+    the exact template is the engine's hoisted one plus a scalar:
+
+        T_exact(b) = T_engine(b) + [sum(w * V) - sum_s min_p sm_w(s, p)] / sum(w)
+
+    where ``V`` are the hoisted (original-weights) offsets,
+    ``disp_clean = cube_raw - V`` (the dispersed-frame baseline-removed
+    cube the engine keeps), and ``sm_w`` is the current-weights total
+    profile's centred-window-mean curve.  The identity uses
+    ``sum_c w*WM[s,c,p] = wm(sum_c w*cube)[s,p]`` (window means commute
+    with the weighted channel sum) and ``argmin = min`` under the sum, so
+    no (nsub, nchan, nbin) window-mean tensor is ever materialised — the
+    per-iteration cost is one pass over ``disp_clean``.
+    """
+    nbin = disp_clean.shape[-1]
+    w = window_width(nbin, duty)
+    t1 = xp.einsum("sc,scb->sb", weights, disp_clean)
+    r = xp.sum(weights * base_offsets, axis=1)       # (nsub,)
+    sm = centred_window_means(t1, w, xp) + r[:, None]
+    num = xp.sum(weights * base_offsets) - xp.sum(xp.min(sm, axis=-1))
+    den = xp.sum(weights)
+    safe = xp.where(den == 0, xp.ones_like(den), den)
+    return xp.where(den == 0, xp.zeros_like(num), num / safe)
+
+
+def template_correction_numerator_raw(cube_raw, base_offsets, weights,
+                                      duty: float, xp):
+    """Un-normalised :func:`template_correction` over a subint tile of the
+    RAW (pre-baseline) cube — the smoothed total is computed from the raw
+    weighted sum directly (``wm(sum_c w*(clean + V)) = wm(sum_c w*clean)
+    + sum_c w*V``, so the two formulations agree).  The exact streaming
+    mode accumulates these per-tile numerators and divides by the global
+    weight sum (every subint's consensus is subint-local, so tiling is
+    exact)."""
+    w = window_width(cube_raw.shape[-1], duty)
+    t1 = xp.einsum("sc,scb->sb", weights, cube_raw)
+    sm = centred_window_means(t1, w, xp)
+    return xp.sum(weights * base_offsets) - xp.sum(xp.min(sm, axis=-1))

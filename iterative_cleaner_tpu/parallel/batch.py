@@ -25,24 +25,30 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                            pulse_scale, pulse_active, rotation, baseline_duty,
                            fft_mode, median_impl="sort",
                            stats_frame="dispersed", dedispersed=False,
-                           stats_impl="xla"):
+                           stats_impl="xla", baseline_mode="profile"):
     """Jitted batched cleaner: every per-archive input gains a leading batch
     axis; scalars (dm, period, ref freq) are per-archive vectors.  The
     Pallas kernels (median/fused stats) batch through their custom_vmap
     rules — the batch folds into each launch's grid instead of vmap
     serialising the pallas_call."""
     import jax
+    import jax.numpy as jnp
 
-    from iterative_cleaner_tpu.engine.loop import (
-        clean_dedispersed_jax,
-        prepare_cube_jax,
-    )
+    from iterative_cleaner_tpu.engine.loop import clean_dedispersed_jax
 
     def one(cube, weights, freqs, dm, ref, period):
-        ded, shifts = prepare_cube_jax(
-            cube, freqs, dm, ref, period,
+        # integration mode is pure jnp ops: GSPMD/vmap partition the
+        # consensus search natively (channel contraction -> psum; the
+        # bin axis is unsharded, so window means and the per-subint
+        # argmin gather stay shard-local)
+        from iterative_cleaner_tpu.ops.dsp import (
+            prepare_cube_with_correction,
+        )
+
+        ded, shifts, baseline_corr = prepare_cube_with_correction(
+            cube, weights, freqs, dm, ref, period, jnp,
             baseline_duty=baseline_duty, rotation=rotation,
-            dedispersed=dedispersed,
+            dedispersed=dedispersed, baseline_mode=baseline_mode,
         )
         return clean_dedispersed_jax(
             ded, weights, shifts, max_iter=max_iter, chanthresh=chanthresh,
@@ -50,6 +56,7 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
             stats_frame=stats_frame, stats_impl=stats_impl,
+            baseline_corr=baseline_corr,
         )
 
     return jax.jit(jax.vmap(one))
@@ -194,6 +201,7 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
         resolve_stats_frame(config.stats_frame, dtype),
         bool(archives[0].dedispersed),
         stats_impl,
+        config.baseline_mode,
     )
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -27,14 +27,12 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
                            pulse_slice, pulse_scale, pulse_active, rotation,
                            baseline_duty, fft_mode, median_impl="sort",
                            stats_frame="dispersed", dedispersed=False,
-                           stats_impl="xla"):
+                           stats_impl="xla", baseline_mode="profile"):
     import jax
+    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from iterative_cleaner_tpu.engine.loop import (
-        clean_dedispersed_jax,
-        prepare_cube_jax,
-    )
+    from iterative_cleaner_tpu.engine.loop import clean_dedispersed_jax
 
     mesh = mesh_ref
     cube_sh = NamedSharding(mesh, P("sub", "chan", None))
@@ -46,9 +44,18 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
                           or stats_impl == "fused") else None
 
     def run(cube, weights, freqs, dm, ref, period):
-        ded, shifts = prepare_cube_jax(
-            cube, freqs, dm, ref, period, baseline_duty=baseline_duty,
-            rotation=rotation, dedispersed=dedispersed,
+        # integration mode is pure jnp ops: GSPMD/vmap partition the
+        # consensus search natively (channel contraction -> psum; the
+        # bin axis is unsharded, so window means and the per-subint
+        # argmin gather stay shard-local)
+        from iterative_cleaner_tpu.ops.dsp import (
+            prepare_cube_with_correction,
+        )
+
+        ded, shifts, baseline_corr = prepare_cube_with_correction(
+            cube, weights, freqs, dm, ref, period, jnp,
+            baseline_duty=baseline_duty, rotation=rotation,
+            dedispersed=dedispersed, baseline_mode=baseline_mode,
         )
         return clean_dedispersed_jax(
             ded, weights, shifts, max_iter=max_iter, chanthresh=chanthresh,
@@ -56,7 +63,7 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
             stats_frame=stats_frame, stats_impl=stats_impl,
-            shard_mesh=shard_mesh,
+            shard_mesh=shard_mesh, baseline_corr=baseline_corr,
         )
 
     fn = jax.jit(
@@ -119,7 +126,7 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
         config.rotation, config.baseline_duty,
         fft_mode, median_impl,
         resolve_stats_frame(config.stats_frame, dtype),
-        bool(dedispersed), stats_impl,
+        bool(dedispersed), stats_impl, config.baseline_mode,
     )
     with mesh:
         outs = fn(

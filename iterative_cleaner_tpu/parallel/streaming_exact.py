@@ -24,7 +24,12 @@ Memory: prepared tiles live in HOST RAM; the device holds one tile at a
 time (the jax path pays one H2D per tile per pass — the price of exact
 semantics on observations larger than HBM).  Cost: two passes over the
 cube per iteration (template + diagnostics) instead of the online mode's
-single pass per tile.
+single pass per tile.  Under the default INTEGRATION baseline mode the
+raw tiles are kept alongside the prepared ones (the per-iteration
+template correction smooths the current-weights raw total), doubling the
+host-RAM footprint — for observations where only one copy fits, pass
+``baseline_mode='profile'`` (or ``--baseline_mode profile``), whose
+baselines need no correction and no raw retention.
 
 Exactness: every per-cell quantity is computed by the same code as the
 whole-archive path on identical inputs; the only re-grouped reduction is
@@ -99,14 +104,28 @@ def _clean_exact_numpy(cube, weights, freqs, dm, ref_freq, period, config,
 
     cube = np.asarray(cube, dtype=np.float64)
     orig_weights = np.asarray(weights, dtype=np.float64)
+    integration = config.baseline_mode == "integration"
     ded_tiles = []
+    v_tiles = []  # per-tile consensus offsets (integration mode)
     shifts = None
     for sl in tiles:
-        ded_t, shifts = prepare_cube(
-            cube[sl], freqs, dm, ref_freq, period, np,
-            baseline_duty=config.baseline_duty, rotation=config.rotation,
-            dedispersed=dedispersed,
-        )
+        if integration:
+            from iterative_cleaner_tpu.ops.dsp import (
+                prepare_cube_integration,
+            )
+
+            # the consensus window is subint-local, so tiling is exact
+            ded_t, shifts, _, v_t = prepare_cube_integration(
+                cube[sl], orig_weights[sl], freqs, dm, ref_freq, period,
+                np, baseline_duty=config.baseline_duty,
+                rotation=config.rotation, dedispersed=dedispersed)
+            v_tiles.append(v_t)
+        else:
+            ded_t, shifts = prepare_cube(
+                cube[sl], freqs, dm, ref_freq, period, np,
+                baseline_duty=config.baseline_duty,
+                rotation=config.rotation, dedispersed=dedispersed,
+            )
         ded_tiles.append(ded_t)
     cell_mask = orig_weights == 0
 
@@ -118,7 +137,18 @@ def _clean_exact_numpy(cube, weights, freqs, dm, ref_freq, period, config,
         for sl, ded_t in zip(tiles, ded_tiles):
             num += weighted_template_numerator(ded_t, cur[sl], np)
         den = np.sum(cur)
-        template = (np.zeros_like(num) if den == 0 else num / den) * 10000.0
+        template = np.zeros_like(num) if den == 0 else num / den
+        if integration:
+            from iterative_cleaner_tpu.ops.psrchive_baseline import (
+                template_correction_numerator_raw,
+            )
+
+            corr = 0.0
+            for sl, v_t in zip(tiles, v_tiles):
+                corr += template_correction_numerator_raw(
+                    cube[sl], v_t, cur[sl], config.baseline_duty, np)
+            template = template + (0.0 if den == 0 else corr / den)
+        template = template * 10000.0
 
         # pass 2: cell-local diagnostics per tile, scalers on the full plane
         diag_tiles = []
@@ -174,17 +204,43 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool):
     stats_impl = resolve_stats_impl(config.stats_impl, dtype, nbin, fft_mode)
     stats_frame = resolve_stats_frame(config.stats_frame, dtype)
 
-    @jax.jit
-    def prep(cube_t, freqs, dm, ref_freq, period):
-        return prepare_cube_jax(
-            cube_t, freqs, dm, ref_freq, period,
-            baseline_duty=config.baseline_duty, rotation=config.rotation,
-            dedispersed=dedispersed,
-        )
+    integration = config.baseline_mode == "integration"
+
+    if integration:
+        @jax.jit
+        def prep(cube_t, w_t, freqs, dm, ref_freq, period):
+            from iterative_cleaner_tpu.ops.dsp import (
+                prepare_cube_integration,
+            )
+
+            ded_t, shifts, _, v_t = prepare_cube_integration(
+                cube_t, w_t, freqs, dm, ref_freq, period, jnp,
+                baseline_duty=config.baseline_duty,
+                rotation=config.rotation, dedispersed=dedispersed)
+            return ded_t, shifts, v_t
+    else:
+        @jax.jit
+        def prep(cube_t, w_t, freqs, dm, ref_freq, period):
+            del w_t  # per-profile windows are weight-independent
+            ded_t, shifts = prepare_cube_jax(
+                cube_t, freqs, dm, ref_freq, period,
+                baseline_duty=config.baseline_duty,
+                rotation=config.rotation, dedispersed=dedispersed,
+            )
+            return ded_t, shifts, None
 
     @jax.jit
     def template_partial(ded_t, w_t):
         return weighted_template_numerator(ded_t, w_t, jnp)
+
+    @jax.jit
+    def correction_partial(cube_t, v_t, w_t):
+        from iterative_cleaner_tpu.ops.psrchive_baseline import (
+            template_correction_numerator_raw,
+        )
+
+        return template_correction_numerator_raw(
+            cube_t, v_t, w_t, config.baseline_duty, jnp)
 
     @jax.jit
     def diag_tile(ded_t, template, w_orig_t, mask_t, shifts):
@@ -212,7 +268,7 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool):
                                    config.subintthresh, median_impl)
         return jnp.where(scores >= 1.0, 0.0, orig_weights), scores
 
-    return prep, template_partial, diag_tile, combine
+    return prep, template_partial, correction_partial, diag_tile, combine
 
 
 def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
@@ -220,9 +276,10 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
     import jax.numpy as jnp
 
     dtype = jnp.dtype(config.dtype)
+    integration = config.baseline_mode == "integration"
     chunk = tiles[0].stop - tiles[0].start
-    prep, template_partial, diag_tile, combine = _jax_tile_fns(
-        config, cube.shape[-1], bool(dedispersed))
+    prep, template_partial, correction_partial, diag_tile, combine = \
+        _jax_tile_fns(config, cube.shape[-1], bool(dedispersed))
 
     freqs_d = jnp.asarray(freqs, dtype=dtype)
     dm_d = jnp.asarray(dm, dtype=dtype)
@@ -242,31 +299,48 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
     # prepared tiles spill to HOST RAM: the device only ever holds the tile
     # being processed, so the exact mode stays usable on observations whose
     # cube exceeds HBM (each pass below pays one H2D per tile)
-    ded_tiles = []
-    shifts = None
-    for sl in tiles:
-        ded_t, shifts = prep(
-            jnp.asarray(pad_tile(np.asarray(cube[sl]).astype(dtype))),
-            freqs_d, dm_d, ref_d, per_d)
-        ded_tiles.append(np.asarray(ded_t))
-
     cell_mask_full = orig_weights == 0
     w_host = [pad_tile(orig_weights[sl]).astype(dtype) for sl in tiles]
     m_host = [pad_tile(cell_mask_full[sl]) for sl in tiles]
+    # integration mode keeps the raw tiles too: the per-iteration template
+    # correction smooths the current-weights raw total (see
+    # ops/psrchive_baseline.template_correction_numerator_raw)
+    cube_host = [pad_tile(np.asarray(cube[sl]).astype(dtype))
+                 for sl in tiles] if integration else None
+    ded_tiles = []
+    v_tiles = []
+    shifts = None
+    for i, sl in enumerate(tiles):
+        cube_t = cube_host[i] if integration \
+            else pad_tile(np.asarray(cube[sl]).astype(dtype))
+        ded_t, shifts, v_t = prep(jnp.asarray(cube_t),
+                                  jnp.asarray(w_host[i]),
+                                  freqs_d, dm_d, ref_d, per_d)
+        ded_tiles.append(np.asarray(ded_t))
+        if integration:
+            v_tiles.append(np.asarray(v_t))
     nsub = cube.shape[0]
 
     def step(cur):
+        cur_host = [pad_tile(cur[sl]).astype(dtype) for sl in tiles]
         num = None
-        for sl, ded_t in zip(tiles, ded_tiles):
-            part = template_partial(jnp.asarray(ded_t),
-                                    jnp.asarray(pad_tile(cur[sl])
-                                                .astype(dtype)))
+        corr = None
+        for i, (ded_t, w_t) in enumerate(zip(ded_tiles, cur_host)):
+            part = template_partial(jnp.asarray(ded_t), jnp.asarray(w_t))
             num = part if num is None else num + part
+            if integration:
+                cp = correction_partial(jnp.asarray(cube_host[i]),
+                                        jnp.asarray(v_tiles[i]),
+                                        jnp.asarray(w_t))
+                corr = cp if corr is None else corr + cp
         # the denominator's operand is the full (nsub, nchan) plane — never
         # tiled — so it is the same device reduction the whole path runs
         den = jnp.sum(jnp.asarray(cur.astype(dtype)))
-        template = jnp.where(den == 0, jnp.zeros_like(num),
-                             num / jnp.where(den == 0, 1.0, den)) * 10000.0
+        safe = jnp.where(den == 0, 1.0, den)
+        template = jnp.where(den == 0, jnp.zeros_like(num), num / safe)
+        if integration:
+            template = template + jnp.where(den == 0, 0.0, corr / safe)
+        template = template * 10000.0
 
         diag_tiles = [
             diag_tile(jnp.asarray(ded_t), template, jnp.asarray(w_t),

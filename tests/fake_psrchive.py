@@ -28,6 +28,7 @@ from iterative_cleaner_tpu.ops import dsp
 # cannot drift from the operator definitions the backends use.
 ROTATION_METHOD = CleanConfig.rotation
 BASELINE_DUTY = CleanConfig.baseline_duty
+BASELINE_MODE = CleanConfig.baseline_mode
 
 
 class _Epoch:
@@ -76,13 +77,14 @@ class _Profile:
 
 class FakeArchive:
     def __init__(self, ar, path="", rotation=ROTATION_METHOD,
-                 baseline_duty=BASELINE_DUTY):
-        # rotation/baseline_duty must match the CleanConfig under test:
-        # differential runs with non-default DSP knobs should pass them here
+                 baseline_duty=BASELINE_DUTY, baseline_mode=BASELINE_MODE):
+        # rotation/baseline knobs must match the CleanConfig under test:
+        # differential runs with non-default DSP settings pass them here
         self._ar = ar
         self._path = path
         self._rotation = rotation
         self._baseline_duty = baseline_duty
+        self._baseline_mode = baseline_mode
 
     # --- geometry / data ---
     def get_nsubint(self):
@@ -116,6 +118,33 @@ class FakeArchive:
         self._ar.pscrunch()
 
     def remove_baseline(self):
+        if self._baseline_mode == "integration":
+            # PSRCHIVE's Integration::remove_baseline: one consensus
+            # window per subint from the weighted total-intensity profile;
+            # every (pol, chan) profile subtracts ITS OWN mean over the
+            # shared bins (ops/psrchive_baseline module docstring).  The
+            # archive's current weights place the window — in the
+            # reference loop that means the previous iteration's weights
+            # on the template path (:88-94) and the originals on the
+            # residual path (:97-100), reproduced here for free because
+            # the script calls this method on the right clones.
+            from iterative_cleaner_tpu.ops.psrchive_baseline import (
+                centred_window_means,
+                integration_window_centres,
+                window_width,
+            )
+
+            ar = self._ar
+            w = window_width(ar.nbin, self._baseline_duty)
+            total = np.einsum("sc,scb->sb", ar.weights,
+                              ar.total_intensity())
+            centres = integration_window_centres(
+                total, self._baseline_duty, np)
+            wm = centred_window_means(ar.data, w, np)  # (s, p, c, b)
+            offsets = np.take_along_axis(
+                wm, centres[:, None, None, None], axis=-1)[..., 0]
+            ar.data = ar.data - offsets[..., None]
+            return
         self._ar.data = dsp.remove_baseline(self._ar.data, np,
                                             duty=self._baseline_duty)
 

@@ -89,6 +89,17 @@ class TestDispersion:
         expect0 = KDM_S * dm * (1300.0 ** -2 - 1400.0 ** -2) / period * nbin
         assert shifts[0] == pytest.approx(expect0)
 
+    def test_dispersion_constant_is_tempo_convention(self, xp):
+        """The delay constant is PSRCHIVE/tempo's 1/2.41e-4 s MHz^2 per
+        pc cm^-3 (the value the reference's dedisperse inherits), not the
+        CODATA derivation 4148.808.  Golden: DM=100 across 400->1400 MHz
+        delays by 1/2.41e-4 * 100 * (400^-2 - 1400^-2) s."""
+        assert KDM_S == pytest.approx(4149.377593360996, abs=1e-9)
+        freqs = xp.asarray([400.0, 1400.0])
+        shifts = np.asarray(
+            dispersion_shift_bins(freqs, 100.0, 1400.0, 1.0, 1, xp))
+        assert shifts[0] == pytest.approx(2.381658057413837, rel=1e-9)
+
     def test_dedisperse_aligns_dispersed_pulse(self, xp):
         nchan, nbin = 8, 128
         freqs = np.linspace(1300.0, 1500.0, nchan)
