@@ -17,6 +17,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import zipfile
 from typing import Optional, Tuple
 
 import numpy as np
@@ -105,10 +106,19 @@ def save_clean_checkpoint(path: str, result: CleanResult,
         arrays["loop_rfi_frac"] = np.asarray(result.loop_rfi_frac)
     if result.weight_history is not None:
         arrays["weight_history"] = result.weight_history
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez_compressed(f, **arrays)
-    os.replace(tmp, path)  # atomic: a crashed run never leaves a torn file
+    # per-writer tmp name: checkpoint dirs are legitimately shared between
+    # racing processes (batch fan-out), and a FIXED tmp name would let one
+    # writer truncate/steal another's half-written inode mid-rename
+    # (exercised by tests/test_concurrency.py); last os.replace wins and
+    # every rename is atomic, so readers never see a torn file
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):  # failed mid-write: don't litter the dir
+            os.unlink(tmp)
 
 
 def load_clean_checkpoint(path: str) -> Tuple[CleanResult, str, str]:
@@ -145,7 +155,9 @@ def load_matching_checkpoint(directory: str, in_path: str, ar: Archive,
         result, fp, cfg = load_clean_checkpoint(path)
         with np.load(path, allow_pickle=False) as z:
             stored_sig = str(z["file_sig"]) if "file_sig" in z else ""
-    except (ValueError, KeyError, OSError):
+    except (ValueError, KeyError, OSError, zipfile.BadZipFile):
+        # BadZipFile: a checkpoint caught mid-replace by a racing writer
+        # (zip magic present, directory truncated) is stale, not fatal
         return None
     if cfg != config_identity(config):
         return None
