@@ -276,7 +276,11 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=4):
              f"peak = {hbm_util:.2f} HBM utilisation")
     elif per_iter is None:
         _log("hbm_util omitted: no clean differential per-iteration time")
-    return rate, dev.platform, hbm_util, quality
+    extras = {
+        "ms_per_iter": None if per_iter is None else round(per_iter * 1e3, 2),
+        "loops": loops,
+    }
+    return rate, dev.platform, hbm_util, quality, extras
 
 
 def bench_numpy(nsub, nchan, nbin, max_iter=5):
@@ -331,10 +335,10 @@ def main():
 
     np_rate = bench_numpy(*np_cfg)
 
-    jax_rate = platform = hbm_util = quality = None
+    jax_rate = platform = hbm_util = quality = extras = None
     for cfg in (jax_cfg, (512, 4096, 128), (512, 2048, 128)):
         try:
-            jax_rate, platform, hbm_util, quality = bench_jax(*cfg)
+            jax_rate, platform, hbm_util, quality, extras = bench_jax(*cfg)
             jax_cfg = cfg
             break
         except Exception as e:  # OOM fallback ladder
@@ -366,6 +370,7 @@ def main():
         "platform": platform,
         "hbm_util": None if hbm_util is None else round(hbm_util, 3),
         "quality": quality,
+        **(extras or {}),
     }
     if platform != "tpu":
         # Dead-tunnel fallback: surface the most recent committed real-TPU

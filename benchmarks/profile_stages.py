@@ -189,6 +189,19 @@ def main():
                    (d0, d1, d2, d3), m, 5.0, 5.0, "pallas"),
                *diags, cell_mask)
 
+    # round 3: the integration baseline's per-iteration template
+    # correction — one pass over disp_clean + tiny window-mean/min work
+    from iterative_cleaner_tpu.ops.psrchive_baseline import (
+        baseline_offsets_integration,
+        template_correction,
+    )
+
+    v_offsets, _ = jax.jit(lambda c, w: baseline_offsets_integration(
+        c, w, 0.15, jnp))(cube, weights)
+    timeit("baseline correction (integration)",
+           lambda dc, v, w: template_correction(dc, v, w, 0.15, jnp),
+           cube, v_offsets, weights, passes=1)
+
     for label, median_impl, stats_impl, passes in (
             ("iteration_step (xla/sort)", "sort", "xla", 6),
             ("iteration_step (fused/pallas)", "pallas", "fused", 3)):

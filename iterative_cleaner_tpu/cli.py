@@ -151,8 +151,8 @@ def build_parser() -> argparse.ArgumentParser:
                              "tiles (parallel/streaming.py) instead of one "
                              "device footprint — for observations larger "
                              "than HBM; 0 (default) disables. Composes "
-                             "with --mesh cell (each tile sharded, "
-                             "--stream_mode online only).")
+                             "with --mesh cell (each tile sharded, either "
+                             "stream mode).")
     parser.add_argument("--stream_mode", choices=("exact", "online"),
                         default="exact",
                         help="exact (default): two-pass drift-free tiling "
@@ -485,13 +485,8 @@ def main(argv=None) -> int:
             "--stream is incompatible with --batch/--unload_res/"
             "--record_history/--checkpoint/--model quicklook "
             "(tiles do not gather residuals or histories; checkpoints are "
-            "keyed to whole-archive cleaning). --mesh cell composes "
-            "(--stream_mode online).")
-    if (args.stream > 0 and args.stream_mode == "exact"
-            and args.mesh == "cell"):
-        build_parser().error(
-            "--stream_mode exact does not support --mesh cell yet; pass "
-            "--stream_mode online for sharded tiles")
+            "keyed to whole-archive cleaning). --mesh cell composes with "
+            "either stream mode.")
 
     # Probe the default device before the first jax computation: a dead
     # accelerator tunnel otherwise hangs PJRT init forever.  Skipped when a

@@ -185,21 +185,17 @@ def clean_streaming(archive: Archive, chunk_nsub: int,
     (:func:`iterative_cleaner_tpu.parallel.streaming_exact.clean_streaming_exact`):
     masks bit-equal to whole-archive cleaning, at two cube passes per
     iteration with host-resident tiles; it needs the whole archive up
-    front, so it composes with neither the push/finish live API nor
-    (currently) a mesh.  ``mode="online"`` cleans each tile independently
-    as it fills (single pass; ~0.01-0.02% mask drift vs whole-archive
-    cleaning — module docstring)."""
+    front, so it does not compose with the push/finish live API.  With
+    ``mesh`` each tile's device work is sharded over the cell grid in
+    either mode.  ``mode="online"`` cleans each tile independently as it
+    fills (single pass; ~0.01-0.02% mask drift vs whole-archive cleaning
+    — module docstring)."""
     if mode == "exact":
-        if mesh is not None:
-            raise ValueError(
-                "mode='exact' does not support a mesh yet; use "
-                "mode='online' for sharded tiles or clean whole-archive "
-                "with --mesh cell")
         from iterative_cleaner_tpu.parallel.streaming_exact import (
             clean_streaming_exact,
         )
 
-        return clean_streaming_exact(archive, chunk_nsub, config)
+        return clean_streaming_exact(archive, chunk_nsub, config, mesh=mesh)
     if mode != "online":
         raise ValueError(f"unknown streaming mode {mode!r}")
     sc = StreamingCleaner(

@@ -16,10 +16,11 @@ Phase 3: 100 hostile-diagnostic draws against the fused scaler kernel
 (scale_and_combine median_impl='pallas' vs 'sort'): inf/NaN injections,
 zero-MAD lines, dead channels/subints — bit-identical scores required.
 
-    python tests/soak_differential.py          # ~18 min on one CPU
+    python tests/soak_differential.py          # ~30 min on one CPU
 
-Last full run 2026-07-30: phase 1 300/300 clean, phase 2 200/200 clean;
-phase 3 added round 3 (60-draw spot run clean; full run pending).
+Last full run 2026-07-30 (round 3, integration baseline default +
+34-pass adjacent-rank selection + fused scaler kernel): phase 1 300/300
+clean, phase 2 200/200 clean, phase 3 100/100 clean.
 """
 import os, sys, time
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"

@@ -293,7 +293,7 @@ def test_stream_with_cell_mesh(tmp_path, monkeypatch):
 
 def test_stream_exact_default_matches_whole(tmp_path, monkeypatch):
     """--stream's default mode is drift-free: masks identical to the
-    whole-archive run; --mesh cell without --stream_mode online errors."""
+    whole-archive run, with and without --mesh cell."""
     monkeypatch.chdir(tmp_path)
     from iterative_cleaner_tpu.io import make_synthetic_archive, save_archive
 
@@ -306,8 +306,15 @@ def test_stream_exact_default_matches_whole(tmp_path, monkeypatch):
           "-o", str(tmp_path / "exact.npz"), p])
     np.testing.assert_array_equal(
         load_archive(str(tmp_path / "exact.npz")).weights, whole)
-    with pytest.raises(SystemExit):
-        main(["-q", "--stream", "8", "--mesh", "cell", p])
+    # exact + cell mesh: sharded tile work, same drift-free masks (mask
+    # level: the sharded path runs float32 vs the float64 oracle above)
+    main(["-q", "--stream", "8", "--mesh", "cell", "--rotation", "roll",
+          "--fft_mode", "dft", "-o", str(tmp_path / "exact_mesh.npz"), p])
+    meshed = load_archive(str(tmp_path / "exact_mesh.npz")).weights
+    main(["-q", "--stream", "8", "--rotation", "roll", "--fft_mode", "dft",
+          "-o", str(tmp_path / "exact_nomesh.npz"), p])
+    np.testing.assert_array_equal(
+        load_archive(str(tmp_path / "exact_nomesh.npz")).weights, meshed)
 
 
 def test_stream_incompatible_flags(tmp_path):

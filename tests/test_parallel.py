@@ -422,14 +422,44 @@ def test_streaming_exact_rejections():
     )
 
     ar, _ = make_synthetic_archive(nsub=8, nchan=16, nbin=32, seed=1)
-    with pytest.raises(ValueError, match="mesh"):
+    with pytest.raises(ValueError, match="jax backend"):
         clean_streaming(ar, 4, CleanConfig(backend="numpy"),
                         mesh=cell_mesh(8), mode="exact")
+    with pytest.raises(ValueError, match="divide"):
+        clean_streaming_exact(ar, 3, _roll_cfg(), mesh=cell_mesh(8))
+    # oversized chunk: the REAL tile is min(chunk, nsub) — a chunk bigger
+    # than the archive must still be validated against the actual tile
+    ar5, _ = make_synthetic_archive(nsub=5, nchan=16, nbin=32, seed=2)
+    with pytest.raises(ValueError, match="divide"):
+        clean_streaming_exact(ar5, 8, _roll_cfg(), mesh=cell_mesh(8))
     with pytest.raises(ValueError, match="unload_res"):
         clean_streaming_exact(ar, 4, CleanConfig(backend="numpy",
                                                  unload_res=True))
     with pytest.raises(ValueError, match="mode"):
         clean_streaming(ar, 4, CleanConfig(backend="numpy"), mode="bogus")
+
+
+def test_streaming_exact_sharded_matches_single_device():
+    """Exact streaming over the ('sub','chan') mesh: tile work sharded,
+    masks identical to the unsharded exact run (and therefore to
+    whole-archive cleaning) — the long-observation x drift-free x
+    multi-chip composition."""
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+    from iterative_cleaner_tpu.parallel import clean_streaming_exact
+    from iterative_cleaner_tpu.parallel.mesh import cell_mesh
+
+    cfg = _roll_cfg()
+    ar, _ = make_synthetic_archive(nsub=24, nchan=16, nbin=32, seed=37,
+                                   n_rfi_cells=6, n_prezapped=10)
+    whole = clean_archive(ar.clone(), cfg)
+    single = clean_streaming_exact(ar.clone(), 8, cfg)
+    sharded = clean_streaming_exact(ar.clone(), 8, cfg, mesh=cell_mesh(8))
+    np.testing.assert_array_equal(single.final_weights,
+                                  sharded.final_weights)
+    np.testing.assert_array_equal(whole.final_weights,
+                                  sharded.final_weights)
+    assert single.loops == sharded.loops
 
 
 def test_streaming_exact_record_history():
