@@ -20,3 +20,43 @@ def test_oracle_full_rate_parses_and_matches_record():
     # The round-1 record: 273.3 s/iteration.  If the oracle is re-measured,
     # update BASELINE.md and this pin together.
     assert abs(1024 * 4096 / bench.oracle_full_rate() - 273.3) < 0.05
+
+
+def test_bench_small_end_to_end_json_schema():
+    """The driver runs `python bench.py` unattended at round end; a crash
+    or malformed JSON there loses the round's benchmark record.  Run the
+    real script in a subprocess (CPU pin, small config) and validate the
+    contract: one JSON line with the driver-read keys."""
+    import json
+    import subprocess
+    import sys
+
+    # ICLEAN_PLATFORM pinned => bench.py skips its device probe entirely
+    env = dict(os.environ, ICLEAN_PLATFORM="cpu", BENCH_SMALL="1")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    out = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "platform",
+                "quality", "ms_per_iter", "loops"):
+        assert key in out, key
+    assert out["unit"] == "cell-iters/s"
+    assert out["value"] > 0 and out["vs_baseline"] > 0
+    assert out["quality"]["precision"] is not None
+
+
+def test_tpu_validation_pass_script_parses():
+    """The queued hardware script must at least be valid sh — a typo there
+    would burn the first live-tunnel window."""
+    import subprocess
+
+    proc = subprocess.run(
+        ["sh", "-n", os.path.join(REPO, "benchmarks",
+                                  "tpu_validation_pass.sh")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
