@@ -62,6 +62,30 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
     return jax.jit(jax.vmap(one))
 
 
+# the six stacked inputs of stack_archive_batch, by rank (cube 4-D ...
+# per-archive scalars 1-D) — what the shard_map in_specs derive from
+_STACKED_NDIMS = (4, 3, 2, 1, 1, 1)
+
+
+@functools.lru_cache(maxsize=None)
+def build_batch_shardmap_fn(mesh, *build_args):
+    """The pure-('batch',)-mesh kernel route: shard_map the cached batched
+    cleaner over the batch axis (archives are independent — zero
+    collectives; each device vmap-cleans its local slice with the full
+    Pallas stack).  Cached alongside :func:`build_batched_clean_fn` so
+    repeated CLI groups reuse one compiled program."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    inner = build_batched_clean_fn(*build_args)
+    in_specs = tuple(P("batch", *([None] * (nd - 1)))
+                     for nd in _STACKED_NDIMS)
+    # every CleanOutputs leaf carries a leading batch dim, so one
+    # P('batch') prefix spec covers the whole output pytree
+    return jax.jit(jax.shard_map(inner, mesh=mesh, in_specs=in_specs,
+                                 out_specs=P("batch"), check_vma=False))
+
+
 def check_equal_shapes(archives: Sequence[Archive]) -> None:
     shapes = {(a.nsub, a.nchan, a.nbin) for a in archives}
     if len(shapes) != 1:
@@ -170,29 +194,38 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
     # same 'auto' resolution as the single-archive path: the kernels'
     # custom_vmap rules fold the batch into their launch grids, so the
     # fast paths survive batching (round 3; previously forced to 'sort').
-    # Under a device mesh the kernels stay OFF: a bare pallas_call in a
-    # GSPMD-sharded program gathers its operands onto every device (the
-    # same constraint shard_stats routes around for cell meshes; shard_map
-    # routing for the batch mesh is not built yet).
     dtype = jnp.dtype(config.dtype)
     fft_mode = resolve_fft_mode(config.fft_mode, dtype)
-    if mesh is None:
+    pure_batch = (mesh is not None
+                  and set(mesh.axis_names) == {"batch"})
+    kernel_route = pure_batch and specs is None
+    if mesh is None or kernel_route:
+        # pure ('batch',) meshes keep the kernels too: archives are
+        # independent, so a shard_map over the batch axis (below) needs no
+        # collectives — each device vmap-cleans its local archives with
+        # the full kernel stack (custom_vmap folds the LOCAL batch into
+        # each launch's grid)
         median_impl = resolve_median_impl(config.median_impl, dtype)
         stats_impl = resolve_stats_impl(config.stats_impl, dtype,
                                         archives[0].nbin, fft_mode)
     else:
+        # hybrid meshes / caller-supplied specs stay GSPMD-routed, where a
+        # bare pallas_call would all-gather the folded cubes
         if config.median_impl == "pallas" or config.stats_impl == "fused":
+            kind = ("batch mesh with custom specs" if pure_batch
+                    else "hybrid batch mesh")
             raise ValueError(
-                "explicit median_impl='pallas'/stats_impl='fused' cannot "
-                "run under a batch mesh: a bare pallas_call in the sharded "
+                f"explicit median_impl='pallas'/stats_impl='fused' cannot "
+                f"run under a {kind}: a bare pallas_call in the GSPMD "
                 "program would all-gather the folded cubes onto every "
-                "device; use 'auto' (resolves to sort/xla here) or drop "
-                "the mesh")
+                "device; use 'auto' (resolves to sort/xla here) or a pure "
+                "('batch',) mesh with default specs, which "
+                "shard_map-routes the kernels")
         median_impl = "sort" if config.median_impl == "auto" \
             else config.median_impl
         stats_impl = "xla" if config.stats_impl == "auto" \
             else config.stats_impl
-    fn = build_batched_clean_fn(
+    build_args = (
         config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
         config.rotation, config.baseline_duty,
@@ -203,6 +236,11 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
         stats_impl,
         config.baseline_mode,
     )
+    if (kernel_route
+            and (median_impl == "pallas" or stats_impl == "fused")):
+        fn = build_batch_shardmap_fn(mesh, *build_args)
+    else:
+        fn = build_batched_clean_fn(*build_args)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 

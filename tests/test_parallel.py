@@ -153,21 +153,31 @@ def test_batched_pallas_fused_matches_individual():
         assert single.loops == b.loops
 
 
-def test_batched_mesh_rejects_explicit_kernels():
-    """A bare pallas_call in the batch-mesh-sharded program would gather
-    the folded cubes onto every device; explicit pallas/fused must be
-    rejected up front, 'auto' resolves safely."""
+def test_batched_pure_mesh_runs_kernels_hybrid_rejects():
+    """Pure ('batch',) meshes shard_map-route the Pallas kernels (each
+    device vmap-cleans its local archives, zero collectives) — masks must
+    equal the unsharded kernel run.  Hybrid meshes stay GSPMD-routed,
+    where explicit pallas/fused must be rejected up front."""
+    from iterative_cleaner_tpu.backends import clean_archive
     from iterative_cleaner_tpu.config import CleanConfig
     from iterative_cleaner_tpu.parallel import (
         batch_mesh,
         clean_archives_batched,
+        hybrid_batch_cell_mesh,
     )
 
-    archives = [_mk(s) for s in range(2)]
+    archives = [_mk(s) for s in range(3)]  # 3 over 8 devices -> padded
     cfg = CleanConfig(rotation="roll", fft_mode="dft", dtype="float32",
-                      median_impl="pallas")
-    with pytest.raises(ValueError, match="batch mesh"):
-        clean_archives_batched(archives, cfg, mesh=batch_mesh(8))
+                      median_impl="pallas", stats_impl="fused")
+    batched = clean_archives_batched(archives, cfg, mesh=batch_mesh(8))
+    for ar, b in zip(archives, batched):
+        single = clean_archive(ar.clone(), cfg)
+        np.testing.assert_array_equal(single.final_weights, b.final_weights)
+        assert single.loops == b.loops
+
+    with pytest.raises(ValueError, match="hybrid"):
+        clean_archives_batched(
+            archives, cfg, mesh=hybrid_batch_cell_mesh(batch=2))
 
 
 def test_batched_rejects_ragged_shapes():
