@@ -139,7 +139,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Pipeline batch runs: load up to N archives "
                              "ahead on a background thread while the device "
                              "cleans the current one (costs N extra "
-                             "archives of host RAM; 0 = sequential).")
+                             "archives of host RAM; 0 = sequential; "
+                             "ignored when --batch B > 1, whose grouped "
+                             "loader reads each group up front instead).")
     parser.add_argument("--batch", type=int, default=0, metavar="B",
                         help="Clean runs of up to B consecutive "
                              "equal-shaped archives in one compiled vmap "

@@ -22,22 +22,29 @@ def test_oracle_full_rate_parses_and_matches_record():
     assert abs(1024 * 4096 / bench.oracle_full_rate() - 273.3) < 0.05
 
 
+def _run_repo_script(rel_path, *argv, extra_env=()):
+    """Launch a repo script in a subprocess with the CPU pin and repo
+    PYTHONPATH — the shared contract of the driver-facing entry points."""
+    import subprocess
+    import sys
+
+    # ICLEAN_PLATFORM pinned => the scripts skip their device probes
+    env = dict(os.environ, ICLEAN_PLATFORM="cpu", **dict(extra_env))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, rel_path), *argv],
+        env=env, capture_output=True, text=True, timeout=600)
+
+
 def test_bench_small_end_to_end_json_schema():
     """The driver runs `python bench.py` unattended at round end; a crash
     or malformed JSON there loses the round's benchmark record.  Run the
     real script in a subprocess (CPU pin, small config) and validate the
     contract: one JSON line with the driver-read keys."""
     import json
-    import subprocess
-    import sys
 
-    # ICLEAN_PLATFORM pinned => bench.py skips its device probe entirely
-    env = dict(os.environ, ICLEAN_PLATFORM="cpu", BENCH_SMALL="1")
-    env["PYTHONPATH"] = os.pathsep.join(
-        [REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py")],
-        env=env, capture_output=True, text=True, timeout=600)
+    proc = _run_repo_script("bench.py", extra_env=(("BENCH_SMALL", "1"),))
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, proc.stdout
@@ -48,6 +55,23 @@ def test_bench_small_end_to_end_json_schema():
     assert out["unit"] == "cell-iters/s"
     assert out["value"] > 0 and out["vs_baseline"] > 0
     assert out["quality"]["precision"] is not None
+
+
+def test_profile_stages_small_end_to_end():
+    """profile_stages.py is step 3 of the queued hardware pass; a crash
+    there (e.g. a stage signature drifting from the engine) would waste a
+    live-tunnel window.  Run it small on CPU and require every expected
+    stage row to appear (timed, below-noise, or explicitly skipped)."""
+    proc = _run_repo_script(
+        os.path.join("benchmarks", "profile_stages.py"),
+        "--nsub", "16", "--nchan", "32", "--nbin", "32",
+        "--chain", "2", "--repeats", "1")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for row in ("weighted_template", "fit_template_amplitudes",
+                "cell diagnostics (xla)", "scale_and_combine (sort)",
+                "baseline correction (integration)",
+                "iteration_step (xla/sort)", "preamble: prepare_cube"):
+        assert row in proc.stdout, (row, proc.stdout)
 
 
 def test_tpu_validation_pass_script_parses():
