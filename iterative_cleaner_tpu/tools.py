@@ -64,6 +64,44 @@ def cmd_convert(args) -> int:
     return 0
 
 
+def cmd_sweep(args) -> int:
+    """Threshold sweep: clean one archive across a chanthresh x
+    subintthresh grid and print one JSON line per point (zap fraction,
+    loops, converged).  THE operational question for a cleaner is "what
+    thresholds for this receiver?" — the reference answers it by
+    re-running the whole script per guess; here the archive loads (and
+    transfers) once for the whole grid.  Thresholds are compile-time
+    constants on the jax path, so a P-point grid pays P compiles within
+    this invocation (in-process caches only; the default 5x5 grid fits
+    the quicklook builder's 32-entry bound); --backend numpy avoids
+    compilation entirely for quick looks.
+    """
+    import numpy as np
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io import load_archive
+    from iterative_cleaner_tpu.models import get_model
+
+    ar = load_archive(args.path)
+    prezap = np.asarray(ar.weights) == 0
+    clean_fn = get_model(args.model)
+    for c in args.chanthresh:
+        for s in args.subintthresh:
+            cfg = CleanConfig(backend=args.backend, chanthresh=float(c),
+                              subintthresh=float(s), max_iter=args.max_iter)
+            # no clone: no cleaning path mutates its input archive
+            res = clean_fn(ar, cfg)
+            new = res.zap_mask() & ~prezap
+            print(json.dumps({
+                "chanthresh": float(c), "subintthresh": float(s),
+                "rfi_frac": round(res.rfi_fraction, 6),
+                "new_zap_frac": round(float(new.mean()), 6),
+                "loops": int(res.loops),
+                "converged": bool(res.converged),
+            }), flush=True)
+    return 0
+
+
 def cmd_info(args) -> int:
     """Print an archive's metadata as one JSON object (header + weights
     only; the data cube is never read)."""
@@ -205,6 +243,22 @@ def main(argv=None) -> int:
     p = sub.add_parser("info", help="print archive metadata as JSON")
     p.add_argument("path")
     p.set_defaults(fn=cmd_info)
+
+    p = sub.add_parser("sweep",
+                       help="clean one archive across a chanthresh x "
+                            "subintthresh grid; one JSON line per point "
+                            "(zap fractions, loops) — pick thresholds "
+                            "without re-running the CLI per guess")
+    p.add_argument("path")
+    p.add_argument("-c", "--chanthresh", type=float, nargs="+",
+                   default=[3.0, 4.0, 5.0, 6.0, 8.0])
+    p.add_argument("-s", "--subintthresh", type=float, nargs="+",
+                   default=[3.0, 4.0, 5.0, 6.0, 8.0])
+    p.add_argument("-m", "--max_iter", type=int, default=5)
+    p.add_argument("--backend", choices=("jax", "numpy"), default="jax")
+    p.add_argument("--model", choices=("surgical_scrub", "quicklook"),
+                   default="surgical_scrub")
+    p.set_defaults(fn=cmd_sweep)
 
     p = sub.add_parser("selftest",
                        help="end-to-end installation check: clean a "

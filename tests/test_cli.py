@@ -420,6 +420,33 @@ class TestTools:
         d = json.loads(capsys.readouterr().out)
         assert d["changed"] == 1 and d["newly_zapped"] == 1
 
+    def test_sweep_grid(self, tmp_path, monkeypatch, capsys):
+        """tools sweep: one JSON row per grid point; zap fraction is
+        monotone non-increasing in the thresholds (a sanity property of
+        the detector) and every row matches a direct clean."""
+        import json
+
+        from iterative_cleaner_tpu.backends import clean_archive
+        from iterative_cleaner_tpu.config import CleanConfig
+        from iterative_cleaner_tpu.tools import main as tools_main
+
+        monkeypatch.chdir(tmp_path)
+        ar, _ = make_synthetic_archive(nsub=8, nchan=16, nbin=32, seed=5,
+                                       n_rfi_cells=4, n_prezapped=6)
+        save_archive(ar, "o.npz")
+        assert tools_main(["sweep", "o.npz", "--backend", "numpy",
+                           "-c", "3", "8", "-s", "4"]) == 0
+        rows = [json.loads(ln) for ln in
+                capsys.readouterr().out.strip().splitlines()]
+        assert len(rows) == 2
+        assert rows[0]["rfi_frac"] >= rows[1]["rfi_frac"]  # c=3 vs c=8
+        want = clean_archive(
+            ar.clone(), CleanConfig(backend="numpy", chanthresh=8.0,
+                                    subintthresh=4.0))
+        assert rows[1]["rfi_frac"] == round(
+            float((want.final_weights == 0).mean()), 6)
+        assert rows[1]["loops"] == want.loops
+
     def test_diff_checkpoints(self, tmp_path, monkeypatch, capsys):
         import json
 
