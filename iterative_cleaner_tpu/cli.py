@@ -19,7 +19,6 @@ import os
 import sys
 
 from iterative_cleaner_tpu import io as ar_io
-from iterative_cleaner_tpu.backends import clean_archive
 from iterative_cleaner_tpu.config import CleanConfig
 
 

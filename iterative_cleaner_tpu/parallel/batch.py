@@ -11,7 +11,7 @@ sharding is the final result gather.
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 import numpy as np
 
