@@ -376,6 +376,10 @@ def main():
         # Dead-tunnel fallback: surface the most recent committed real-TPU
         # capture (benchmarks/measured/) so a CPU-platform record is never
         # mistaken for "no TPU number exists".
+        out["note"] = (
+            "off-TPU fallback; round-3 kernel/semantics changes await "
+            "hardware numbers — run benchmarks/tpu_validation_pass.sh on "
+            "a live chip (BASELINE.md 'Round-3 note' explains comparisons)")
         cap_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "benchmarks", "measured")
         try:
