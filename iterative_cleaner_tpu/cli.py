@@ -121,6 +121,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "state is saved there, and re-runs reuse "
                              "checkpoints whose input content and config "
                              "still match (batch resume).")
+    parser.add_argument("--compile_cache", type=str, default="",
+                        metavar="DIR",
+                        help="Persistent jax compilation cache directory: "
+                             "repeat invocations (sweeps, nightly batches) "
+                             "skip the 20-40s TPU compiles. Also settable "
+                             "as ICLEAN_COMPILE_CACHE for any entry point.")
     parser.add_argument("--record_history", action="store_true",
                         help="Keep every iteration's weight matrix in the "
                              "result/checkpoint (regression diffing).")
@@ -446,6 +452,7 @@ def main(argv=None) -> int:
     from iterative_cleaner_tpu.utils import (
         apply_platform_override,
         device_reachable,
+        enable_compile_cache,
     )
 
     if args.batch > 1 and (args.unload_res or args.checkpoint
@@ -508,6 +515,7 @@ def main(argv=None) -> int:
               "(set ICLEAN_PLATFORM to override)", file=sys.stderr)
         os.environ["ICLEAN_PLATFORM"] = "cpu"
     apply_platform_override()
+    enable_compile_cache(args.compile_cache)
     from iterative_cleaner_tpu.utils.tracing import device_trace
 
     failed = []

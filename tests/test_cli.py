@@ -155,6 +155,47 @@ def test_prefetch_keep_going_isolates_bad_archive(tmp_path, monkeypatch,
     assert "ERROR cleaning" in capsys.readouterr().err
 
 
+def test_compile_cache_populates_and_cross_process_reload(tmp_path,
+                                                          monkeypatch):
+    """--compile_cache DIR: the first run writes compiled programs into
+    the persistent cache, and a FRESH PROCESS reloading from it (the
+    whole point — in-process runs would hit the jit cache anyway)
+    produces identical masks.  On a real TPU the reload skips the 20-40s
+    remote compiles."""
+    import subprocess
+    import sys
+
+    monkeypatch.chdir(tmp_path)
+    ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=0)
+    save_archive(ar, "o.npz")
+    cache = str(tmp_path / "jitcache")
+
+    # both legs run in FRESH processes: in-process, jax's in-memory jit
+    # cache (warmed by earlier tests compiling these very shapes) would
+    # skip compilation entirely and never touch the persistent cache —
+    # and an in-process jax.config.update would leak into later tests
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, ICLEAN_PLATFORM="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+
+    def run(out_name):
+        return subprocess.run(
+            [sys.executable, "-m", "iterative_cleaner_tpu", "-q", "-l",
+             "--compile_cache", cache, "-o", out_name, "o.npz"],
+            env=env, cwd=str(tmp_path), capture_output=True, text=True,
+            timeout=300)
+
+    proc = run("first.npz")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert os.listdir(cache), "persistent compilation cache stayed empty"
+    proc = run("second.npz")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    np.testing.assert_array_equal(
+        np.asarray(load_archive("second.npz").weights),
+        np.asarray(load_archive("first.npz").weights))
+
+
 def test_platform_env_override(tmp_path, monkeypatch):
     """ICLEAN_PLATFORM forces the jax platform (no-op here since conftest
     already pinned cpu, but the path must parse and clean successfully)."""
