@@ -126,7 +126,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Persistent jax compilation cache directory: "
                              "repeat invocations (sweeps, nightly batches) "
                              "skip the 20-40s TPU compiles. Also settable "
-                             "as ICLEAN_COMPILE_CACHE for any entry point.")
+                             "as ICLEAN_COMPILE_CACHE for any entry point. "
+                             "jax backend only (numpy never compiles).")
     parser.add_argument("--record_history", action="store_true",
                         help="Keep every iteration's weight matrix in the "
                              "result/checkpoint (regression diffing).")
@@ -482,6 +483,10 @@ def main(argv=None) -> int:
         build_parser().error(
             "--mesh batch shards the --batch groups over devices; pass "
             "--batch B (B > 1) and --backend jax")
+    if args.compile_cache and args.backend != "jax":
+        # numpy never compiles jax programs — a silently useless cache
+        # would mislead; the other ineffective flag combos error loudly too
+        build_parser().error("--compile_cache requires --backend jax")
     if args.stream < 0:
         build_parser().error(
             f"--stream must be a positive tile size (0 disables), got "

@@ -24,3 +24,15 @@ import jax  # noqa: E402
 # with JAX_PLATFORMS pointing at a TPU tunnel.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def repo_subprocess_env(**extra):
+    """Environment for tests that launch repo entry points in fresh
+    processes: repo on PYTHONPATH (prepended, existing entries kept) and
+    the CPU pin so nothing touches the accelerator tunnel.  One place to
+    fix launch-contract changes (several test modules share this)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, ICLEAN_PLATFORM="cpu", **extra)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    return env

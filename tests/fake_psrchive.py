@@ -233,9 +233,14 @@ class FakeArchive:
     def clone(self):
         import copy
 
+        # forward EVERY DSP knob: the reference's loop works entirely on
+        # clones (:71,:97,:124), so a knob dropped here silently reverts
+        # those clones to the defaults mid-run (caught for baseline_mode
+        # by the profile-mode differential soak, round 3)
         return type(self)(copy.deepcopy(self._ar), self._path,
                           rotation=self._rotation,
-                          baseline_duty=self._baseline_duty)
+                          baseline_duty=self._baseline_duty,
+                          baseline_mode=self._baseline_mode)
 
     def unload(self, path):
         save_archive(self._ar, path)

@@ -29,9 +29,9 @@ def _run_repo_script(rel_path, *argv, extra_env=()):
     import sys
 
     # ICLEAN_PLATFORM pinned => the scripts skip their device probes
-    env = dict(os.environ, ICLEAN_PLATFORM="cpu", **dict(extra_env))
-    env["PYTHONPATH"] = os.pathsep.join(
-        [REPO] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    from tests.conftest import repo_subprocess_env
+
+    env = repo_subprocess_env(**dict(extra_env))
     return subprocess.run(
         [sys.executable, os.path.join(REPO, rel_path), *argv],
         env=env, capture_output=True, text=True, timeout=600)

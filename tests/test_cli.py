@@ -174,10 +174,9 @@ def test_compile_cache_populates_and_cross_process_reload(tmp_path,
     # cache (warmed by earlier tests compiling these very shapes) would
     # skip compilation entirely and never touch the persistent cache —
     # and an in-process jax.config.update would leak into later tests
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ, ICLEAN_PLATFORM="cpu")
-    env["PYTHONPATH"] = os.pathsep.join(
-        [repo] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    from tests.conftest import repo_subprocess_env
+
+    env = repo_subprocess_env()
 
     def run(out_name):
         return subprocess.run(

@@ -132,10 +132,9 @@ rc = main(["-q", "-l", "--backend", "numpy", "--checkpoint", sys.argv[1],
            "-o", sys.argv[2]] + sys.argv[3:])
 sys.exit(rc)
 """
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [repo] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    from tests.conftest import repo_subprocess_env
+
+    env = repo_subprocess_env()
     procs = []
     for tag in ("a", "b"):
         outdir = tmp_path / f"out_{tag}"

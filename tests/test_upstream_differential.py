@@ -136,6 +136,22 @@ def test_final_weights_match_upstream(upstream, name, gen_kw, arg_kw):
     np.testing.assert_array_equal(res.final_weights, ref_weights)
 
 
+def test_profile_baseline_mode_matches_upstream(upstream):
+    """The legacy per-profile baseline mode, end to end against the
+    upstream script with a profile-mode fake.  Regression for the round-3
+    find that FakeArchive.clone() silently dropped baseline_mode — the
+    reference's loop works entirely on clones, so the dropped knob made
+    every 'profile' differential secretly mixed-mode."""
+    for seed in (31, 32, 33):
+        ar, _ = make_synthetic_archive(seed=seed, n_prezapped=6)
+        args = ref_args()
+        ref_weights = run_upstream(upstream, ar, args,
+                                   baseline_mode="profile")
+        res = clean_archive(
+            ar.clone(), _config_from_args(args, baseline_mode="profile"))
+        np.testing.assert_array_equal(res.final_weights, ref_weights)
+
+
 def test_roll_rotation_matches_upstream(upstream):
     """Non-default DSP knob: nearest-bin roll dedispersion on both sides."""
     ar, _ = make_synthetic_archive(seed=13)
