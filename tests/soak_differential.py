@@ -77,9 +77,12 @@ for trial in range(300):
         pulse_region=pulse_region,
         bad_chan=float(rng.choice([1.0, rng.uniform(0.2, 0.9)])),
         bad_subint=float(rng.choice([1.0, rng.uniform(0.2, 0.9)])))
+    # alternate baseline estimators so BOTH modes soak (the round-3 clone
+    # bug hid profile-mode drift precisely because only the default ran)
+    bmode = "integration" if rng.random() < 0.5 else "profile"
     try:
-        ref_w = T.run_upstream(upstream, ar, args)
-        cfg = T._config_from_args(args)
+        ref_w = T.run_upstream(upstream, ar, args, baseline_mode=bmode)
+        cfg = T._config_from_args(args, baseline_mode=bmode)
         res_np = clean_archive(ar.clone(), cfg)
         assert np.array_equal(res_np.final_weights, ref_w), "numpy vs upstream"
         import dataclasses
