@@ -350,12 +350,14 @@ def test_streaming_vs_whole_mask_drift_bounded():
     assert worst > 0  # the populations DO differ; zero would mean a no-op test
 
 
-@pytest.mark.parametrize("backend,dtype", [
-    ("numpy", None), ("jax", "float64"), ("jax", "float32")])
-def test_streaming_exact_masks_bit_equal_to_whole(backend, dtype):
+@pytest.mark.parametrize("backend,dtype,bmode", [
+    ("numpy", None, "integration"), ("jax", "float64", "integration"),
+    ("jax", "float32", "integration"), ("numpy", None, "profile"),
+    ("jax", "float64", "profile")])
+def test_streaming_exact_masks_bit_equal_to_whole(backend, dtype, bmode):
     """The two-pass exact mode (VERDICT r2 #4): masks bit-equal to
-    whole-archive cleaning on every backend — including geometries with a
-    padded partial final tile."""
+    whole-archive cleaning on every backend and both baseline estimators
+    — including geometries with a padded partial final tile."""
     from iterative_cleaner_tpu.backends import clean_archive
     from iterative_cleaner_tpu.config import CleanConfig
     from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
@@ -366,7 +368,7 @@ def test_streaming_exact_masks_bit_equal_to_whole(backend, dtype):
         ar, _ = make_synthetic_archive(
             nsub=nsub, nchan=24, nbin=64, seed=seed, n_rfi_cells=12,
             n_rfi_channels=2, n_rfi_subints=3, n_prezapped=20)
-        cfg = CleanConfig(backend=backend, **kw)
+        cfg = CleanConfig(backend=backend, baseline_mode=bmode, **kw)
         whole = clean_archive(ar.clone(), cfg)
         ex = clean_streaming_exact(ar.clone(), chunk, cfg)
         np.testing.assert_array_equal(whole.final_weights, ex.final_weights)
