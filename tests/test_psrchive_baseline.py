@@ -167,6 +167,43 @@ def test_modes_actually_differ_and_integration_matches_upstream():
     np.testing.assert_array_equal(integ.final_weights, want)
 
 
+def test_template_correction_identity_random():
+    """The engine's hoisted-template + scalar-correction form must equal
+    the literal reference recomputation (baseline with CURRENT weights,
+    then weighted template) for random cubes/weights/duties — the
+    algebraic heart of the integration mode (template_correction
+    docstring), checked to float64 precision."""
+    from iterative_cleaner_tpu.ops.dsp import (
+        prepare_cube_integration,
+        weighted_template,
+    )
+    from iterative_cleaner_tpu.ops.psrchive_baseline import (
+        remove_baseline_integration,
+        template_correction,
+    )
+    from iterative_cleaner_tpu.ops import dsp
+
+    rng = np.random.default_rng(29)
+    for trial in range(6):
+        nsub = int(rng.integers(2, 10))
+        nchan = int(rng.integers(2, 12))
+        nbin = int(rng.choice([8, 16, 32]))
+        duty = float(rng.choice([0.1, 0.15, 0.3]))
+        cube = rng.normal(size=(nsub, nchan, nbin)) * 10 + 50
+        freqs = np.linspace(1300, 1500, nchan)
+        w0 = (rng.random((nsub, nchan)) > 0.2).astype(float)
+        w_cur = np.where(rng.random((nsub, nchan)) < 0.15, 0.0, w0)
+        ded, shifts, disp_clean, V = prepare_cube_integration(
+            cube, w0, freqs, 26.76, 1400.0, 0.714, np,
+            baseline_duty=duty, rotation="roll")
+        engine = (weighted_template(ded, w_cur, np)
+                  + template_correction(disp_clean, V, w_cur, duty, np))
+        lit_clean = remove_baseline_integration(cube, w_cur, duty, np)
+        lit_ded = dsp.rotate_bins(lit_clean, -shifts, np, method="roll")
+        literal = weighted_template(lit_ded, w_cur, np)
+        np.testing.assert_allclose(engine, literal, rtol=1e-11, atol=1e-9)
+
+
 def test_window_avoids_pulse():
     """A strong pulse pushes the consensus window off-pulse in every
     channel, even channels where noise would have misplaced a per-profile
