@@ -338,7 +338,13 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
         return np.concatenate(
             [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
 
-    orig_weights = np.asarray(weights, dtype=np.float64)
+    # seed with the *dtype-round-tripped* weights: the device computes (and
+    # step() returns) weights that went through `dtype`, so the convergence
+    # comparison in _run_iterations must see those same values — raw f64
+    # weights that aren't exactly dtype-representable would never match the
+    # first returned matrix and overcount loops by one
+    orig_weights = np.asarray(
+        np.asarray(weights, dtype=np.float64).astype(dtype), dtype=np.float64)
     # prepared tiles spill to HOST RAM: the device only ever holds the tile
     # being processed, so the exact mode stays usable on observations whose
     # cube exceeds HBM (each pass below pays one H2D per tile)

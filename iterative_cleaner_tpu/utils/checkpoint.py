@@ -17,6 +17,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import threading
 import zipfile
 from typing import Optional, Tuple
 
@@ -109,9 +110,11 @@ def save_clean_checkpoint(path: str, result: CleanResult,
     # per-writer tmp name: checkpoint dirs are legitimately shared between
     # racing processes (batch fan-out), and a FIXED tmp name would let one
     # writer truncate/steal another's half-written inode mid-rename
-    # (exercised by tests/test_concurrency.py); last os.replace wins and
-    # every rename is atomic, so readers never see a torn file
-    tmp = f"{path}.{os.getpid()}.tmp"
+    # (exercised by tests/test_concurrency.py); the thread ident covers
+    # same-process library callers saving one path from several threads,
+    # which the PID alone would not; last os.replace wins and every rename
+    # is atomic, so readers never see a torn file
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
     try:
         with open(tmp, "wb") as f:
             np.savez_compressed(f, **arrays)

@@ -186,3 +186,45 @@ def test_prefetch_shutdown_never_leaks_thread(tmp_path, monkeypatch, trial):
             break
         time.sleep(0.05)
     assert not leaked, [t.name for t in leaked]
+
+
+def test_checkpoint_same_path_thread_contention(tmp_path):
+    """Same-process threads saving ONE checkpoint path concurrently
+    (ADVICE r3): the tmp name must be unique per writer *thread*, not
+    just per PID, or two threads truncate each other's half-written tmp
+    file mid-write and the final rename can publish a torn npz."""
+    from iterative_cleaner_tpu.utils import checkpoint as ck
+
+    ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=3,
+                                   n_rfi_cells=3)
+    cfg = CleanConfig(backend="numpy")
+    res = clean_archive(ar.clone(), cfg)
+    fp = ck.fingerprint_archive(ar)
+    path = ck.checkpoint_path(str(tmp_path), "shared")
+
+    start = threading.Barrier(4)
+    errors = []
+
+    def writer():
+        try:
+            start.wait(timeout=30)
+            for _ in range(25):
+                ck.save_clean_checkpoint(path, res, cfg, fp)
+                # every published state must be a complete, readable file
+                back, fp2, _ = ck.load_clean_checkpoint(path)
+                assert fp2 == fp
+                np.testing.assert_array_equal(back.final_weights,
+                                              res.final_weights)
+        except Exception as e:  # surfaced below; thread death would hang
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "writer deadlocked"
+    assert not errors, errors
+    # no stray tmp litter once every writer has finished
+    leftovers = [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    assert not leftovers, leftovers

@@ -531,3 +531,31 @@ def test_streaming_sharded_matches_single_device():
                                   sharded2.final_weights)
     # a mostly-alive archive must not be wiped by padding-skewed sweeps
     assert (single2.final_weights != 0).any()
+
+
+def test_streaming_exact_non_f32_weights_loop_count(monkeypatch):
+    """ADVICE r3: weights like 0.1 are not exactly float32-representable.
+    The exact jax path's convergence history must be seeded with the
+    dtype-ROUND-TRIPPED weights (the values the device actually computes
+    with); seeding raw float64 weights would make the first-loop cycle
+    match impossible and report loops one higher than the whole-archive
+    f32 engine whenever nothing gets zapped."""
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+    from iterative_cleaner_tpu.parallel import clean_streaming_exact
+
+    ar, _ = make_synthetic_archive(nsub=48, nchan=16, nbin=32, seed=31,
+                                   n_rfi_cells=0, n_rfi_channels=0,
+                                   n_rfi_subints=0)
+    ar.weights[ar.weights > 0] = 0.1  # f64(0.1) != f64(f32(0.1))
+    # thresholds high enough that pure noise never zaps: the mask is
+    # unchanged after loop 1, so cycle detection must fire immediately
+    cfg = CleanConfig(backend="jax", dtype="float32",
+                      chanthresh=50.0, subintthresh=50.0)
+    whole = clean_archive(ar.clone(), cfg)
+    ex = clean_streaming_exact(ar.clone(), 16, cfg)
+    assert whole.converged and ex.converged
+    assert whole.loops == 1
+    assert ex.loops == whole.loops
+    np.testing.assert_array_equal(whole.final_weights, ex.final_weights)
