@@ -67,3 +67,29 @@ for SBLK in 8 16 32; do for CSCALE in 1 2; do
   ICLEAN_FUSED_SBLK=$SBLK ICLEAN_FUSED_CBLK_SCALE=$CSCALE \
     python benchmarks/profile_stages.py || true
 done; done > "benchmarks/measured/tier_sweep_${STAMP}.txt" 2>&1
+
+# 5b. (round 4) Tier-STRATEGY A/B (VERDICT r3 #4): the "sublane" strategy
+#     keeps a full 128-lane channel tile and shrinks the subint block,
+#     attacking the 512-bin falloff (155 GB/s fused vs 326 XLA in the
+#     round-2 capture).  Interpret parity is already pinned
+#     (tests/test_pallas_stats.py::TestSublaneTier); this measures it.
+#     Keep whichever "cell diagnostics (fused pallas)" rows win and record
+#     the choice in BASELINE.md; if sublane wins broadly, flip the default
+#     _TIER in stats/pallas_kernels.py.
+{ for TIER in cell sublane; do
+    echo "=== TIER=$TIER (nbin 512) ==="
+    ICLEAN_FUSED_TIER=$TIER python benchmarks/profile_stages.py \
+      --nbin 512 --nchan 1024 || true
+    echo "=== TIER=$TIER (nbin 2048) ==="
+    ICLEAN_FUSED_TIER=$TIER python benchmarks/profile_stages.py \
+      --nbin 2048 --nchan 256 || true
+  done
+} > "benchmarks/measured/tier_strategy_ab_${STAMP}.txt" 2>&1
+
+# 6. (round 4) Full-size mask parity on hardware (VERDICT r3 #2): the
+#    committed golden is the float64 oracle's mask; the TPU float32 path
+#    must reproduce it bit-for-bit for every kernel variant.
+{ python benchmarks/fullsize_golden.py check --variant fused || true
+  python benchmarks/fullsize_golden.py check --variant pallas || true
+  python benchmarks/fullsize_golden.py check --variant xla || true
+} > "benchmarks/measured/fullsize_parity_tpu_${STAMP}.txt" 2>&1

@@ -312,11 +312,21 @@ def _median_axis0(values, mask, interpret):
 # hardware tier sweep (benchmarks/tpu_validation_pass.sh step 5): larger
 # blocks mean more rows per DFT matmul — better MXU utilisation at long
 # nbin where the C_BLK tiers shrink — until the VMEM budget trips the
-# Mosaic compile.  Only the default has been hardware-validated.
+# Mosaic compile.  Only the "cell" default has been hardware-validated.
 import os as _os
 
-_S_BLK = int(_os.environ.get("ICLEAN_FUSED_SBLK", "8"))
+_S_BLK = _os.environ.get("ICLEAN_FUSED_SBLK", "")
 _C_BLK_SCALE = int(_os.environ.get("ICLEAN_FUSED_CBLK_SCALE", "1"))
+# tier strategy (VERDICT r3 #4): how the cell block sheds VMEM as profiles
+# lengthen.  "cell" (default, hardware-validated) keeps S_BLK=8 and shrinks
+# the CHANNEL block — the round-2 capture shows it falling to 155 GB/s at
+# 512 bins (vs XLA's 326) as the lane-dim tiles go half-empty.  "sublane"
+# keeps the channel block at one full 128-lane tile and sheds VMEM by
+# shrinking the SUBINT block instead, holding cells-per-step (and so VMEM)
+# equal to the "cell" tier at every nbin; the DFT matmul row count is
+# unchanged, only the block aspect ratio moves.  Interpret-tested for
+# parity at every tier; the A/B lives in tpu_validation_pass.sh step 5b.
+_TIER = _os.environ.get("ICLEAN_FUSED_TIER", "cell")
 
 
 def _cell_blocks(nbin: int):
@@ -324,9 +334,11 @@ def _cell_blocks(nbin: int):
 
     VMEM per step scales as ``S_BLK * C_BLK * nbin`` (two cube blocks +
     the flat intermediates) on top of the O(nbin^2) DFT tables, so the
-    channel block shrinks as profiles lengthen — the footprint stays
+    cell block shrinks as profiles lengthen — the footprint stays
     roughly flat from 256 to 1024 bins (measured on a v5e: C_BLK=128
-    overflows VMEM at 512 bins, these tiers compile and run at all sizes).
+    with S_BLK=8 overflows VMEM at 512 bins, these tiers compile and run
+    at all sizes).  Which *axis* shrinks is the ``ICLEAN_FUSED_TIER``
+    strategy above.
 
     This is deliberately cell-axis tiling, not bin-axis tiling: the
     closed-form amplitude needs a full-bin reduction *before* the residual
@@ -344,6 +356,24 @@ def _cell_blocks(nbin: int):
     dim.  Cube blocks are unaffected: their last dim is the whole bin
     axis, and C_BLK sits second-to-last where a multiple of 8 suffices.
     """
+    if _TIER == "sublane":
+        # full 128-lane channel tile at every nbin; subint block sheds the
+        # VMEM.  Cells-per-step match the "cell" tiers (512/256/128) except
+        # at 4096 bins, where the channel block drops to 64 so the flat
+        # (S*C, nbin) intermediates stay within the "cell" tier's budget.
+        if nbin <= 256:
+            s, c = 8, 128
+        elif nbin <= 512:
+            s, c = 4, 128
+        elif nbin <= 1024:
+            s, c = 2, 128
+        elif nbin <= 2048:
+            s, c = 1, 128
+        else:
+            s, c = 1, 64
+        if _S_BLK:
+            s = int(_S_BLK)
+        return s, c
     if nbin <= 256:
         c = 128
     elif nbin <= 512:
@@ -354,10 +384,11 @@ def _cell_blocks(nbin: int):
         c = 16
     else:
         c = 8
-    # the sweep knob multiplies the tier (capped at one lane tile); padding
-    # keeps correctness for any block shape, so the sweep is purely a
-    # compile-legality + throughput question
-    return _S_BLK, min(128, c * max(1, _C_BLK_SCALE))
+    # the sweep knobs override/multiply the tier (capped at one lane
+    # tile); padding keeps correctness for any block shape, so the sweep
+    # is purely a compile-legality + throughput question
+    return (int(_S_BLK) if _S_BLK else 8), \
+        min(128, c * max(1, _C_BLK_SCALE))
 
 
 def _k_chunk(nbin: int, nk_pad: int) -> int:
@@ -500,11 +531,14 @@ class _FusedScaffold:
     the batched engine (parallel/batch.py) keeps the fused kernel instead
     of letting ``vmap`` serialise the pallas_call."""
 
-    def __init__(self, nsub, nchan, nbin, num_k, batch=1):
+    def __init__(self, nsub, nchan, nbin, num_k, batch=1, blocks=None):
         self.batch = batch
         self.nsub, self.nchan, self.nbin = nsub, nchan, nbin
         self.num_k = num_k
-        s_blk, c_blk = _cell_blocks(nbin)
+        # blocks arrives as a STATIC jit argument from the callers (so a
+        # tier-strategy change can never hit a stale jit cache entry keyed
+        # only on shapes); None keeps the env-selected tier for direct use
+        s_blk, c_blk = blocks or _cell_blocks(nbin)
         self.c_blk = c_blk
         self.pad_s = (-nsub) % s_blk
         self.pad_c = (-nchan) % c_blk
@@ -584,12 +618,14 @@ class _FusedScaffold:
             for o in outs)
 
 
-@functools.partial(jax.jit, static_argnames=("num_k", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_k", "interpret", "blocks"))
 def _cell_stats_call(ded, disp_base, rot_t, template, tt_info, weights,
-                     cell_mask, cos_t, sin_t, num_k, interpret):
+                     cell_mask, cos_t, sin_t, num_k, interpret, blocks):
     """Batched-shape launch: ded/disp (B, S, C, nbin), rot_t (B, C, nbin),
     template/tt per archive; B archives fold into one grid."""
-    sc = _FusedScaffold(*ded.shape[1:], num_k, batch=ded.shape[0])
+    sc = _FusedScaffold(*ded.shape[1:], num_k, batch=ded.shape[0],
+                        blocks=blocks)
     weights, cell_mask = sc.pad_cells(weights, cell_mask)
     return sc.launch(
         _cell_stats_kernel,
@@ -645,7 +681,8 @@ def _fused_dispersed_batched(ded, disp_base, rot_t, template, weights,
     return _cell_stats_call(ded, disp_base, rot_t, template,
                             _tt_info(template),
                             weights.astype(jnp.float32), cell_mask,
-                            cos_t, sin_t, num_k, interpret)
+                            cos_t, sin_t, num_k, interpret,
+                            _cell_blocks(ded.shape[-1]))
 
 
 from jax.custom_batching import custom_vmap  # noqa: E402
@@ -680,10 +717,13 @@ def cell_diagnostics_pallas(ded, disp_base, rot_t, template, weights,
                             weights.astype(jnp.float32), cell_mask)
 
 
-@functools.partial(jax.jit, static_argnames=("num_k", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_k", "interpret", "blocks"))
 def _cell_stats_dedisp_call(ded, template, window, tt_info, weights,
-                            cell_mask, cos_t, sin_t, num_k, interpret):
-    sc = _FusedScaffold(*ded.shape[1:], num_k, batch=ded.shape[0])
+                            cell_mask, cos_t, sin_t, num_k, interpret,
+                            blocks):
+    sc = _FusedScaffold(*ded.shape[1:], num_k, batch=ded.shape[0],
+                        blocks=blocks)
     weights, cell_mask = sc.pad_cells(weights, cell_mask)
     return sc.launch(
         _cell_stats_dedisp_kernel,
@@ -698,7 +738,8 @@ def _fused_dedisp_batched(ded, template, window, weights, cell_mask):
     return _cell_stats_dedisp_call(ded, template, window,
                                    _tt_info(template),
                                    weights.astype(jnp.float32), cell_mask,
-                                   cos_t, sin_t, num_k, interpret)
+                                   cos_t, sin_t, num_k, interpret,
+                                   _cell_blocks(ded.shape[-1]))
 
 
 @custom_vmap
