@@ -1,0 +1,55 @@
+"""One-off probe: WHERE and WHY the full-size f32 jax mask diverges from
+the f64 oracle (found 2026-07-30 by benchmarks/fullsize_golden.py: 2 cells
+of 4.19M).  Runs both backends with score/history capture and reports each
+differing cell's scores and per-loop membership."""
+
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    from iterative_cleaner_tpu.utils import fallback_to_cpu_if_unreachable
+
+    fallback_to_cpu_if_unreachable("BENCH_PROBE_TIMEOUT")
+
+    from benchmarks.fullsize_golden import make_fullsize_archive
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.config import CleanConfig
+
+    ar = make_fullsize_archive()
+    out = {}
+    for name, cfg in (
+        ("numpy", CleanConfig(backend="numpy", record_history=True)),
+        ("jax", CleanConfig(backend="jax", dtype="float32",
+                            median_impl="sort", stats_impl="xla",
+                            stats_frame="dispersed", record_history=True)),
+    ):
+        res = clean_archive(ar.clone(), cfg)
+        out[name] = res
+        print(f"{name}: loops={res.loops} zap={int((res.final_weights == 0).sum())}",
+              flush=True)
+
+    m64 = out["numpy"].final_weights == 0
+    m32 = out["jax"].final_weights == 0
+    diff = np.argwhere(m64 != m32)
+    print(f"differing cells: {len(diff)}")
+    s64, s32 = out["numpy"].scores, np.asarray(out["jax"].scores, np.float64)
+    h64, h32 = out["numpy"].weight_history, out["jax"].weight_history
+    for isub, ichan in diff:
+        zapped64 = [bool(h[isub, ichan] == 0) for h in h64]
+        zapped32 = [bool(h[isub, ichan] == 0) for h in np.asarray(h32)]
+        print(f"cell ({isub},{ichan}): score64={s64[isub, ichan]!r} "
+              f"score32={s32[isub, ichan]!r} "
+              f"zap-history 64={zapped64} 32={zapped32}")
+    np.savez_compressed(
+        "/tmp/fullsize_divergence.npz", m64=m64, m32=m32,
+        s64=s64, s32=s32, diff=diff)
+    print("saved /tmp/fullsize_divergence.npz")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main()

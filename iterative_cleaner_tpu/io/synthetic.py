@@ -37,6 +37,17 @@ class SyntheticTruth:
         return mask
 
 
+def bench_rfi_density(nsub: int, nchan: int) -> dict:
+    """The benchmark RFI-density rules (~0.05% impulsive cells, one bad
+    channel per 512, one bad subint per 512), shared by ``bench.py``'s two
+    configs and ``benchmarks/fullsize_golden.py`` — the committed full-size
+    mask golden is only valid while all three generate the SAME archive,
+    so the rules live in exactly one place."""
+    return dict(n_rfi_cells=max(8, nsub * nchan // 2048),
+                n_rfi_channels=max(1, nchan // 512),
+                n_rfi_subints=max(1, nsub // 512))
+
+
 def make_synthetic_archive(
     nsub: int = 16,
     nchan: int = 32,

@@ -173,21 +173,15 @@ def cmd_selftest(args) -> int:
     from iterative_cleaner_tpu.backends import clean_archive
     from iterative_cleaner_tpu.config import CleanConfig
     from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
-    from iterative_cleaner_tpu.utils import (
-        apply_platform_override,
-        device_reachable,
-    )
+    from iterative_cleaner_tpu.utils import fallback_to_cpu_if_unreachable
 
     # Same dead-tunnel guard as the CLI: a sitecustomize-pinned accelerator
     # whose tunnel is down hangs PJRT init forever — the very installs a
     # doctor must diagnose.  Probe in a killable subprocess first.
-    probe_t = float(os.environ.get("ICLEAN_PROBE_TIMEOUT", "90"))
-    if (probe_t > 0 and not os.environ.get("ICLEAN_PLATFORM")
-            and not device_reachable(probe_t, log=lambda m: print(m))):
-        print("default device unreachable (dead tunnel?); selftest runs "
-              "on CPU — parity still meaningful, speed is not")
-        os.environ["ICLEAN_PLATFORM"] = "cpu"
-    apply_platform_override()
+    fallback_to_cpu_if_unreachable(
+        log=lambda m: print(m),
+        message="default device unreachable (dead tunnel?); selftest runs "
+                "on CPU — parity still meaningful, speed is not")
     import jax
 
     # the parity leg runs both backends at float64 (safe to flip at

@@ -40,6 +40,34 @@ def enable_compile_cache(directory) -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def fallback_to_cpu_if_unreachable(timeout_env: str = "ICLEAN_PROBE_TIMEOUT",
+                                   log=None, message: str = "") -> bool:
+    """Probe the default jax device and pin ``ICLEAN_PLATFORM=cpu`` when it
+    is unreachable, then apply the platform override.  Returns True when
+    the fallback engaged.
+
+    The one shared implementation of the dead-tunnel guard used by
+    ``bench.py``, ``tools selftest`` and ``benchmarks/fullsize_golden.py``
+    (the CLI keeps its own variant: its probe is additionally conditional
+    on the selected backend and an existing in-process cpu pin).  An
+    explicit ``ICLEAN_PLATFORM`` or a zero/negative timeout skips the
+    probe entirely."""
+    import sys
+
+    timeout = float(os.environ.get(timeout_env, "90"))
+    fell_back = False
+    if (timeout > 0 and not os.environ.get("ICLEAN_PLATFORM")
+            and not device_reachable(timeout, log=log,
+                                     knob_hint=timeout_env)):
+        if message:
+            (log or (lambda m: print(m, file=sys.stderr, flush=True)))(
+                message)
+        os.environ["ICLEAN_PLATFORM"] = "cpu"
+        fell_back = True
+    apply_platform_override()
+    return fell_back
+
+
 def device_reachable(timeout_s: float = 90.0, log=None,
                      knob_hint: str = "") -> bool:
     """Probe the default jax device in a killable subprocess.
