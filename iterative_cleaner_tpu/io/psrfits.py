@@ -10,21 +10,35 @@ and ``native/psrfits_io.cpp`` provides an mmap-based C++ reader for the same
 subset (byte swap + int16 scale/offset conversion in native code), used
 automatically when built.
 
-Supported subset (documented, tested):
+Supported PSRFITS matrix (documented, tested — foreign-writer variants in
+tests/test_psrfits.py::TestForeignWriterVariants):
 
-- Fold-mode (``OBS_MODE='PSR'``) single-file archives.
+- Fold-mode (``OBS_MODE='PSR'``/``'CAL'``) single-file archives; other
+  modes (search) are rejected with a clear error.
 - ``SUBINT`` binary table with per-row columns ``TSUBINT``, ``OFFS_SUB``,
-  ``DAT_FREQ``, ``DAT_WTS``, ``DAT_SCL``, ``DAT_OFFS`` and ``DATA``;
-  ``DATA`` element types ``E`` (float32) or ``I`` (int16, scaled by
-  ``DAT_SCL``/``DAT_OFFS`` per (pol, channel)).
-- Folding period resolution order: ``PERIOD`` key in the SUBINT header (this
-  writer emits it), then ``1/REF_F0`` from a ``POLYCO`` table, then the
-  standard fold-mode identity ``TBIN * NBIN``.
-- Search-mode files, references to external ephemerides, and exotic DATA
-  types are rejected with clear errors.
+  ``DAT_FREQ``, ``DAT_WTS``, ``DAT_SCL``, ``DAT_OFFS`` and ``DATA`` — in
+  ANY column order (columns resolve by TTYPE name through TFORM byte
+  offsets, never by position).  Padded repeats (repeat > expected) are
+  tolerated on every column except ``DATA``, whose repeat must equal
+  ``NPOL*NCHAN*NBIN`` exactly (a padded cube would make the row shape
+  ambiguous).
+- ``DATA`` element types ``E`` (float32) or ``I`` (int16, scaled by
+  ``DAT_SCL``/``DAT_OFFS`` per (pol, channel)); anything else (1-bit,
+  8-bit, 32-bit-int search payloads) rejects actionably.  ``DAT_FREQ``
+  may be ``E`` (the common layout) or ``D`` (this writer's choice).
+- ``TDIM`` on the DATA column is informative only: absent, canonical
+  ``(nbin,nchan,npol)``, or whitespace-padded spellings all load — the
+  cube shape comes from NBIN/NCHAN/NPOL, which are required.
+- Non-SUBINT HDUs anywhere (PSRPARAM/HISTORY/POLYCO before or after the
+  SUBINT table) are skipped structurally.
+- Folding period resolution order: ``PERIOD`` key in the SUBINT header
+  (this writer emits it), then ``1/REF_F0`` from a ``POLYCO`` table, then
+  the standard fold-mode identity ``TBIN * NBIN``; no usable source is an
+  actionable error.
+- References to external ephemerides are ignored (never followed).
 
 FITS structural details handled here: 2880-byte units, 80-char header cards,
-big-endian table payloads, ``TDIM`` row shapes, header/data padding.
+big-endian table payloads, header/data padding.
 """
 
 from __future__ import annotations
