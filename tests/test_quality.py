@@ -14,6 +14,9 @@ recall ~0.82).  The floors leave slack so the gate catches detector
 regressions, not noise.
 """
 
+import json
+import os
+
 import numpy as np
 import pytest
 
@@ -80,3 +83,58 @@ def test_quality_excludes_prezapped_cells():
     assert q["precision"] is None       # no live cells zapped at all
     assert q["recall_cell"] is None and q["recall_channel"] is None
     assert q["false_zap_frac"] == 0.0
+
+
+# --- borderline recall curve (VERDICT r3 #8) -------------------------------
+
+CURVE_STRENGTHS = (3.0, 4.0, 5.0, 6.0, 8.0, 40.0)
+CURVE_GOLDEN = os.path.join(os.path.dirname(__file__), "goldens",
+                            "quality_recall_curve.json")
+
+
+def _recall_curve():
+    """Per-morphology recall vs injected strength, averaged over 2 seeds
+    (numpy backend: deterministic, platform-independent — the jax engines
+    are tied to it by the parity suite)."""
+    curve = {}
+    for s in CURVE_STRENGTHS:
+        qs = [_quality("surgical_scrub", "numpy", seed, rfi_strength=s)
+              for seed in (0, 1)]
+        curve[str(s)] = {
+            k: round(float(np.mean([q[k] for q in qs])), 4)
+            for k in ("precision", "recall_cell", "recall_channel",
+                      "recall_subint", "false_zap_frac")}
+    return curve
+
+
+def test_borderline_recall_curve():
+    """Sweep injected strength across the 5-sigma detection threshold and
+    pin the whole recall curve exactly (the committed artifact,
+    regenerate with ICLEAN_REGEN_GOLDENS=1): a kernel/semantics change
+    that shifts *borderline* behaviour — invisible to the strong-RFI
+    floors — moves one of these integer-ratio recalls and fails here
+    visibly.  Measured shape (2026-07-30): sigmoid from
+    recall_cell 0.39 @ 3-sigma through 0.92 @ 5 to 1.0 @ >= 6, channel
+    recall the slowest riser (0.11 @ 3), precision 1.0 with zero false
+    zaps at EVERY strength."""
+    curve = _recall_curve()
+
+    # shape: recall never decreases with injection strength...
+    for k in ("recall_cell", "recall_channel", "recall_subint"):
+        vals = [curve[str(s)][k] for s in CURVE_STRENGTHS]
+        assert all(b >= a for a, b in zip(vals, vals[1:])), (k, vals)
+        assert vals[-1] >= 0.999, (k, vals)
+    # ...and surgical precision costs nothing at any strength
+    for s in CURVE_STRENGTHS:
+        assert curve[str(s)]["precision"] == 1.0, curve[str(s)]
+        assert curve[str(s)]["false_zap_frac"] == 0.0, curve[str(s)]
+
+    if os.environ.get("ICLEAN_REGEN_GOLDENS"):
+        os.makedirs(os.path.dirname(CURVE_GOLDEN), exist_ok=True)
+        with open(CURVE_GOLDEN, "w") as f:
+            json.dump(curve, f, indent=1, sort_keys=True)
+            f.write("\n")
+    with open(CURVE_GOLDEN) as f:
+        want = json.load(f)
+    assert curve == want, "recall curve moved; if intentional, regenerate " \
+        "with ICLEAN_REGEN_GOLDENS=1 and commit the diff"
