@@ -40,8 +40,20 @@ import numpy as np
 GOLDEN_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "tests", "goldens", "fullsize_mask_golden.json")
+# the oracle's packed zap mask itself (compressed npz; the JSON keeps only
+# its hash) — needed by `check` to LOCATE differing cells, not just count
+MASK_PATH = os.path.join(os.path.dirname(GOLDEN_PATH), "fullsize_mask.npz")
 
 NSUB, NCHAN, NBIN = 1024, 4096, 128
+
+# Borderline band (measured 2026-07-30, benchmarks/fullsize_divergence_probe
+# + /tmp/fullsize_divergence.npz analysis): float32 score noise near the
+# zap threshold is <= ~1e-2 (median 2.2e-5, max 9.4e-3 within |s-1|<0.3 of
+# threshold), so cells with |score64 - 1| < 0.05 — 236 of 4.19M — are the
+# only ones a correct f32 path can legitimately flip; 5x margin over the
+# observed worst noise.  The first full-size check found exactly 2 flips,
+# both inside the 0.005 band.
+BORDERLINE_EPS = 0.05
 
 
 def make_fullsize_archive():
@@ -88,12 +100,24 @@ def run(backend: str, variant: str = "xla", stats_frame: str = "dispersed",
     return ar, res, dt
 
 
+def borderline_cells(scores) -> list:
+    """[[isub, ichan, score64], ...] for |score - 1| < BORDERLINE_EPS —
+    the only cells whose zap decision float32 noise can legitimately move.
+    The band is selected on the ROUNDED value that gets stored, so a
+    band-edge score can never round onto the boundary and violate the
+    wellformed test's strict inequality."""
+    s = np.round(np.asarray(scores, dtype=np.float64), 6)
+    idx = np.argwhere(np.isfinite(s) & (np.abs(s - 1.0) < BORDERLINE_EPS))
+    return [[int(i), int(c), float(s[i, c])] for i, c in idx]
+
+
 def cmd_generate(_args) -> int:
     print(f"oracle run: {NSUB}x{NCHAN}x{NBIN} float64 numpy "
           "(expect ~14 min / CPU core)", flush=True)
     ar, res, dt = run("numpy")
     from iterative_cleaner_tpu.io.synthetic import bench_rfi_density
 
+    zap = np.asarray(res.final_weights) == 0
     golden = {
         # the CONCRETE density numbers, not a pointer at bench.py: a tuned
         # bench_rfi_density() must invalidate this golden visibly (the
@@ -108,39 +132,72 @@ def cmd_generate(_args) -> int:
         "weights_hash": weights_hash(res.final_weights),
         "loops": int(res.loops),
         "converged": bool(res.converged),
-        "zap_cells": int(np.sum(res.final_weights == 0)),
+        "zap_cells": int(zap.sum()),
         "oracle_seconds": round(dt, 1),
         "oracle": "numpy float64 backend, CleanConfig defaults",
+        "borderline_eps": BORDERLINE_EPS,
+        "borderline": borderline_cells(res.scores),
     }
     os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
     with open(GOLDEN_PATH, "w") as f:
         json.dump(golden, f, indent=1, sort_keys=True)
         f.write("\n")
-    print(json.dumps(golden, indent=1, sort_keys=True))
-    print(f"golden written: {GOLDEN_PATH}")
+    np.savez_compressed(MASK_PATH, zap=np.packbits(zap),
+                        shape=np.asarray(zap.shape))
+    print(json.dumps({k: v for k, v in golden.items() if k != "borderline"},
+                     indent=1, sort_keys=True))
+    print(f"borderline cells (|s-1|<{BORDERLINE_EPS}):"
+          f" {len(golden['borderline'])}")
+    print(f"golden written: {GOLDEN_PATH} + {MASK_PATH}")
     return 0
 
 
 def cmd_check(args) -> int:
+    """Mask parity with a principled borderline allowance.
+
+    Exact bit-equality is the expected AND observed behaviour everywhere
+    except cells whose float64 score sits within BORDERLINE_EPS of the
+    zap threshold (enumerated in the golden): for those, float32 noise
+    (measured <= ~1e-2 near the threshold) can legitimately flip the
+    decision.  The check passes iff every differing cell is in that
+    enumerated band; anything else — one flip of a decisively-scored
+    cell, or a loop-count change — fails."""
     with open(GOLDEN_PATH) as f:
         golden = json.load(f)
+    with np.load(MASK_PATH) as z:
+        want_zap = np.unpackbits(z["zap"])[: NSUB * NCHAN] \
+            .reshape(NSUB, NCHAN).astype(bool)
+    assert mask_hash(np.where(want_zap, 0.0, 1.0)) == golden["mask_hash"], \
+        "goldens out of sync: fullsize_mask.npz does not match the JSON hash"
     print(f"jax check: variant={args.variant} stats_frame={args.stats_frame}",
           flush=True)
     ar, res, dt = run("jax", args.variant, args.stats_frame)
+    got_zap = np.asarray(res.final_weights) == 0
+    flips = np.argwhere(want_zap != got_zap)
+    border = {(i, c) for i, c, _ in golden["borderline"]}
+    rogue = [(int(i), int(c)) for i, c in flips if (i, c) not in border]
     got = {
         "mask_hash": mask_hash(res.final_weights),
         "loops": int(res.loops),
         "converged": bool(res.converged),
-        "zap_cells": int(np.sum(res.final_weights == 0)),
+        "zap_cells": int(got_zap.sum()),
+        "flips": len(flips),
+        "rogue_flips": rogue,
         "seconds": round(dt, 1),
     }
     print(json.dumps(got, indent=1, sort_keys=True))
-    ok = (got["mask_hash"] == golden["mask_hash"]
-          and got["loops"] == golden["loops"]
+    ok = (not rogue and got["loops"] == golden["loops"]
           and got["converged"] == golden["converged"])
-    print("MASK PARITY: " + ("OK" if ok else
-                             f"MISMATCH (want {golden['mask_hash']}, "
-                             f"loops {golden['loops']})"))
+    if ok and not len(flips):
+        print("MASK PARITY: OK (exact)")
+    elif ok:
+        print(f"MASK PARITY: OK ({len(flips)} flips, all inside the "
+              f"|score-1|<{golden['borderline_eps']} borderline band of "
+              f"{len(golden['borderline'])} cells)")
+    else:
+        print(f"MASK PARITY: MISMATCH ({len(rogue)} flips OUTSIDE the "
+              f"borderline band, or loop count moved: want "
+              f"{golden['loops']}, got {got['loops']})")
     return 0 if ok else 1
 
 

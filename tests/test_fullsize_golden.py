@@ -39,13 +39,28 @@ def test_golden_committed_and_wellformed():
     # density sanity: the injected RFI (~bench rules) zaps a small but
     # nonzero fraction of the 4.2M cells
     assert 0 < g["zap_cells"] < 1024 * 4096 // 4
+    # the borderline band `check` tolerates flips in must stay tiny and
+    # every member must actually be within eps of the threshold
+    assert g["borderline_eps"] == 0.05
+    assert 0 < len(g["borderline"]) < 1000
+    for _i, _c, s in g["borderline"]:
+        assert abs(s - 1.0) < g["borderline_eps"]
+    # the packed oracle mask golden must decode and match the JSON's counts
+    import numpy as np
+
+    with np.load(os.path.join(os.path.dirname(GOLDEN),
+                              "fullsize_mask.npz")) as z:
+        zap = np.unpackbits(z["zap"])[: 1024 * 4096]
+    assert int(zap.sum()) == g["zap_cells"]
 
 
 @pytest.mark.skipif(not os.environ.get("ICLEAN_RUN_FULLSIZE"),
                     reason="full-size run takes minutes; set "
                            "ICLEAN_RUN_FULLSIZE=1 to enable")
-@pytest.mark.parametrize("variant,frame", [
-    ("xla", "dispersed"), ("fused", "dispersed"), ("pallas", "dispersed")])
+# xla only: the fused/pallas kernels run in INTERPRET mode off-TPU, which
+# is impractically slow at 1024x4096x128 — those variants are checked on
+# hardware by benchmarks/tpu_validation_pass.sh step 6
+@pytest.mark.parametrize("variant,frame", [("xla", "dispersed")])
 def test_fullsize_mask_parity(variant, frame):
     import subprocess
     import sys
