@@ -102,6 +102,54 @@ def cmd_sweep(args) -> int:
     return 0
 
 
+def cmd_borderline(args) -> int:
+    """Report the zap decisions that sit on the detection edge.
+
+    Cleans one archive and prints every cell whose final score lies
+    within ``--eps`` of the zap threshold, one JSON line per cell
+    (position, score, zapped).  These are the decisions that are
+    sensitive to precision and convention: the full-size f32/f64
+    divergence study (ROUND4_NOTES.md) measured float32 score noise up
+    to ~1e-2 near the threshold, and the one-bin PSRCHIVE convention
+    perturbations (tests/test_convention_sensitivity.py) only ever move
+    cells in this band.  An operator seeing important data in a
+    borderline cell knows to rerun with ``--backend numpy`` (float64)
+    or adjusted thresholds rather than trusting a coin-flip decision.
+    """
+    import numpy as np
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io import load_archive
+
+    from iterative_cleaner_tpu.models import get_model
+
+    ar = load_archive(args.path)
+    cfg = CleanConfig(backend=args.backend, max_iter=args.max_iter,
+                      chanthresh=args.chanthresh,
+                      subintthresh=args.subintthresh)
+    prezap = np.asarray(ar.weights) == 0
+    res = get_model(args.model)(ar, cfg)
+    s = np.asarray(res.scores, dtype=np.float64)
+    zapped = res.zap_mask()
+    # pre-zapped cells are not DECISIONS — they stay zapped whatever their
+    # score (new_weights = where(score>=1, 0, orig) keeps orig zeros), so
+    # reporting them here would tell the operator a zapped cell survived
+    band = np.isfinite(s) & (np.abs(s - 1.0) < args.eps) & ~prezap
+    for isub, ichan in np.argwhere(band):
+        print(json.dumps({
+            "isub": int(isub), "ichan": int(ichan),
+            "score": round(float(s[isub, ichan]), 6),
+            "zapped": bool(zapped[isub, ichan]),
+        }), flush=True)
+    print(json.dumps({
+        "total_cells": int(s.size),
+        "borderline": int(band.sum()),
+        "zapped_borderline": int((band & zapped).sum()),
+        "eps": args.eps, "loops": int(res.loops),
+    }), flush=True)
+    return 0
+
+
 def cmd_info(args) -> int:
     """Print an archive's metadata as one JSON object (header + weights
     only; the data cube is never read)."""
@@ -253,6 +301,20 @@ def main(argv=None) -> int:
     p.add_argument("--model", choices=("surgical_scrub", "quicklook"),
                    default="surgical_scrub")
     p.set_defaults(fn=cmd_sweep)
+
+    p = sub.add_parser("borderline",
+                       help="list zap decisions within --eps of the "
+                            "threshold (precision/convention-sensitive "
+                            "cells); one JSON line per cell + a summary")
+    p.add_argument("path")
+    p.add_argument("--eps", type=float, default=0.05)
+    p.add_argument("-c", "--chanthresh", type=float, default=5.0)
+    p.add_argument("-s", "--subintthresh", type=float, default=5.0)
+    p.add_argument("-m", "--max_iter", type=int, default=5)
+    p.add_argument("--backend", choices=("jax", "numpy"), default="numpy")
+    p.add_argument("--model", choices=("surgical_scrub", "quicklook"),
+                   default="surgical_scrub")
+    p.set_defaults(fn=cmd_borderline)
 
     p = sub.add_parser("selftest",
                        help="end-to-end installation check: clean a "

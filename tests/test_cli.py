@@ -505,3 +505,40 @@ class TestTools:
         d = json.loads(capsys.readouterr().out)
         assert d["changed"] == 0 and d["same_input"]
 
+
+
+def test_tools_borderline(tmp_path, monkeypatch, capsys):
+    """tools borderline: the per-cell rows agree with a direct clean's
+    scores (same band, same zap side), and the summary's counts add up."""
+    import json
+
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.tools import main as tools_main
+
+    monkeypatch.chdir(tmp_path)
+    ar, _ = make_synthetic_archive(nsub=32, nchan=64, nbin=128, seed=0,
+                                   n_rfi_cells=20, rfi_strength=5.0,
+                                   n_prezapped=40)
+    save_archive(ar, "b.npz")
+    assert tools_main(["borderline", "b.npz", "--eps", "0.05",
+                       "--backend", "numpy"]) == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    rows, summary = lines[:-1], lines[-1]
+    assert summary["borderline"] == len(rows) > 0
+    assert summary["zapped_borderline"] == sum(r["zapped"] for r in rows)
+
+    res = clean_archive(load_archive("b.npz"), CleanConfig(backend="numpy"))
+    s = np.asarray(res.scores)
+    prezap = np.asarray(ar.weights) == 0
+    want = np.argwhere(np.isfinite(s) & (np.abs(s - 1.0) < 0.05) & ~prezap)
+    assert {(r["isub"], r["ichan"]) for r in rows} \
+        == {(int(i), int(c)) for i, c in want}
+    final_zap = np.asarray(res.final_weights) == 0
+    for r in rows:
+        assert abs(r["score"] - s[r["isub"], r["ichan"]]) < 1e-5
+        # "zapped" is the OUTPUT mask, and pre-zapped cells (always zapped
+        # regardless of score) never appear as rows
+        assert r["zapped"] == bool(final_zap[r["isub"], r["ichan"]])
+        assert not prezap[r["isub"], r["ichan"]]
