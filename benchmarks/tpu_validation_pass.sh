@@ -1,9 +1,11 @@
 #!/bin/sh
 # Queued real-TPU validations — run top to bottom whenever the tunnel is
 # alive (probe first: timeout 90 python -c "import jax; print(jax.devices())").
-# Each step records into benchmarks/measured/; after step 2 passes, lift
-# FUSED_STATS_AUTO_MAX_NBIN (stats/pallas_kernels.py) to 4096 and rerun
-# the bench.  2026-07-30: steps 1-2 pending since the tunnel died mid-day.
+# Each step records into benchmarks/measured/; step 2b re-benches with the
+# k-chunked fused tier enabled the moment step 2's lowering check passes
+# (ICLEAN_FUSED_AUTO_MAX_NBIN overrides without a source edit — commit the
+# new default in stats/pallas_kernels.py afterwards).
+# 2026-07-30: steps 1-2 pending since the tunnel died mid-day.
 set -ex
 cd "$(dirname "$0")/.."
 STAMP=$(date +%Y-%m-%d_%H%M)
@@ -45,6 +47,29 @@ for nbin in (2048, 4096):
     out = jax.jit(cell_diagnostics_pallas)(ded, disp, rot_t, t, w, w == 0)
     jax.block_until_ready(out); print(f"nbin={nbin}: OK (compiled + ran)")
 EOF
+
+# 2b. End-to-end LONG-PROFILE clean with the lift active (valid the moment
+#     step 2 printed OK): every bench config is nbin=128, so this is the
+#     step that actually routes a 2048-bin archive through 'auto' -> fused
+#     on real hardware.  What the lift BUYS comes from step 3/5b's
+#     fused-vs-xla rows at --nbin 2048; commit the new default in
+#     stats/pallas_kernels.py if fused wins there.
+python - <<'EOF2B' > "benchmarks/measured/autolift_longprofile_${STAMP}.txt" 2>&1
+import os
+os.environ["ICLEAN_FUSED_AUTO_MAX_NBIN"] = "4096"
+import numpy as np
+from iterative_cleaner_tpu.backends import clean_archive
+from iterative_cleaner_tpu.backends.jax_backend import resolve_stats_impl
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+import jax.numpy as jnp
+assert resolve_stats_impl("auto", jnp.float32, 2048, "dft") == "fused", \
+    "lift did not reach resolve_stats_impl"
+ar, _ = make_synthetic_archive(nsub=64, nchan=128, nbin=2048, seed=0)
+res = clean_archive(ar, CleanConfig(backend="jax"))
+print(f"auto->fused 2048-bin clean OK: loops={res.loops}, "
+      f"zapped={int((np.asarray(res.final_weights) == 0).sum())}")
+EOF2B
 
 # 3. Per-stage profile (batched scaler rows) at the bench config + long bins.
 { python benchmarks/profile_stages.py

@@ -420,7 +420,13 @@ FUSED_STATS_MAX_NBIN = 4096
 # 2048/4096 path is interpret-mode-verified only — explicit
 # stats_impl='fused' reaches it, 'auto' won't until a hardware run
 # confirms the lowering (interpret mode cannot check Mosaic constraints).
-FUSED_STATS_AUTO_MAX_NBIN = 1024
+# ICLEAN_FUSED_AUTO_MAX_NBIN overrides WITHOUT a source edit so the
+# hardware validation pass (step 2b) can exercise the lift the moment the
+# 2048/4096 lowering check passes; commit the new default afterwards.
+# Clamped to the kernel's own VMEM bound: past it 'auto' must keep its
+# silently-pick-a-working-impl contract (fall back to xla), never crash.
+FUSED_STATS_AUTO_MAX_NBIN = min(FUSED_STATS_MAX_NBIN, int(_os.environ.get(
+    "ICLEAN_FUSED_AUTO_MAX_NBIN", "1024")))
 
 
 def _write_diags(wres, mask, cos_ref, sin_ref,
