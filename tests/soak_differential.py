@@ -18,9 +18,9 @@ zero-MAD lines, dead channels/subints — bit-identical scores required.
 
     python tests/soak_differential.py          # ~30 min on one CPU
 
-Last full run 2026-07-30 (round 3, integration baseline default +
-34-pass adjacent-rank selection + fused scaler kernel): phase 1 300/300
-clean, phase 2 200/200 clean, phase 3 100/100 clean.
+Last full run 2026-07-30 (round 4: double-buffered exact streaming,
+sublane tier plumbing, f32-seeded streaming convergence): phase 1
+300/300 clean, phase 2 200/200 clean, phase 3 100/100 clean.
 """
 import os, sys, time
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
