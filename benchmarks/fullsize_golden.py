@@ -169,12 +169,21 @@ def cmd_check(args) -> int:
             .reshape(NSUB, NCHAN).astype(bool)
     assert mask_hash(np.where(want_zap, 0.0, 1.0)) == golden["mask_hash"], \
         "goldens out of sync: fullsize_mask.npz does not match the JSON hash"
-    print(f"jax check: variant={args.variant} stats_frame={args.stats_frame}",
-          flush=True)
-    ar, res, dt = run("jax", args.variant, args.stats_frame)
+    print(f"jax check: variant={args.variant} "
+          f"stats_frame={args.stats_frame} dtype={args.dtype}", flush=True)
+    if args.dtype == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    ar, res, dt = run("jax", args.variant, args.stats_frame,
+                      dtype=args.dtype)
     got_zap = np.asarray(res.final_weights) == 0
     flips = np.argwhere(want_zap != got_zap)
-    border = {(i, c) for i, c, _ in golden["borderline"]}
+    # float64 must match the float64 oracle EXACTLY (verified 2026-07-30:
+    # bit-identical at full size — the borderline allowance exists solely
+    # for float32's near-threshold noise)
+    border = set() if args.dtype == "float64" \
+        else {(i, c) for i, c, _ in golden["borderline"]}
     rogue = [(int(i), int(c)) for i, c in flips if (i, c) not in border]
     got = {
         "mask_hash": mask_hash(res.final_weights),
@@ -210,7 +219,17 @@ def main(argv=None) -> int:
                    default="xla")
     c.add_argument("--stats_frame", choices=("dispersed", "dedispersed"),
                    default="dispersed")
+    c.add_argument("--dtype", choices=("float32", "float64"),
+                   default="float32")
     args = p.parse_args(argv)
+    if (args.cmd == "check" and args.dtype == "float64"
+            and args.variant != "xla"):
+        # reject at parse time: the fused/pallas kernels are float32-only,
+        # and discovering that after minutes of archive generation (and
+        # the device probe) wastes a hardware window
+        p.error("--variant fused/pallas requires float32 "
+                "(the kernels are float32-only); use --variant xla "
+                "with --dtype float64")
     # oracle generation is numpy-only; probe the accelerator (killable
     # subprocess — a dead TPU tunnel hangs PJRT init forever) only on the
     # jax check path
