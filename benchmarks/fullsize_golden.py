@@ -37,12 +37,23 @@ import time
 
 import numpy as np
 
-GOLDEN_PATH = os.path.join(
+GOLDENS_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "tests", "goldens", "fullsize_mask_golden.json")
-# the oracle's packed zap mask itself (compressed npz; the JSON keeps only
-# its hash) — needed by `check` to LOCATE differing cells, not just count
-MASK_PATH = os.path.join(os.path.dirname(GOLDEN_PATH), "fullsize_mask.npz")
+    "tests", "goldens")
+
+
+def golden_paths(baseline_mode: str = "integration"):
+    """(json_path, mask_npz_path) for one baseline estimator.  The
+    default INTEGRATION mode keeps the original filenames; the profile
+    estimator (the cheaper per-profile window) gets its own pair — the
+    mask npz holds the oracle's packed zap mask (the JSON keeps only its
+    hash), needed by `check` to LOCATE differing cells, not just count.
+    """
+    suffix = "" if baseline_mode == "integration" else "_" + baseline_mode
+    return (os.path.join(GOLDENS_DIR, f"fullsize_mask_golden{suffix}.json"),
+            os.path.join(GOLDENS_DIR, f"fullsize_mask{suffix}.npz"))
+
+
 
 NSUB, NCHAN, NBIN = 1024, 4096, 128
 
@@ -82,18 +93,19 @@ def weights_hash(weights) -> str:
 
 
 def run(backend: str, variant: str = "xla", stats_frame: str = "dispersed",
-        dtype: str = "float32"):
+        dtype: str = "float32", baseline_mode: str = "integration"):
     from iterative_cleaner_tpu.backends import clean_archive
     from iterative_cleaner_tpu.config import CleanConfig
 
     ar = make_fullsize_archive()
     if backend == "numpy":
-        cfg = CleanConfig(backend="numpy")
+        cfg = CleanConfig(backend="numpy", baseline_mode=baseline_mode)
     else:
         median = "pallas" if variant == "pallas" else "sort"
         stats = "fused" if variant == "fused" else "xla"
         cfg = CleanConfig(backend="jax", dtype=dtype, median_impl=median,
-                          stats_impl=stats, stats_frame=stats_frame)
+                          stats_impl=stats, stats_frame=stats_frame,
+                          baseline_mode=baseline_mode)
     t0 = time.perf_counter()
     res = clean_archive(ar, cfg)
     dt = time.perf_counter() - t0
@@ -111,10 +123,12 @@ def borderline_cells(scores) -> list:
     return [[int(i), int(c), float(s[i, c])] for i, c in idx]
 
 
-def cmd_generate(_args) -> int:
-    print(f"oracle run: {NSUB}x{NCHAN}x{NBIN} float64 numpy "
+def cmd_generate(args) -> int:
+    golden_json, mask_npz = golden_paths(args.baseline_mode)
+    print(f"oracle run: {NSUB}x{NCHAN}x{NBIN} float64 numpy, "
+          f"baseline_mode={args.baseline_mode} "
           "(expect ~14 min / CPU core)", flush=True)
-    ar, res, dt = run("numpy")
+    ar, res, dt = run("numpy", baseline_mode=args.baseline_mode)
     from iterative_cleaner_tpu.io.synthetic import bench_rfi_density
 
     zap = np.asarray(res.final_weights) == 0
@@ -124,6 +138,7 @@ def cmd_generate(_args) -> int:
         # ungated wellformed test recomputes and compares them)
         "config": {"nsub": NSUB, "nchan": NCHAN, "nbin": NBIN, "seed": 0,
                    "disperse": True,
+                   "baseline_mode": args.baseline_mode,
                    "rfi": bench_rfi_density(NSUB, NCHAN)},
         "mask_hash": mask_hash(res.final_weights),
         # weights_hash is for ORACLE-REGENERATION diffing only (numpy vs
@@ -134,21 +149,22 @@ def cmd_generate(_args) -> int:
         "converged": bool(res.converged),
         "zap_cells": int(zap.sum()),
         "oracle_seconds": round(dt, 1),
-        "oracle": "numpy float64 backend, CleanConfig defaults",
+        "oracle": ("numpy float64 backend, CleanConfig defaults, "
+                   f"baseline_mode={args.baseline_mode}"),
         "borderline_eps": BORDERLINE_EPS,
         "borderline": borderline_cells(res.scores),
     }
-    os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
-    with open(GOLDEN_PATH, "w") as f:
+    os.makedirs(GOLDENS_DIR, exist_ok=True)
+    with open(golden_json, "w") as f:
         json.dump(golden, f, indent=1, sort_keys=True)
         f.write("\n")
-    np.savez_compressed(MASK_PATH, zap=np.packbits(zap),
+    np.savez_compressed(mask_npz, zap=np.packbits(zap),
                         shape=np.asarray(zap.shape))
     print(json.dumps({k: v for k, v in golden.items() if k != "borderline"},
                      indent=1, sort_keys=True))
     print(f"borderline cells (|s-1|<{BORDERLINE_EPS}):"
           f" {len(golden['borderline'])}")
-    print(f"golden written: {GOLDEN_PATH} + {MASK_PATH}")
+    print(f"golden written: {golden_json} + {mask_npz}")
     return 0
 
 
@@ -162,21 +178,23 @@ def cmd_check(args) -> int:
     decision.  The check passes iff every differing cell is in that
     enumerated band; anything else — one flip of a decisively-scored
     cell, or a loop-count change — fails."""
-    with open(GOLDEN_PATH) as f:
+    golden_json, mask_npz = golden_paths(args.baseline_mode)
+    with open(golden_json) as f:
         golden = json.load(f)
-    with np.load(MASK_PATH) as z:
+    with np.load(mask_npz) as z:
         want_zap = np.unpackbits(z["zap"])[: NSUB * NCHAN] \
             .reshape(NSUB, NCHAN).astype(bool)
     assert mask_hash(np.where(want_zap, 0.0, 1.0)) == golden["mask_hash"], \
-        "goldens out of sync: fullsize_mask.npz does not match the JSON hash"
+        f"goldens out of sync: {mask_npz} does not match the JSON hash"
     print(f"jax check: variant={args.variant} "
-          f"stats_frame={args.stats_frame} dtype={args.dtype}", flush=True)
+          f"stats_frame={args.stats_frame} dtype={args.dtype} "
+          f"baseline_mode={args.baseline_mode}", flush=True)
     if args.dtype == "float64":
         import jax
 
         jax.config.update("jax_enable_x64", True)
     ar, res, dt = run("jax", args.variant, args.stats_frame,
-                      dtype=args.dtype)
+                      dtype=args.dtype, baseline_mode=args.baseline_mode)
     got_zap = np.asarray(res.final_weights) == 0
     flips = np.argwhere(want_zap != got_zap)
     # float64 must match the float64 oracle EXACTLY (verified 2026-07-30:
@@ -213,8 +231,12 @@ def cmd_check(args) -> int:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
-    sub.add_parser("generate")
+    g = sub.add_parser("generate")
+    g.add_argument("--baseline_mode", choices=("integration", "profile"),
+                   default="integration")
     c = sub.add_parser("check")
+    c.add_argument("--baseline_mode", choices=("integration", "profile"),
+                   default="integration")
     c.add_argument("--variant", choices=("xla", "fused", "pallas"),
                    default="xla")
     c.add_argument("--stats_frame", choices=("dispersed", "dedispersed"),

@@ -117,6 +117,7 @@ done; done > "benchmarks/measured/tier_sweep_${STAMP}.txt" 2>&1
 { python benchmarks/fullsize_golden.py check --variant fused || true
   python benchmarks/fullsize_golden.py check --variant pallas || true
   python benchmarks/fullsize_golden.py check --variant xla || true
+  python benchmarks/fullsize_golden.py check --baseline_mode profile || true
 } > "benchmarks/measured/fullsize_parity_tpu_${STAMP}.txt" 2>&1
 
 # 7. (round 4) fourier/fft MULTI-CHIP program (VERDICT r3 #6): the default

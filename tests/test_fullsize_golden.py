@@ -15,24 +15,25 @@ import os
 
 import pytest
 
-GOLDEN = os.path.join(os.path.dirname(__file__), "goldens",
-                      "fullsize_mask_golden.json")
+from benchmarks.fullsize_golden import golden_paths
 
 
-def _load():
-    with open(GOLDEN) as f:
+def _load(mode="integration"):
+    with open(golden_paths(mode)[0]) as f:
         return json.load(f)
 
 
-def test_golden_committed_and_wellformed():
+@pytest.mark.parametrize("mode", ["integration", "profile"])
+def test_golden_committed_and_wellformed(mode):
     from iterative_cleaner_tpu.io.synthetic import bench_rfi_density
 
-    g = _load()
+    g = _load(mode)
     # recomputing the density rules here means a bench_rfi_density() tune
     # that would silently change the generated archive fails THIS cheap
     # test instead of only the rarely-run full-size check
     assert g["config"] == {"nsub": 1024, "nchan": 4096, "nbin": 128,
                            "seed": 0, "disperse": True,
+                           "baseline_mode": mode,
                            "rfi": bench_rfi_density(1024, 4096)}
     assert len(g["mask_hash"]) == 32 and len(g["weights_hash"]) == 32
     assert 1 <= g["loops"] <= 5 and g["converged"] is True
@@ -48,8 +49,7 @@ def test_golden_committed_and_wellformed():
     # the packed oracle mask golden must decode and match the JSON's counts
     import numpy as np
 
-    with np.load(os.path.join(os.path.dirname(GOLDEN),
-                              "fullsize_mask.npz")) as z:
+    with np.load(golden_paths(mode)[1]) as z:
         zap = np.unpackbits(z["zap"])[: 1024 * 4096]
     assert int(zap.sum()) == g["zap_cells"]
 
@@ -63,9 +63,11 @@ def test_golden_committed_and_wellformed():
 # via the borderline-band allowance; float64 must match the oracle
 # EXACTLY (verified 2026-07-30: bit-identical — the remaining f32
 # divergence is dtype-only, not algorithmic).
-@pytest.mark.parametrize("variant,frame,dtype", [
-    ("xla", "dispersed", "float32"), ("xla", "dispersed", "float64")])
-def test_fullsize_mask_parity(variant, frame, dtype):
+@pytest.mark.parametrize("variant,frame,dtype,mode", [
+    ("xla", "dispersed", "float32", "integration"),
+    ("xla", "dispersed", "float64", "integration"),
+    ("xla", "dispersed", "float32", "profile")])
+def test_fullsize_mask_parity(variant, frame, dtype, mode):
     import subprocess
     import sys
 
@@ -76,7 +78,7 @@ def test_fullsize_mask_parity(variant, frame, dtype):
         [sys.executable, os.path.join(repo, "benchmarks",
                                       "fullsize_golden.py"),
          "check", "--variant", variant, "--stats_frame", frame,
-         "--dtype", dtype],
+         "--dtype", dtype, "--baseline_mode", mode],
         env=repo_subprocess_env(), capture_output=True, timeout=3600)
     assert out.returncode == 0, (out.stdout.decode()[-2000:]
                                  + out.stderr.decode()[-2000:])
