@@ -29,6 +29,7 @@ for other dtypes.
 from __future__ import annotations
 
 import functools
+import os as _os
 
 import jax
 import jax.numpy as jnp
@@ -173,16 +174,27 @@ def _scaled_sides_kernel(d0_ref, d1_ref, d2_ref, d3_ref, m_ref,
     o3_ref[0] = jnp.abs(centred / _patch_nan_lines(mad[None, :], absc, 0)) / t
 
 
+# Scoped-VMEM ceiling for the fused scaler launch (v5e has 128 MB VMEM;
+# Mosaic's default scoped limit is 16 MB).  The kernel's live set at
+# n=4096 is ~9 lane-padded (n, 128) f32 block buffers (double-buffered)
+# plus ~16 bisection temporaries ≈ 70 MB, measured on hardware 2026-07-31.
+_SCALER_VMEM_BYTES = min(120, max(32, int(
+    _os.environ.get("ICLEAN_SCALER_VMEM_MB", "100")))) * 2**20
+
+
 def _scaler_tile_lines(n: int) -> int:
-    """Lane-tile width for the fused scaler launch.  VMEM per grid step is
-    ~12 full-height (n, T) float32 arrays (5 in + 4 out blocks + bisection
-    temporaries), so T shrinks as the reduction axis grows — at n=4096
-    (the full-size subint scaler) T=32 keeps the step ~7 MB."""
-    if n <= 1024:
-        return _TILE_LINES
-    if n <= 2048:
-        return 64
-    return 32
+    """Lane-tile width for the fused scaler launch: always one full
+    128-lane tile.
+
+    Hardware lesson (2026-07-31, v5e): TPU lane tiling pads the last block
+    dim to 128 lanes, so a (n, 32) float32 block occupies the same VMEM as
+    a (n, 128) one — the earlier scheme of shrinking T for long reduction
+    axes (T=64 at n<=2048, T=32 beyond) saved nothing and cut per-step
+    work 4x; it still blew the default 16 MB scoped-VMEM limit at n=4096
+    (32 MB stack allocation).  The real lever is the scoped-VMEM ceiling,
+    raised via ``CompilerParams(vmem_limit_bytes=...)`` on the launch."""
+    del n
+    return _TILE_LINES
 
 
 @functools.partial(jax.jit, static_argnames=("thresh", "interpret"))
@@ -212,6 +224,8 @@ def _scaled_sides_axis0(d0, d1, d2, d3, mask, thresh, interpret):
         in_specs=[spec] * 5,
         out_specs=[spec] * 4,
         interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_SCALER_VMEM_BYTES),
     )(*(chunked(d) for d in (d0, d1, d2, d3)), chunked(mask))
     return tuple(o.swapaxes(0, 1).reshape(n, mp)[:, :m] for o in outs)
 
@@ -313,8 +327,6 @@ def _median_axis0(values, mask, interpret):
 # blocks mean more rows per DFT matmul — better MXU utilisation at long
 # nbin where the C_BLK tiers shrink — until the VMEM budget trips the
 # Mosaic compile.  Only the "cell" default has been hardware-validated.
-import os as _os
-
 _S_BLK = _os.environ.get("ICLEAN_FUSED_SBLK", "")
 _C_BLK_SCALE = int(_os.environ.get("ICLEAN_FUSED_CBLK_SCALE", "1"))
 # tier strategy (VERDICT r3 #4): how the cell block sheds VMEM as profiles
