@@ -291,7 +291,11 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
     partial + diagnostics), parallel/streaming_exact.py — so its cost
     model is transfer-bound where the whole-archive path is HBM-bound.
     Reports tiles/s, effective transfer GB/s, and the wall-clock ratio
-    vs the whole-archive clean of the SAME archive.  Wall-clock (not
+    vs the whole-archive clean of the SAME archive.
+    ``streaming_eff_gbps`` is a cube-tile-upload MODEL (n_tiles x loops x
+    passes x padded-tile bytes over wall time), not measured bytes: the
+    smaller per-tile weight/mask/offset uploads are not counted, so it
+    slightly understates the real transfer (ADVICE r4).  Wall-clock (not
     in-program differential) is the honest metric here: the per-tile
     dispatch+H2D cost IS the thing being measured, amortised over
     loops x tiles x passes dispatches.
@@ -333,6 +337,9 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
          f"({t_stream / t_whole:.2f}x), {tiles_per_s:.1f} tile-passes/s, "
          f"{eff_gbps:.1f} GB/s effective transfer")
     return {
+        # geometry recorded so captures from hosts that fell down the OOM
+        # ladder (smaller streaming shape) are not compared as regressions
+        "streaming_geometry": f"{nsub}x{nchan}x{nbin}/chunk{chunk}",
         "streaming_tile_passes_per_s": round(tiles_per_s, 1),
         "streaming_eff_gbps": round(eff_gbps, 2),
         "streaming_vs_whole": round(t_stream / t_whole, 2),
@@ -398,7 +405,13 @@ def main():
     # (OOM on the streaming copy, etc.) must not sink the headline number —
     # but a mask-PARITY failure is a correctness regression, never benign
     try:
-        s_nsub, s_nchan, s_nbin = (32, 64, 64) if small else (512, 4096, 128)
+        # geometry derives from the jax config that actually SUCCEEDED
+        # (half its subints): on memory-constrained hosts a hardcoded
+        # full-size streaming copy would predictably re-OOM after the main
+        # bench already fell down the ladder (ADVICE r4)
+        s_nsub, s_nchan, s_nbin = ((32, 64, 64) if small else
+                                   (max(8, jax_cfg[0] // 2),
+                                    jax_cfg[1], jax_cfg[2]))
         extras = {**(extras or {}),
                   **bench_streaming(s_nsub, s_nchan, s_nbin,
                                     chunk=max(8, s_nsub // 4))}

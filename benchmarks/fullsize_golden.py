@@ -66,6 +66,17 @@ NSUB, NCHAN, NBIN = 1024, 4096, 128
 # both inside the 0.005 band.
 BORDERLINE_EPS = 0.05
 
+# The band alone is an allowance, not a contract (VERDICT r4 weak #3): a
+# regression that flipped ALL band cells would still have passed.  Two
+# further requirements turn it into one: at most MAX_BORDERLINE_FLIPS
+# cells may flip (observed: 2), and every flip's float64 score must lie
+# inside the measured float32 noise envelope of the threshold
+# (|s64 - 1| <= FLIP_NOISE_ENV; max observed noise 9.4e-3, both observed
+# flips within 0.005).  A flip in the outer band (noise envelope < |s64-1|
+# < BORDERLINE_EPS) means f32 noise LARGER than ever measured — fail.
+MAX_BORDERLINE_FLIPS = 10
+FLIP_NOISE_ENV = 0.01
+
 
 def make_fullsize_archive():
     from iterative_cleaner_tpu.io.synthetic import (
@@ -168,16 +179,42 @@ def cmd_generate(args) -> int:
     return 0
 
 
+def flip_verdict(flips, golden, dtype) -> dict:
+    """Classify mask flips against the golden's borderline band.
+
+    Returns ``{"rogue": [...], "wide": [...], "over_cap": bool, "ok":
+    bool}``: ``rogue`` — flips outside the enumerated band entirely (for
+    float64 ANY flip is rogue: the oracle match is exact); ``wide`` —
+    flips inside the band but outside the measured noise envelope
+    (FLIP_NOISE_ENV) of the threshold; ``over_cap`` — more than
+    MAX_BORDERLINE_FLIPS flips.  ``ok`` iff none of the three."""
+    border = {} if dtype == "float64" \
+        else {(i, c): s for i, c, s in golden["borderline"]}
+    rogue, wide = [], []
+    for i, c in flips:
+        key = (int(i), int(c))
+        if key not in border:
+            rogue.append(key)
+        elif abs(border[key] - 1.0) > FLIP_NOISE_ENV:
+            wide.append(key)
+    over_cap = len(flips) > MAX_BORDERLINE_FLIPS
+    return {"rogue": rogue, "wide": wide, "over_cap": over_cap,
+            "ok": not rogue and not wide and not over_cap}
+
+
 def cmd_check(args) -> int:
-    """Mask parity with a principled borderline allowance.
+    """Mask parity with a principled, BOUNDED borderline allowance.
 
     Exact bit-equality is the expected AND observed behaviour everywhere
     except cells whose float64 score sits within BORDERLINE_EPS of the
     zap threshold (enumerated in the golden): for those, float32 noise
     (measured <= ~1e-2 near the threshold) can legitimately flip the
     decision.  The check passes iff every differing cell is in that
-    enumerated band; anything else — one flip of a decisively-scored
-    cell, or a loop-count change — fails."""
+    enumerated band AND within the measured noise envelope of the
+    threshold AND there are at most MAX_BORDERLINE_FLIPS of them
+    (see :func:`flip_verdict`); anything else — one flip of a
+    decisively-scored cell, a mass flip of the band, or a loop-count
+    change — fails."""
     golden_json, mask_npz = golden_paths(args.baseline_mode)
     with open(golden_json) as f:
         golden = json.load(f)
@@ -200,31 +237,35 @@ def cmd_check(args) -> int:
     # float64 must match the float64 oracle EXACTLY (verified 2026-07-30:
     # bit-identical at full size — the borderline allowance exists solely
     # for float32's near-threshold noise)
-    border = set() if args.dtype == "float64" \
-        else {(i, c) for i, c, _ in golden["borderline"]}
-    rogue = [(int(i), int(c)) for i, c in flips if (i, c) not in border]
+    verdict = flip_verdict(flips, golden, args.dtype)
     got = {
         "mask_hash": mask_hash(res.final_weights),
         "loops": int(res.loops),
         "converged": bool(res.converged),
         "zap_cells": int(got_zap.sum()),
         "flips": len(flips),
-        "rogue_flips": rogue,
+        "rogue_flips": verdict["rogue"],
+        "wide_flips": verdict["wide"],
         "seconds": round(dt, 1),
     }
     print(json.dumps(got, indent=1, sort_keys=True))
-    ok = (not rogue and got["loops"] == golden["loops"]
+    ok = (verdict["ok"] and got["loops"] == golden["loops"]
           and got["converged"] == golden["converged"])
     if ok and not len(flips):
         print("MASK PARITY: OK (exact)")
     elif ok:
-        print(f"MASK PARITY: OK ({len(flips)} flips, all inside the "
+        print(f"MASK PARITY: OK ({len(flips)} flips <= cap "
+              f"{MAX_BORDERLINE_FLIPS}, all inside the "
               f"|score-1|<{golden['borderline_eps']} borderline band of "
-              f"{len(golden['borderline'])} cells)")
+              f"{len(golden['borderline'])} cells and within the "
+              f"|score-1|<={FLIP_NOISE_ENV} noise envelope)")
     else:
-        print(f"MASK PARITY: MISMATCH ({len(rogue)} flips OUTSIDE the "
-              f"borderline band, or loop count moved: want "
-              f"{golden['loops']}, got {got['loops']})")
+        print(f"MASK PARITY: MISMATCH ({len(verdict['rogue'])} flips "
+              f"outside the borderline band, {len(verdict['wide'])} inside "
+              f"the band but beyond the {FLIP_NOISE_ENV} noise envelope, "
+              f"flip count {len(flips)} vs cap {MAX_BORDERLINE_FLIPS}, or "
+              f"loop count moved: want {golden['loops']}, "
+              f"got {got['loops']})")
     return 0 if ok else 1
 
 

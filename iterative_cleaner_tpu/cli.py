@@ -385,10 +385,38 @@ def _iter_archives(paths, prefetch: int):
                 next_i += 1
 
 
+def _bucket_by_shape(paths: list) -> list:
+    """Stable sort-by-shape prepass (VERDICT r4 #6): reorder the input so
+    every archive of one (nsub, nchan, nbin, dedispersed) key is
+    consecutive — an interleaved list (a.64x128, b.32x64, c.64x128, ...)
+    otherwise recompiles or under-fills a group at every shape change.
+    Keys come from a header peek (no data-cube IO); buckets keep
+    first-appearance order and per-shape input order, so equal-shaped runs
+    are processed in the sequence given.  Paths whose header cannot be
+    read keep their relative order at the end — the group loop's load is
+    where their error surfaces (respecting --keep_going)."""
+    buckets, order, unpeekable = {}, [], []
+    for p in paths:
+        try:
+            # cheap_only: a TIMER .ar would need a full bridge load just
+            # to peek — leave it in the consecutive-grouping tail rather
+            # than load it twice
+            key = ar_io.peek_shape(p, cheap_only=True)
+        except Exception:
+            unpeekable.append(p)
+            continue
+        if key not in buckets:
+            buckets[key] = []
+            order.append(key)
+        buckets[key].append(p)
+    return [p for k in order for p in buckets[k]] + unpeekable
+
+
 def _run_batched(args) -> list:
-    """--batch driver: group consecutive equal-shaped archives and clean
-    each group in one compiled vmap program; per-archive outputs, console
-    lines and logs are identical to the sequential path."""
+    """--batch driver: bucket the input by shape, then group equal-shaped
+    archives and clean each group in one compiled vmap program;
+    per-archive outputs, console lines and logs are identical to the
+    sequential path (processing order follows the shape buckets)."""
     from iterative_cleaner_tpu.parallel.batch import clean_archives_batched
 
     cfg = config_from_args(args)
@@ -397,7 +425,7 @@ def _run_batched(args) -> list:
         from iterative_cleaner_tpu.parallel.mesh import batch_mesh
 
         mesh = batch_mesh()
-    paths = list(args.archive)
+    paths = _bucket_by_shape(list(args.archive))
     failed = []
 
     def record_failure(bad_paths, exc):

@@ -11,5 +11,9 @@ thin dispatch over:
   Python module is importable (:mod:`iterative_cleaner_tpu.io.psrchive_bridge`).
 """
 
-from iterative_cleaner_tpu.io.npz import load_archive, save_archive  # noqa: F401
+from iterative_cleaner_tpu.io.npz import (  # noqa: F401
+    load_archive,
+    peek_shape,
+    save_archive,
+)
 from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive  # noqa: F401

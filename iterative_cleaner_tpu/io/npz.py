@@ -80,6 +80,55 @@ def _write_npz(f, ar: Archive) -> None:
     )
 
 
+def peek_shape(path: str, cheap_only: bool = False):
+    """(nsub, nchan, nbin, dedispersed) without reading the data cube —
+    the batching key of the CLI's ``--batch`` shape prepass
+    (``check_equal_shapes`` compiles one program per distinct key).
+
+    Cheap for every container with a header: `.icar` reads its 144-byte
+    header, PSRFITS mmaps the header blocks, `.npz` reads the `data`
+    member's npy header out of the zip directory.  TIMER `.ar` via the
+    psrchive bridge has no header-only API and falls back to a full load
+    — unless ``cheap_only`` is set, which raises instead (the CLI prepass
+    uses it so a TIMER archive is never bridge-loaded twice: once to peek
+    and again to clean).
+    """
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".icar":
+        from iterative_cleaner_tpu.io import native
+
+        m = native.read_icar_header(path)
+        return m["nsub"], m["nchan"], m["nbin"], m["dedispersed"]
+    if ext in _PSRFITS_EXTS or ext == ".ar":
+        from iterative_cleaner_tpu.io import psrfits
+
+        if ext != ".ar" or psrfits.is_fits(path):
+            # header cards only — read_psrfits_info would also page in
+            # every row's DAT_WTS and resolve the period (POLYCO walk),
+            # work the load in the group loop redoes anyway
+            return psrfits.read_psrfits_shape(path)
+        if cheap_only:
+            raise ValueError(
+                f"{path}: TIMER-format .ar has no header-only shape peek")
+        ar = load_archive(path)  # TIMER bridge: header-only not available
+        return ar.nsub, ar.nchan, ar.nbin, ar.dedispersed
+    import zipfile
+
+    from numpy.lib import format as npy_format
+
+    with zipfile.ZipFile(path) as z:
+        with z.open("data.npy") as f:
+            version = npy_format.read_magic(f)
+            if version == (1, 0):
+                shape, _, _ = npy_format.read_array_header_1_0(f)
+            else:
+                shape, _, _ = npy_format.read_array_header_2_0(f)
+        with z.open("dedispersed.npy") as f:
+            ded = bool(npy_format.read_array(f, allow_pickle=False))
+    nsub, _npol, nchan, nbin = shape
+    return int(nsub), int(nchan), int(nbin), ded
+
+
 def load_archive(path: str) -> Archive:
     ext = os.path.splitext(path)[1].lower()
     if ext == ".icar":

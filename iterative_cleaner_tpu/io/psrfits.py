@@ -30,7 +30,13 @@ tests/test_psrfits.py::TestForeignWriterVariants):
   ``(nbin,nchan,npol)``, or whitespace-padded spellings all load — the
   cube shape comes from NBIN/NCHAN/NPOL, which are required.
 - Non-SUBINT HDUs anywhere (PSRPARAM/HISTORY/POLYCO before or after the
-  SUBINT table) are skipped structurally.
+  SUBINT table) are skipped structurally.  If more than one ``SUBINT``
+  HDU is present, the FIRST is authoritative (both readers).  Trailing
+  non-FITS bytes after the last HDU (junk some writers leave) are
+  ignored.  The long-string convention (a quoted value ending ``&``
+  extended by ``CONTINUE`` cards) is parsed by the pure reader; the
+  native reader skips ``CONTINUE`` cards (no long-valued key is load-
+  bearing for the cube).
 - Folding period resolution order: ``PERIOD`` key in the SUBINT header
   (this writer emits it), then ``1/REF_F0`` from a ``POLYCO`` table, then
   the standard fold-mode identity ``TBIN * NBIN``; no usable source is an
@@ -108,10 +114,14 @@ def _parse_header(buf: memoryview, off: int):
 
     Repeated keys keep the first value; COMMENT/HISTORY/blank cards are
     skipped.  The dict preserves raw string values stripped of padding.
+    The long-string convention is honoured: a string value ending in ``&``
+    is extended by following ``CONTINUE`` cards (psrchive writes long
+    PSRPARAM/HISTORY values this way).
     """
     cards = {}
     pos = off
     end_seen = False
+    pending = None  # key whose string value ended with '&'
     while not end_seen:
         if pos + BLOCK > len(buf):
             raise ValueError("truncated FITS header")
@@ -123,13 +133,32 @@ def _parse_header(buf: memoryview, off: int):
             if key == "END":
                 end_seen = True
                 break
+            if key == "CONTINUE":
+                if pending is not None:
+                    m = _VALUE_RE.match(card[8:].strip())
+                    if m and m.group("str") is not None:
+                        s = m.group("str").rstrip().replace("''", "'")
+                        cards[pending] = cards[pending][:-1] + s
+                        if not s.endswith("&"):
+                            pending = None
+                    else:
+                        # a CONTINUE that is not a quoted string ENDS the
+                        # long string (FITS convention) — stitching a later
+                        # CONTINUE across it would silently drop a chunk
+                        pending = None
+                continue
             if key in ("", "COMMENT", "HISTORY") or card[8:10] != "= ":
+                pending = None
                 continue
             m = _VALUE_RE.match(card[10:].strip())
+            pending = None
             if not m or key in cards:
                 continue
             if m.group("str") is not None:
-                cards[key] = m.group("str").rstrip().replace("''", "'")
+                val = m.group("str").rstrip().replace("''", "'")
+                cards[key] = val
+                if val.endswith("&"):
+                    pending = key
             else:
                 cards[key] = m.group("num").strip()
     return cards, pos
@@ -194,7 +223,7 @@ def _hdu_data_bytes(cards) -> int:
     return n
 
 
-def _iter_hdus(buf: memoryview):
+def _iter_hdus(buf: memoryview, stopped_early: "list | None" = None):
     """Yield (cards, data_offset) for each HDU.
 
     Negative NAXISn/PCOUNT raise (``_hdu_data_bytes``) rather than walking
@@ -202,7 +231,17 @@ def _iter_hdus(buf: memoryview):
     crafted header can therefore never make this loop revisit offsets
     (the corruption-fuzz contract: reject or load, never hang)."""
     off = 0
+    first = True
     while off < len(buf):
+        if not first and bytes(buf[off: off + 8]) != b"XTENSION":
+            # not an extension header: trailing non-FITS bytes some foreign
+            # writers leave after the last HDU — stop the walk, matching
+            # the native reader (polyco_period returns 0 on a bad header).
+            # The flag lets _resolve_period warn if the stop hid a
+            # possible POLYCO table.
+            if stopped_early is not None:
+                stopped_early.append(off)
+            break
         cards, data_off = _parse_header(buf, off)
         yield cards, data_off
         size = _hdu_data_bytes(cards)
@@ -210,6 +249,7 @@ def _iter_hdus(buf: memoryview):
         if nxt <= off:  # pragma: no cover - guarded by the raises above
             raise ValueError("corrupt FITS: HDU walk does not advance")
         off = nxt
+        first = False
 
 
 # ---------------------------------------------------------------------------
@@ -345,12 +385,20 @@ def save_psrfits(ar: Archive, path: str, nbits: "int | None" = None) -> None:
 
 def _find_subint(buf: memoryview):
     primary = None
-    for cards, data_off in _iter_hdus(buf):
+    stopped = []
+    for cards, data_off in _iter_hdus(buf, stopped_early=stopped):
         if primary is None:
             primary = cards
             continue
         if cards.get("EXTNAME", "").strip() == "SUBINT":
             return primary, cards, data_off
+    if stopped:
+        # the walk ended at non-FITS bytes BEFORE any SUBINT table: that
+        # is corruption/truncation, not a non-fold-mode archive — keep the
+        # distinct error the pre-tolerance reader gave such files
+        raise ValueError(
+            f"no SUBINT table before non-FITS bytes at offset {stopped[0]} "
+            "(corrupt or truncated FITS?)")
     raise ValueError("no SUBINT binary table in file (not a fold-mode "
                      "PSRFITS archive?)")
 
@@ -359,7 +407,8 @@ def _resolve_period(buf: memoryview, subint_cards) -> float:
     period = _as_float(subint_cards, "PERIOD", 0.0)  # 0 = unset
     if period > 0:
         return period
-    for cards, data_off in _iter_hdus(buf):
+    stopped = []
+    for cards, data_off in _iter_hdus(buf, stopped_early=stopped):
         if cards.get("EXTNAME", "").strip() == "POLYCO":
             cols, row_bytes = _columns(cards)
             nrows = _as_int(cards, "NAXIS2")
@@ -378,6 +427,17 @@ def _resolve_period(buf: memoryview, subint_cards) -> float:
     period = _as_float(subint_cards, "TBIN", 0.0) * _as_int(subint_cards,
                                                             "NBIN")
     if period > 0:
+        if stopped:
+            # the POLYCO search ended at non-FITS bytes, so a POLYCO table
+            # beyond them would have been missed: the TBIN identity may
+            # not be the writer's intended period source — load, but say so
+            import warnings
+
+            warnings.warn(
+                "PSRFITS period resolved from TBIN*NBIN, but the HDU walk "
+                f"stopped at non-FITS bytes (offset {stopped[0]}) before "
+                "the POLYCO search completed — verify the folding period",
+                stacklevel=2)
         return period
     raise ValueError("cannot determine the folding period (no usable "
                      "PERIOD key, POLYCO REF_F0, or TBIN)")
@@ -629,6 +689,22 @@ def _parse_psrfits(buf: memoryview, path: str) -> Archive:
         dedispersed=bool(_as_int(sub, "DEDISP", 0)),
         psrfits_nbits=16 if dcode == "I" else 32,
     )
+
+
+def read_psrfits_shape(path: str):
+    """(nsub, nchan, nbin, dedispersed) from the SUBINT header cards only —
+    no DAT_WTS row reads, no period resolution, no POLYCO walk.  The
+    cheapest possible peek for the CLI's --batch shape prepass; `tools
+    info` wants :func:`read_psrfits_info` instead."""
+
+    def parse(buf: memoryview, p: str):
+        if bytes(buf[:6]) != b"SIMPLE":
+            raise ValueError(f"{p} is not a FITS file")
+        _, sub, _ = _find_subint(buf)
+        return (_as_int(sub, "NAXIS2"), _as_int(sub, "NCHAN"),
+                _as_int(sub, "NBIN"), bool(_as_int(sub, "DEDISP", 0)))
+
+    return _mmap_parse(path, parse)
 
 
 def read_psrfits_info(path: str):

@@ -36,6 +36,7 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
+from iterative_cleaner_tpu.stats.pallas_kernels import pallas_interpret
 
 _CELL = P("sub", "chan")
 _CUBE = P("sub", "chan", None)
@@ -49,6 +50,13 @@ def shard_divisible(mesh, nsub: int, nchan: int) -> bool:
     layout requirement, and what NamedSharding's device_put enforces)."""
     return (nsub % int(mesh.shape["sub"]) == 0
             and nchan % int(mesh.shape["chan"]) == 0)
+
+
+def _mesh_interpret(mesh) -> bool:
+    """Interpret-mode decision for kernels traced against THIS mesh: its
+    devices' platform, not the process default (which may be a live TPU
+    tunnel while the mesh is virtual CPU devices — the multichip dryrun)."""
+    return next(iter(mesh.devices.flat)).platform != "tpu"
 
 
 def _gather_cells(x):
@@ -84,7 +92,8 @@ def sharded_scale_and_combine(mesh, diagnostics, cell_mask, chanthresh,
     # annotation, so shard_map's replication checker cannot see through it.
     fn = jax.shard_map(local, mesh=mesh, in_specs=(_CELL,) * 5,
                        out_specs=_CELL, check_vma=False)
-    return fn(*diagnostics, cell_mask)
+    with pallas_interpret(_mesh_interpret(mesh)):
+        return fn(*diagnostics, cell_mask)
 
 
 def sharded_cell_diagnostics_fused(mesh, ded, disp_base, rot_t, template,
@@ -104,7 +113,8 @@ def sharded_cell_diagnostics_fused(mesh, ded, disp_base, rot_t, template,
         in_specs=(_CUBE, _CUBE, _CHAN_ROW, _REP, _CELL, _CELL),
         out_specs=(_CELL,) * 4, check_vma=False,
     )
-    return fn(ded, disp_base, rot_t, template, weights, cell_mask)
+    with pallas_interpret(_mesh_interpret(mesh)):
+        return fn(ded, disp_base, rot_t, template, weights, cell_mask)
 
 
 def sharded_cell_diagnostics_fused_dedisp(mesh, ded, template, window,
@@ -120,4 +130,5 @@ def sharded_cell_diagnostics_fused_dedisp(mesh, ded, template, window,
         in_specs=(_CUBE, _REP, _REP, _CELL, _CELL),
         out_specs=(_CELL,) * 4, check_vma=False,
     )
-    return fn(ded, template, window, weights, cell_mask)
+    with pallas_interpret(_mesh_interpret(mesh)):
+        return fn(ded, template, window, weights, cell_mask)

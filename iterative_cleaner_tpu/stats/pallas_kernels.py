@@ -28,6 +28,8 @@ for other dtypes.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 import os as _os
 
@@ -46,6 +48,36 @@ _KEY_MASKED = np.int32(0x7F800000)
 
 # Lane tile over the line axis; the reduction axis stays whole in VMEM.
 _TILE_LINES = 128
+
+# Whether a launch should run in interpret mode is a property of the
+# devices the program actually TARGETS, not of the process default —
+# jax.devices()[0] is wrong the moment a live-TPU process builds a CPU
+# mesh (the multichip dryrun: entry() initialises the TPU backend, the
+# cpu platform pin then fails, and every kernel traced for the explicit
+# CPU mesh would lower non-interpreted and die in XLA:CPU).  Callers that
+# know the target (parallel/shard_stats knows its mesh) scope an override
+# around the traced call; everything else falls back to the default
+# platform.
+_INTERPRET_OVERRIDE: contextvars.ContextVar = contextvars.ContextVar(
+    "iclean_pallas_interpret", default=None)
+
+
+def _interpret_default() -> bool:
+    ov = _INTERPRET_OVERRIDE.get()
+    if ov is not None:
+        return ov
+    return jax.devices()[0].platform != "tpu"
+
+
+@contextlib.contextmanager
+def pallas_interpret(value: bool):
+    """Scope an explicit interpret-mode decision over any pallas launches
+    traced inside the block (True = interpret; False = compile Mosaic)."""
+    token = _INTERPRET_OVERRIDE.set(bool(value))
+    try:
+        yield
+    finally:
+        _INTERPRET_OVERRIDE.reset(token)
 
 
 def _ordered_key(x):
@@ -241,7 +273,7 @@ def _scaled_sides_fn(axis: int, thresh: float):
 
     @custom_vmap
     def f(d0, d1, d2, d3, mask):
-        interpret = jax.devices()[0].platform != "tpu"
+        interpret = _interpret_default()
         if axis == 0:
             return _scaled_sides_axis0(d0, d1, d2, d3, mask, thresh,
                                        interpret)
@@ -254,7 +286,7 @@ def _scaled_sides_fn(axis: int, thresh: float):
         d0, d1, d2, d3, mask = _batch_args(axis_size, in_batched, *args)
         B, S, C = d0.shape
         fold, unfold = _line_fold(axis, B, S, C)
-        interpret = jax.devices()[0].platform != "tpu"
+        interpret = _interpret_default()
         outs = _scaled_sides_axis0(fold(d0), fold(d1), fold(d2), fold(d3),
                                    fold(mask), thresh, interpret)
         return tuple(unfold(o) for o in outs), (True,) * 4
@@ -674,7 +706,7 @@ def _fused_tables(nbin, dtype):
     cos_t = jnp.pad(jnp.cos(ang), ((0, 0), (0, pad_k)))
     sin_t = jnp.pad(jnp.sin(ang), ((0, 0), (0, pad_k)))
     num_k = cos_t.shape[1] // _k_chunk(nbin, cos_t.shape[1])
-    interpret = jax.devices()[0].platform != "tpu"
+    interpret = _interpret_default()
     return cos_t, sin_t, num_k, interpret
 
 
@@ -791,7 +823,7 @@ def _masked_median_fn(axis: int):
 
     @custom_vmap
     def f(values, mask):
-        interpret = jax.devices()[0].platform != "tpu"
+        interpret = _interpret_default()
         if axis == 0:
             return _median_axis0(values, mask, interpret)
         return _median_axis0(values.T, mask.T, interpret).T
@@ -801,7 +833,7 @@ def _masked_median_fn(axis: int):
         values, mask = _batch_args(axis_size, in_batched, values, mask)
         B, S, C = values.shape
         fold, unfold = _line_fold(axis, B, S, C, keepdims=True)
-        interpret = jax.devices()[0].platform != "tpu"
+        interpret = _interpret_default()
         out = _median_axis0(fold(values), fold(mask), interpret)
         return unfold(out), True
 

