@@ -310,15 +310,20 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
     )
     from iterative_cleaner_tpu.parallel import clean_streaming_exact
 
+    t0 = time.perf_counter()
     ar, _ = make_synthetic_archive(
         nsub=nsub, nchan=nchan, nbin=nbin, **bench_rfi_density(nsub, nchan),
         seed=0, dtype=np.float32,
     )
+    _log(f"streaming stage: archive generated in "
+         f"{time.perf_counter() - t0:.1f}s")
     cfg = CleanConfig(backend="jax", max_iter=max_iter)
 
     t0 = time.perf_counter()
     whole = clean_archive(ar.clone(), cfg)
     t_whole = time.perf_counter() - t0
+    _log(f"streaming stage: whole-archive clean {t_whole:.1f}s "
+         f"(loops={whole.loops})")
 
     t0 = time.perf_counter()
     stream = clean_streaming_exact(ar.clone(), chunk, cfg)
@@ -336,10 +341,15 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
          f"{t_stream:.2f}s vs whole {t_whole:.2f}s "
          f"({t_stream / t_whole:.2f}x), {tiles_per_s:.1f} tile-passes/s, "
          f"{eff_gbps:.1f} GB/s effective transfer")
+    import jax
+
     return {
-        # geometry recorded so captures from hosts that fell down the OOM
-        # ladder (smaller streaming shape) are not compared as regressions
+        # geometry + platform recorded so captures from hosts that fell
+        # down the OOM ladder (smaller shape) or whose streaming
+        # subprocess fell back to CPU while the headline ran on TPU are
+        # never compared as regressions
         "streaming_geometry": f"{nsub}x{nchan}x{nbin}/chunk{chunk}",
+        "streaming_platform": jax.default_backend(),
         "streaming_tile_passes_per_s": round(tiles_per_s, 1),
         "streaming_eff_gbps": round(eff_gbps, 2),
         "streaming_vs_whole": round(t_stream / t_whole, 2),
@@ -369,8 +379,66 @@ def bench_numpy(nsub, nchan, nbin, max_iter=5):
     return rate
 
 
+def _streaming_row_subprocess(nsub, nchan, nbin, chunk, timeout):
+    """Run bench_streaming in a KILLABLE subprocess with its own deadline.
+
+    The 2026-07-31 TPU window lost its headline JSON to a wedge inside the
+    streaming stage: a C-level stall the in-process watchdog could only
+    answer with os._exit(3), taking the already-measured headline numbers
+    down with it.  A subprocess bounds the stage without risking the rest
+    of the run.  Returns the streaming row dict, or None on timeout /
+    environment failure; a mask-PARITY failure (assert inside
+    bench_streaming) re-raises — a correctness regression is never benign.
+    """
+    import subprocess
+
+    env = {**os.environ,
+           "BENCH_STREAMING_ONLY": json.dumps(
+               {"nsub": nsub, "nchan": nchan, "nbin": nbin, "chunk": chunk})}
+    try:
+        # stderr is INHERITED: the child's stage logs stream live (and
+        # survive a timeout kill); only the one-line JSON is captured
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _log(f"streaming bench killed after {timeout:.0f}s (wedged tunnel "
+             "dispatch?); headline row unaffected")
+        return None
+    if out.returncode == 7:
+        # the child's dedicated parity-failure code (see the
+        # BENCH_STREAMING_ONLY branch): a correctness regression, fatal
+        raise AssertionError(
+            "exact streaming mask diverged from whole-archive (subprocess)")
+    if out.returncode != 0:
+        _log(f"streaming bench subprocess failed (rc={out.returncode}); "
+             "skipping the row")
+        return None
+    try:
+        row = json.loads(out.stdout.strip().splitlines()[-1])
+        return row if isinstance(row, dict) else None
+    except (ValueError, IndexError):
+        _log("streaming bench subprocess returned no JSON; skipping")
+        return None
+
+
 def main():
     from iterative_cleaner_tpu.utils import fallback_to_cpu_if_unreachable
+
+    if os.environ.get("BENCH_STREAMING_ONLY"):
+        geom = json.loads(os.environ["BENCH_STREAMING_ONLY"])
+        fallback_to_cpu_if_unreachable(
+            "BENCH_PROBE_TIMEOUT", log=_log,
+            message="device unreachable; streaming row on CPU")
+        try:
+            print(json.dumps(bench_streaming(**geom)))
+        except AssertionError as e:
+            # distinct exit code: the parent must treat a mask-parity
+            # failure as fatal, but ONLY that — scraping stderr for the
+            # word AssertionError would promote unrelated crashes
+            _log(f"streaming parity failure: {e}")
+            sys.exit(7)
+        return
 
     # Dead accelerator tunnel: fall back to CPU so the run still produces
     # a (clearly labelled) number instead of hanging into the watchdog.
@@ -401,24 +469,23 @@ def main():
     if jax_rate is None:
         raise SystemExit("all jax bench configs failed")
 
-    # streaming-exact efficiency row (VERDICT r3 #7); environment failures
-    # (OOM on the streaming copy, etc.) must not sink the headline number —
-    # but a mask-PARITY failure is a correctness regression, never benign
-    try:
-        # geometry derives from the jax config that actually SUCCEEDED
-        # (half its subints): on memory-constrained hosts a hardcoded
-        # full-size streaming copy would predictably re-OOM after the main
-        # bench already fell down the ladder (ADVICE r4)
-        s_nsub, s_nchan, s_nbin = ((32, 64, 64) if small else
-                                   (max(8, jax_cfg[0] // 2),
-                                    jax_cfg[1], jax_cfg[2]))
-        extras = {**(extras or {}),
-                  **bench_streaming(s_nsub, s_nchan, s_nbin,
-                                    chunk=max(8, s_nsub // 4))}
-    except AssertionError:
-        raise
-    except Exception as e:
-        _log(f"streaming bench skipped: {type(e).__name__}: {e}")
+    # streaming-exact efficiency row (VERDICT r3 #7), in a killable
+    # subprocess with its own deadline so a wedge cannot take the headline
+    # row down (2026-07-31); environment failures must not sink the
+    # headline number — but a mask-PARITY failure is a correctness
+    # regression, never benign (re-raised by the helper)
+    # geometry derives from the jax config that actually SUCCEEDED
+    # (half its subints): on memory-constrained hosts a hardcoded
+    # full-size streaming copy would predictably re-OOM after the main
+    # bench already fell down the ladder (ADVICE r4)
+    s_nsub, s_nchan, s_nbin = ((32, 64, 64) if small else
+                               (max(8, jax_cfg[0] // 2),
+                                jax_cfg[1], jax_cfg[2]))
+    row = _streaming_row_subprocess(
+        s_nsub, s_nchan, s_nbin, chunk=max(8, s_nsub // 4),
+        timeout=float(os.environ.get("BENCH_STREAMING_TIMEOUT", "600")))
+    if row:
+        extras = {**(extras or {}), **row}
 
     if not small and jax_cfg == (1024, 4096, 128):
         # Headline methodology (BASELINE.md "Measured baselines"): divide by
@@ -457,17 +524,23 @@ def main():
         cap_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "benchmarks", "measured")
         try:
-            caps = sorted(f for f in os.listdir(cap_dir)
-                          if f.startswith("bench_tpu_")
-                          and f.endswith(".json"))
-            if caps:
-                with open(os.path.join(cap_dir, caps[-1])) as fh:
-                    out["last_tpu_capture"] = {
-                        "file": f"benchmarks/measured/{caps[-1]}",
-                        **json.load(fh)}
+            caps = sorted((f for f in os.listdir(cap_dir)
+                           if f.startswith("bench_tpu_")
+                           and f.endswith(".json")), reverse=True)
+            for cap in caps:  # newest VALID capture (skip empty/truncated)
+                try:
+                    with open(os.path.join(cap_dir, cap)) as fh:
+                        payload = json.load(fh)
+                except (OSError, ValueError):
+                    continue
+                if not isinstance(payload, dict):
+                    continue
+                out["last_tpu_capture"] = {
+                    "file": f"benchmarks/measured/{cap}", **payload}
                 _log(f"fell back off-TPU; last real-TPU capture attached "
-                     f"from benchmarks/measured/{caps[-1]}")
-        except (OSError, ValueError, TypeError) as e:
+                     f"from benchmarks/measured/{cap}")
+                break
+        except OSError as e:
             _log(f"could not attach TPU capture: {e}")
     print(json.dumps(out))
 
