@@ -118,6 +118,15 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
     """Build (and cache) the jitted whole-archive cleaning program for one
     static configuration."""
 
+    # Dispersed-frame iteration (engine/loop.py ``disp_iteration``): the
+    # default configuration's fast path — template + consensus correction
+    # from one marginal pass, fit against the rotated template, ded never
+    # read in-loop (one resident cube, two cube reads per iteration).
+    from iterative_cleaner_tpu.engine.loop import disp_iteration_enabled
+
+    disp_iteration = disp_iteration_enabled(
+        baseline_mode, stats_frame, pulse_active, dedispersed)
+
     def run(cube, weights, freqs_mhz, dm, ref_freq_mhz, period_s):
         from iterative_cleaner_tpu.ops.dsp import (
             prepare_cube_with_correction,
@@ -135,7 +144,7 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
             stats_impl=stats_impl, stats_frame=stats_frame,
-            baseline_corr=baseline_corr,
+            baseline_corr=baseline_corr, disp_iteration=disp_iteration,
         )
         if not unload_res:
             return outs, None

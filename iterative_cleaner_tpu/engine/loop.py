@@ -70,6 +70,24 @@ def dispersed_residual_base(ded_cube, back_shifts, *, pulse_slice,
     return rotate_bins(masked, back_shifts, jnp, method=rotation)
 
 
+def disp_iteration_enabled(baseline_mode: str, stats_frame: str,
+                           pulse_active: bool, dedispersed: bool) -> bool:
+    """The ONE eligibility predicate for the dispersed-frame fast path
+    (``disp_iteration`` below) — every engine entry point (whole-archive,
+    batched, sharded, exact streaming) must call this, not re-derive it:
+    the bit-parity contracts between those paths hold only when they all
+    take the same template/fit route.
+
+    Valid exactly when the dispersed residual base IS the pristine
+    ``disp_clean``: the integration preamble materialises it, the stats
+    run in the dispersed frame, the pulse window is off (the fit must see
+    the unwindowed template), and the input is not already dedispersed
+    (DEDISP=1 makes the dispersed stats frame a rotation AWAY from
+    disp_clean)."""
+    return (baseline_mode == "integration" and stats_frame == "dispersed"
+            and not pulse_active and not dedispersed)
+
+
 class CleanOutputs(NamedTuple):
     final_weights: jax.Array   # (nsub, nchan) — the cleaned weight matrix
     loops: jax.Array           # scalar int32 — iterations actually run
@@ -100,7 +118,7 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
                    pulse_scale, pulse_active, rotation, fft_mode="fft",
                    median_impl="sort", stats_impl="xla",
                    stats_frame="dispersed", shard_mesh=None,
-                   baseline_corr=None):
+                   baseline_corr=None, disp_iteration=False):
     """One cleaning iteration: template -> fit -> residual stats -> new weights.
 
     ``weights`` are the previous iteration's (template) weights;
@@ -126,26 +144,57 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
         raise ValueError(
             "stats_impl='fused' computes DFT-flavoured rFFT magnitudes; "
             "pass fft_mode='dft'")
-    template = weighted_template(ded_cube, weights, jnp)
-    if baseline_corr is not None:
-        # integration baseline mode: the reference recomputes baselines on
-        # every template build with the CURRENT weights (:88-94); the
-        # hoisted preamble used the original weights, and the difference
-        # is exactly a scalar template shift (ops/psrchive_baseline)
+    if disp_iteration:
+        # Dispersed-frame iteration (the default config's fast path): the
+        # whole template stage — global weighted template AND the
+        # integration-consensus correction — derives from ONE pass over
+        # the dispersed cube (both weighted marginals), the dedispersion
+        # rotation is applied to the tiny (nchan, nbin) channel-profile
+        # matrix instead of the cube, and ``disp_base`` IS the pristine
+        # ``disp_clean`` (callers guarantee pulse inactive + dispersed
+        # stats frame + a non-DEDISP input, where the two are the same
+        # quantity).  ded_cube is never touched: XLA dead-code-eliminates
+        # the preamble's cube rotation, leaving ONE resident cube and two
+        # cube reads per iteration (this pass + the diagnostics kernel).
+        from iterative_cleaner_tpu.ops.dsp import (
+            template_numerator_from_channel_profiles,
+            weighted_marginal_totals,
+        )
         from iterative_cleaner_tpu.ops.psrchive_baseline import (
-            template_correction,
+            template_correction_from_totals,
         )
 
-        disp_clean, base_offsets, duty = baseline_corr
-        template = template + template_correction(
-            disp_clean, base_offsets, weights, duty, jnp)
+        _, base_offsets, duty = baseline_corr
+        a, t1 = weighted_marginal_totals(disp_base, weights, jnp)
+        num = template_numerator_from_channel_profiles(
+            a, back_shifts, rotation, jnp)
+        den = jnp.sum(weights)
+        safe = jnp.where(den == 0, jnp.ones_like(den), den)
+        template = jnp.where(den == 0, jnp.zeros_like(num), num / safe)
+        template = template + template_correction_from_totals(
+            t1, base_offsets, weights, duty, jnp)
+    else:
+        template = weighted_template(ded_cube, weights, jnp)
+        if baseline_corr is not None:
+            # integration baseline mode: the reference recomputes baselines
+            # on every template build with the CURRENT weights (:88-94);
+            # the hoisted preamble used the original weights, and the
+            # difference is exactly a scalar template shift
+            # (ops/psrchive_baseline)
+            from iterative_cleaner_tpu.ops.psrchive_baseline import (
+                template_correction,
+            )
+
+            disp_clean, base_offsets, duty = baseline_corr
+            template = template + template_correction(
+                disp_clean, base_offsets, weights, duty, jnp)
     template = template * 10000.0  # ref :94
     diags = diagnostics_given_template(
         ded_cube, disp_base, template, orig_weights, cell_mask, back_shifts,
         pulse_slice=pulse_slice, pulse_scale=pulse_scale,
         pulse_active=pulse_active, rotation=rotation, fft_mode=fft_mode,
         stats_impl=stats_impl, stats_frame=stats_frame,
-        shard_mesh=shard_mesh,
+        shard_mesh=shard_mesh, disp_iteration=disp_iteration,
     )
     if shard_mesh is not None and median_impl == "pallas":
         from iterative_cleaner_tpu.parallel.shard_stats import (
@@ -166,7 +215,8 @@ def diagnostics_given_template(ded_cube, disp_base, template, orig_weights,
                                cell_mask, back_shifts, *, pulse_slice,
                                pulse_scale, pulse_active, rotation,
                                fft_mode="fft", stats_impl="xla",
-                               stats_frame="dispersed", shard_mesh=None):
+                               stats_frame="dispersed", shard_mesh=None,
+                               disp_iteration=False):
     """The per-cell half of an iteration for an already-built template:
     fit, residual, weighting, four diagnostics.  Everything here is
     cell-local (bin-axis reductions only), which is what lets the exact
@@ -205,6 +255,64 @@ def diagnostics_given_template(ded_cube, disp_base, template, orig_weights,
         # lets the cube part live in disp_base)
         rot_t = rotate_bins(jnp.broadcast_to(t, (nchan, nbin)), back_shifts,
                             jnp, method=rotation)
+        if disp_iteration:
+            # One-read variant: the fit happens in the dispersed frame
+            # against rot_t — EXACT, because rotation is self-adjoint up
+            # to shift sign (<R(-s)x, t> == <x, R(s)t>, Nyquist
+            # attenuation included; verified to 1e-14) — so the
+            # dedispersed cube is never read.  The reference-faithful
+            # residual base is the ROUND-TRIPPED cube R(s)R(-s)disp, which
+            # for fourier rotation with fractional shifts differs from
+            # disp by exactly one rank-one term per channel:
+            #     R(s)R(-s)x = x + (cos^2(pi*s) - 1) * nyq(x),
+            # nyq(x)[b] = (1/n)(-1)^b sum_b' (-1)^b' x[b'] (the Nyquist
+            # component a real-FFT phase ramp attenuates, ops/dsp.py
+            # rotate_bins docstring).  Applying that term per cell costs
+            # one alternating-sign reduction instead of a cube-sized
+            # double rotation.  Roll rotation (a permutation) and odd
+            # nbin round-trip exactly: no correction.
+            apply_nyq = rotation == "fourier" and nbin % 2 == 0
+            nyq_row = None
+            if apply_nyq:
+                # fractional part keeps the cos argument small (f32 range
+                # reduction at pi*s for s ~ nbin loses ~1e-5 of gamma)
+                frac = back_shifts - jnp.round(back_shifts)
+                gamma = jnp.cos(np.pi * frac.astype(ded_cube.dtype)) ** 2 \
+                    - 1.0
+                alt = (1.0 - 2.0 * (jnp.arange(nbin) % 2)).astype(
+                    ded_cube.dtype)
+                nyq_row = (gamma / nbin)[:, None] * alt[None, :]
+            if stats_impl == "fused":
+                if shard_mesh is not None:
+                    from iterative_cleaner_tpu.parallel.shard_stats import (
+                        sharded_cell_diagnostics_fused_disp,
+                    )
+
+                    return sharded_cell_diagnostics_fused_disp(
+                        shard_mesh, disp_base, rot_t, nyq_row, template,
+                        orig_weights, cell_mask)
+                from iterative_cleaner_tpu.stats.pallas_kernels import (
+                    cell_diagnostics_pallas_disp,
+                )
+
+                return cell_diagnostics_pallas_disp(
+                    disp_base, rot_t, nyq_row, template, orig_weights,
+                    cell_mask)
+            from iterative_cleaner_tpu.ops.dsp import (
+                fit_template_amplitudes_disp,
+            )
+
+            amps = fit_template_amplitudes_disp(disp_base, rot_t, template,
+                                                jnp)
+            base = disp_base
+            if apply_nyq:
+                alt = (1.0 - 2.0 * (jnp.arange(nbin) % 2)).astype(
+                    ded_cube.dtype)
+                nyqcoef = jnp.sum(disp_base * alt, axis=-1)       # (S, C)
+                base = disp_base + nyqcoef[:, :, None] * nyq_row[None]
+            resid = amps[:, :, None] * rot_t[None] - base
+            weighted = resid * orig_weights[:, :, None]
+            return cell_diagnostics_jax(weighted, cell_mask, fft_mode)
         if stats_impl == "fused":
             if shard_mesh is not None:
                 from iterative_cleaner_tpu.parallel.shard_stats import (
@@ -238,7 +346,8 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
                           stats_impl="xla",
                           stats_frame="dispersed",
                           shard_mesh=None,
-                          baseline_corr=None) -> CleanOutputs:
+                          baseline_corr=None,
+                          disp_iteration=False) -> CleanOutputs:
     """Run the full iteration loop on an already-prepared cube.
 
     ``ded_cube``: baseline-removed, dedispersed (nsub, nchan, nbin) cube.
@@ -250,12 +359,32 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
     :func:`iterative_cleaner_tpu.ops.dsp.prepare_cube_integration` — the
     per-iteration template then gets the current-weights consensus
     correction; ``None`` (profile mode) keeps templates purely hoisted.
+
+    ``disp_iteration`` (callers enable it for integration mode +
+    dispersed stats frame + pulse window inactive + non-DEDISP input):
+    the whole iteration runs in the dispersed frame — ``disp_base`` is
+    the pristine ``disp_clean`` itself (its double-rotated twin differs
+    only by rotation-matrix fp noise), the template stage derives from
+    one marginal pass over it, and the fit happens against the rotated
+    template — so ``ded_cube`` is never read inside the loop and XLA
+    dead-code-eliminates the preamble's cube rotation: one resident
+    cube, two cube reads per iteration.
     """
     nsub, nchan, _ = ded_cube.shape
     wdtype = orig_weights.dtype
     cell_mask = orig_weights == 0  # ref :115 (mask where weight exactly 0)
+    if disp_iteration:
+        if baseline_corr is None or baseline_corr[0] is None:
+            raise ValueError("disp_iteration requires the integration "
+                             "baseline_corr triple (disp_clean, ...)")
+        if stats_frame == "dedispersed" or pulse_active:
+            raise ValueError("disp_iteration is only valid for the "
+                             "dispersed stats frame with the pulse window "
+                             "inactive")
     disp_base = None
-    if stats_frame != "dedispersed":  # the dedispersed frame never needs it
+    if disp_iteration:
+        disp_base = baseline_corr[0]
+    elif stats_frame != "dedispersed":  # dedispersed frame never needs it
         disp_base = dispersed_residual_base(
             ded_cube, back_shifts, pulse_slice=pulse_slice,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
@@ -290,7 +419,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
             pulse_active=pulse_active, rotation=rotation, fft_mode=fft_mode,
             median_impl=median_impl, stats_impl=stats_impl,
             stats_frame=stats_frame, shard_mesh=shard_mesh,
-            baseline_corr=baseline_corr,
+            baseline_corr=baseline_corr, disp_iteration=disp_iteration,
         )
         seen = jnp.arange(max_iter + 1) < c.count
         matches = jnp.all(c.history == new_w[None], axis=(1, 2)) & seen

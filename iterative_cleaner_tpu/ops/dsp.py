@@ -339,6 +339,60 @@ def weighted_template_numerator(cube, weights, xp):
     return xp.einsum("sc,scb->b", weights, cube)
 
 
+def weighted_marginal_totals(disp, weights, xp):
+    """Both weighted marginals of the dispersed cube in one logical pass:
+
+    ``A[c, b] = sum_s w[s, c] * disp[s, c, b]`` (per-channel profiles — the
+    template's raw material) and ``t1[s, b] = sum_c w[s, c] * disp[s, c, b]``
+    (per-subint totals — the integration-consensus correction's smoothed
+    profile).  The dispersed-frame iteration (engine/loop.py
+    ``disp_iteration``) derives the whole template stage from these two
+    (nbin)-row matrices, so the cube is read once here instead of twice
+    (template einsum over ded + correction einsum over disp_clean).
+    """
+    if xp is not np:
+        import jax
+
+        a = jax.lax.dot_general(
+            weights, disp, (((0,), (0,)), ((1,), (1,))),
+            precision=jax.lax.Precision.HIGHEST)      # (nchan, nbin)
+        t1 = jax.lax.dot_general(
+            weights, disp, (((1,), (1,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGHEST)      # (nsub, nbin)
+        return a, t1
+    return (np.einsum("sc,scb->cb", weights, disp),
+            np.einsum("sc,scb->sb", weights, disp))
+
+
+def template_numerator_from_channel_profiles(a, back_shifts, rotation, xp):
+    """Template numerator from the per-channel weighted profiles ``A``.
+
+    ``sum_{s,c} w*ded = sum_c rot_c^{-1}(sum_s w*disp)`` because the
+    per-channel (de)dispersion rotation is linear and weight application
+    is bin-independent — exact algebra; for roll rotation the equality is
+    bitwise (a permutation commutes with the subint sum), for fourier it
+    regroups the rotation matmul at ulp level (the same already-tolerated
+    class as the jax/numpy einsum-grouping differences).  Rotating the
+    (nchan, nbin) profile matrix costs nbin/nsub-th of rotating the cube.
+    """
+    return xp.sum(rotate_bins(a, -back_shifts, xp, method=rotation), axis=0)
+
+
+def fit_template_amplitudes_disp(disp, rot_t, template, xp):
+    """Closed-form template amplitudes evaluated in the DISPERSED frame.
+
+    ``<ded_cell, t> = <disp_cell, rot_c^{-1}(t)>`` (rotation is orthogonal
+    — exactly for roll, to fp noise for fourier), so the fit never needs
+    the dedispersed cube: ``amp = <disp, rot_t_c> / <t, t>``.  The
+    normalisation stays ``<t, t>`` (the dedispersed-frame scalar), keeping
+    one shared definition with :func:`fit_template_amplitudes`.
+    """
+    tt = xp.sum(template * template)
+    tp = xp.einsum("scb,cb->sc", disp, rot_t)
+    safe_tt = xp.where(tt == 0, xp.ones_like(tt), tt)
+    return xp.where(tt == 0, xp.ones_like(tp), tp / safe_tt)
+
+
 def weighted_template(cube, weights, xp):
     """Weight-aware fscrunch+tscrunch to a single (nbin,) profile.
 

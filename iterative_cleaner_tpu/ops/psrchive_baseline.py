@@ -121,12 +121,32 @@ def template_correction(disp_clean, base_offsets, weights, duty: float, xp):
     no (nsub, nchan, nbin) window-mean tensor is ever materialised — the
     per-iteration cost is one pass over ``disp_clean``.
     """
-    nbin = disp_clean.shape[-1]
-    w = window_width(nbin, duty)
     t1 = xp.einsum("sc,scb->sb", weights, disp_clean)
+    return template_correction_from_totals(t1, base_offsets, weights, duty,
+                                           xp)
+
+
+def template_correction_numerator_from_totals(t1, base_offsets, weights,
+                                              duty, xp):
+    """Un-normalised correction over a (tile of) per-subint weighted
+    totals ``t1 = sum_c w * disp_clean``: every term is subint-row-local
+    (window means, the per-row min) or a plain sum, so tile numerators
+    accumulate exactly to the whole-archive numerator — the exact
+    streaming mode's dispersed-frame pass 1 uses this per tile."""
+    w = window_width(t1.shape[-1], duty)
     r = xp.sum(weights * base_offsets, axis=1)       # (nsub,)
     sm = centred_window_means(t1, w, xp) + r[:, None]
-    num = xp.sum(weights * base_offsets) - xp.sum(xp.min(sm, axis=-1))
+    return xp.sum(weights * base_offsets) - xp.sum(xp.min(sm, axis=-1))
+
+
+def template_correction_from_totals(t1, base_offsets, weights, duty, xp):
+    """:func:`template_correction` when the per-subint weighted totals
+    ``t1 = sum_c w * disp_clean`` are already in hand (the dispersed-frame
+    iteration computes them in its single marginal pass,
+    ``ops.dsp.weighted_marginal_totals``) — everything left is
+    (nsub, nbin)-sized."""
+    num = template_correction_numerator_from_totals(
+        t1, base_offsets, weights, duty, xp)
     den = xp.sum(weights)
     safe = xp.where(den == 0, xp.ones_like(den), den)
     return xp.where(den == 0, xp.zeros_like(num), num / safe)

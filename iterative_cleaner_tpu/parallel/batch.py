@@ -34,7 +34,10 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
     import jax
     import jax.numpy as jnp
 
-    from iterative_cleaner_tpu.engine.loop import clean_dedispersed_jax
+    from iterative_cleaner_tpu.engine.loop import (
+        clean_dedispersed_jax,
+        disp_iteration_enabled,
+    )
 
     def one(cube, weights, freqs, dm, ref, period):
         # integration mode is pure jnp ops: GSPMD/vmap partition the
@@ -57,6 +60,10 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
             stats_frame=stats_frame, stats_impl=stats_impl,
             baseline_corr=baseline_corr,
+            # same gate as the single-archive builder (jax_backend):
+            # batched masks must equal the per-archive path's bit-for-bit
+            disp_iteration=disp_iteration_enabled(
+                baseline_mode, stats_frame, pulse_active, dedispersed),
         )
 
     return jax.jit(jax.vmap(one))

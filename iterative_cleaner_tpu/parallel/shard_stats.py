@@ -117,6 +117,37 @@ def sharded_cell_diagnostics_fused(mesh, ded, disp_base, rot_t, template,
         return fn(ded, disp_base, rot_t, template, weights, cell_mask)
 
 
+def sharded_cell_diagnostics_fused_disp(mesh, disp, rot_t, nyq_row,
+                                        template, weights, cell_mask):
+    """Dispersed-frame ONE-read fused diagnostics kernel
+    (:func:`~iterative_cleaner_tpu.stats.pallas_kernels.cell_diagnostics_pallas_disp`)
+    on each device's cube shard; the per-channel rotated template and
+    Nyquist-correction rows ride the 'chan' axis, the (nbin,) template
+    (for ||t||^2) is replicated."""
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        cell_diagnostics_pallas_disp,
+    )
+
+    apply_nyq = nyq_row is not None
+    if nyq_row is None:
+        nyq_row = jnp.zeros_like(rot_t)
+
+    def local(disp, rot_t, nyq_row, template, weights, cell_mask):
+        return cell_diagnostics_pallas_disp(
+            disp, rot_t, nyq_row if apply_nyq else None, template,
+            weights, cell_mask)
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(_CUBE, _CHAN_ROW, _CHAN_ROW, _REP, _CELL, _CELL),
+        out_specs=(_CELL,) * 4, check_vma=False,
+    )
+    with pallas_interpret(_mesh_interpret(mesh)):
+        return fn(disp, rot_t, nyq_row, template, weights, cell_mask)
+
+
 def sharded_cell_diagnostics_fused_dedisp(mesh, ded, template, window,
                                           weights, cell_mask):
     """Dedispersed-frame fused diagnostics kernel (one cube read) on each

@@ -32,7 +32,10 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from iterative_cleaner_tpu.engine.loop import clean_dedispersed_jax
+    from iterative_cleaner_tpu.engine.loop import (
+        clean_dedispersed_jax,
+        disp_iteration_enabled,
+    )
 
     mesh = mesh_ref
     cube_sh = NamedSharding(mesh, P("sub", "chan", None))
@@ -64,6 +67,10 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
             stats_frame=stats_frame, stats_impl=stats_impl,
             shard_mesh=shard_mesh, baseline_corr=baseline_corr,
+            # same gate as the single-device builder (jax_backend): the
+            # sharded masks must equal the single-chip path's bit-for-bit
+            disp_iteration=disp_iteration_enabled(
+                baseline_mode, stats_frame, pulse_active, dedispersed),
         )
 
     fn = jax.jit(

@@ -213,6 +213,17 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
     shard_mesh = mesh if (mesh is not None
                           and (median_impl == "pallas"
                                or stats_impl == "fused")) else None
+    # Dispersed-frame iteration (same gate as the whole-archive builders,
+    # engine/loop.py disp_iteration): tiles ARE the pristine disp_clean,
+    # the template + consensus-correction partials both come from each
+    # tile's one marginal pass, and the raw-cube tiles are never kept or
+    # uploaded — one fewer H2D pass per tile per iteration and half the
+    # host RAM of the ded+raw layout.
+    from iterative_cleaner_tpu.engine.loop import disp_iteration_enabled
+
+    disp_mode = disp_iteration_enabled(
+        config.baseline_mode, stats_frame, config.pulse_region_active,
+        dedispersed)
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -235,7 +246,20 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
 
     integration = config.baseline_mode == "integration"
 
-    if integration:
+    if disp_mode:
+        def prep(cube_t, w_t, freqs, dm, ref_freq, period):
+            from iterative_cleaner_tpu.ops.dsp import (
+                prepare_cube_integration,
+            )
+
+            # the DISP tile is the iteration's working cube; ded is unused
+            # downstream, so XLA dead-code-eliminates its rotation here
+            _, shifts, disp_t, v_t = prepare_cube_integration(
+                cube_t, w_t, freqs, dm, ref_freq, period, jnp,
+                baseline_duty=config.baseline_duty,
+                rotation=config.rotation, dedispersed=dedispersed)
+            return disp_t, shifts, v_t
+    elif integration:
         def prep(cube_t, w_t, freqs, dm, ref_freq, period):
             from iterative_cleaner_tpu.ops.dsp import (
                 prepare_cube_integration,
@@ -258,25 +282,59 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
 
     prep = tile_jit(prep, ("cube", "cell", "rep", "rep", "rep", "rep"))
 
-    def template_partial(ded_t, w_t):
-        return weighted_template_numerator(ded_t, w_t, jnp)
+    if disp_mode:
+        # pass 1, dispersed mode: BOTH template partials from the tile's
+        # one marginal pass — the per-channel profile partial A (summed
+        # across tiles) and the consensus-correction numerator (per-subint
+        # terms, accumulated exactly across tiles)
+        def marginal_partial(disp_t, w_t, v_t):
+            from iterative_cleaner_tpu.ops.dsp import (
+                weighted_marginal_totals,
+            )
+            from iterative_cleaner_tpu.ops.psrchive_baseline import (
+                template_correction_numerator_from_totals,
+            )
 
-    template_partial = tile_jit(template_partial, ("cube", "cell"))
+            a_part, t1 = weighted_marginal_totals(disp_t, w_t, jnp)
+            corr = template_correction_numerator_from_totals(
+                t1, v_t, w_t, config.baseline_duty, jnp)
+            return a_part, corr
 
-    def correction_partial(cube_t, v_t, w_t):
-        from iterative_cleaner_tpu.ops.psrchive_baseline import (
-            template_correction_numerator_raw,
-        )
+        template_partial = tile_jit(marginal_partial,
+                                    ("cube", "cell", "cell"))
+        correction_partial = None
+    else:
+        def template_partial(ded_t, w_t):
+            return weighted_template_numerator(ded_t, w_t, jnp)
 
-        return template_correction_numerator_raw(
-            cube_t, v_t, w_t, config.baseline_duty, jnp)
+        template_partial = tile_jit(template_partial, ("cube", "cell"))
 
-    correction_partial = tile_jit(correction_partial,
-                                  ("cube", "cell", "cell"))
+        def correction_partial(cube_t, v_t, w_t):
+            from iterative_cleaner_tpu.ops.psrchive_baseline import (
+                template_correction_numerator_raw,
+            )
+
+            return template_correction_numerator_raw(
+                cube_t, v_t, w_t, config.baseline_duty, jnp)
+
+        correction_partial = tile_jit(correction_partial,
+                                      ("cube", "cell", "cell"))
 
     def diag_tile(ded_t, template, w_orig_t, mask_t, shifts):
         from iterative_cleaner_tpu.engine.loop import dispersed_residual_base
 
+        if disp_mode:
+            # the tile IS disp_clean; the one-read dispersed iteration
+            # needs no residual base construction
+            return diagnostics_given_template(
+                ded_t, ded_t, template, w_orig_t, mask_t, shifts,
+                pulse_slice=config.pulse_slice,
+                pulse_scale=config.pulse_scale,
+                pulse_active=config.pulse_region_active,
+                rotation=config.rotation, fft_mode=fft_mode,
+                stats_impl=stats_impl, stats_frame=stats_frame,
+                shard_mesh=shard_mesh, disp_iteration=True,
+            )
         disp_base = None
         if stats_frame != "dedispersed":
             disp_base = dispersed_residual_base(
@@ -304,7 +362,8 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
                                    config.subintthresh, median_impl)
         return jnp.where(scores >= 1.0, 0.0, orig_weights), scores
 
-    return prep, template_partial, correction_partial, diag_tile, combine
+    return (prep, template_partial, correction_partial, diag_tile, combine,
+            disp_mode)
 
 
 def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
@@ -314,8 +373,9 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
     dtype = jnp.dtype(config.dtype)
     integration = config.baseline_mode == "integration"
     chunk = tiles[0].stop - tiles[0].start
-    prep, template_partial, correction_partial, diag_tile, combine = \
-        _jax_tile_fns(config, cube.shape[-1], bool(dedispersed), mesh)
+    (prep, template_partial, correction_partial, diag_tile, combine,
+     disp_mode) = _jax_tile_fns(config, cube.shape[-1], bool(dedispersed),
+                                mesh)
     if mesh is not None:
         # meshes can span processes: every sharded tile output is gathered
         # to the host before reassembly (parallel/distributed.host_fetch)
@@ -351,16 +411,19 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
     cell_mask_full = orig_weights == 0
     w_host = [pad_tile(orig_weights[sl]).astype(dtype) for sl in tiles]
     m_host = [pad_tile(cell_mask_full[sl]) for sl in tiles]
-    # integration mode keeps the raw tiles too: the per-iteration template
-    # correction smooths the current-weights raw total (see
-    # ops/psrchive_baseline.template_correction_numerator_raw)
+    # non-disp integration mode keeps the raw tiles too: its per-iteration
+    # template correction smooths the current-weights raw total (see
+    # ops/psrchive_baseline.template_correction_numerator_raw).  The
+    # dispersed-frame mode derives the correction from the DISP tiles'
+    # own marginal pass, so no raw retention and no raw uploads.
+    keep_raw = integration and not disp_mode
     cube_host = [pad_tile(np.asarray(cube[sl]).astype(dtype))
-                 for sl in tiles] if integration else None
-    ded_tiles = []
+                 for sl in tiles] if keep_raw else None
+    ded_tiles = []  # disp_mode: these hold the pristine DISP tiles
     v_tiles = []
     shifts = None
     for i, sl in enumerate(tiles):
-        cube_t = cube_host[i] if integration \
+        cube_t = cube_host[i] if keep_raw \
             else pad_tile(np.asarray(cube[sl]).astype(dtype))
         ded_t, shifts, v_t = prep(jnp.asarray(cube_t),
                                   jnp.asarray(w_host[i]),
@@ -393,7 +456,9 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
         def put_template_inputs(i):
             w_d = jnp.asarray(cur_host[i])
             ins = [jnp.asarray(ded_tiles[i]), w_d]
-            if integration:
+            if disp_mode:
+                ins += [jnp.asarray(v_tiles[i])]
+            elif integration:
                 ins += [jnp.asarray(cube_host[i]), jnp.asarray(v_tiles[i])]
             return ins
 
@@ -405,16 +470,21 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
             nonlocal num, corr
             part = np.asarray(host_fetch(pending[0]))
             num = part if num is None else num + part
-            if integration:
+            if pending[1] is not None:
                 cp = np.asarray(host_fetch(pending[1]))
                 corr = cp if corr is None else corr + cp
 
         nxt = put_template_inputs(0)
         for i in range(n_tiles):
             ded_d, w_d = nxt[0], nxt[1]
-            part = template_partial(ded_d, w_d)
-            cp = correction_partial(nxt[2], nxt[3], w_d) if integration \
-                else None
+            if disp_mode:
+                # one marginal pass: the channel-profile partial AND the
+                # consensus-correction numerator from the same tile read
+                part, cp = template_partial(ded_d, w_d, nxt[2])
+            else:
+                part = template_partial(ded_d, w_d)
+                cp = correction_partial(nxt[2], nxt[3], w_d) \
+                    if integration else None
             if i + 1 < n_tiles:
                 nxt = put_template_inputs(i + 1)
             if pending is not None:
@@ -425,6 +495,15 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
         # the denominator's operand is the full (nsub, nchan) plane — never
         # tiled — so it is the same device reduction the whole path runs
         num = jnp.asarray(num)
+        if disp_mode:
+            # the accumulated partial is the (nchan, nbin) channel-profile
+            # matrix A; dedisperse IT (nbin/nsub-th of a cube rotation)
+            from iterative_cleaner_tpu.ops.dsp import (
+                template_numerator_from_channel_profiles,
+            )
+
+            num = template_numerator_from_channel_profiles(
+                num, jnp.asarray(shifts), config.rotation, jnp)
         den = jnp.sum(jnp.asarray(cur.astype(dtype)))
         safe = jnp.where(den == 0, 1.0, den)
         template = jnp.where(den == 0, jnp.zeros_like(num), num / safe)

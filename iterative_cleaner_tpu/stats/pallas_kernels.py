@@ -544,6 +544,42 @@ def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
                  std_ref, mean_ref, ptp_ref, fft_ref, num_k)
 
 
+def _cell_stats_disp_kernel(disp_ref, rott_ref, nyq_ref, w_ref, m_ref,
+                            cos_ref, sin_ref, tt_ref,
+                            std_ref, mean_ref, ptp_ref, fft_ref, *, num_k,
+                            apply_nyq):
+    """Dispersed-frame ONE-read variant (pulse window inactive): the fit
+    inner product moves into the dispersed frame — ``<ded, t>`` equals
+    ``<disp, rot_c(t)>`` EXACTLY (rotation is self-adjoint up to shift
+    sign, Nyquist attenuation included) — so the dedispersed cube is
+    never read.  Normalisation stays the dedispersed ``<t, t>`` scalar
+    (ops.dsp.fit_template_amplitudes_disp).
+
+    The reference-faithful residual base is the round-tripped cube
+    ``R(s)R(-s)disp = disp + (cos^2(pi s)-1)*nyq(disp)`` (fourier
+    fractional shifts attenuate the Nyquist bin; engine/loop.py
+    disp_iteration): with ``apply_nyq`` the rank-one correction costs one
+    alternating-sign reduction per VMEM-resident cell — ``nyq_ref`` rows
+    carry ``(gamma_c / nbin) * (-1)^b``.  Roll rotation / odd nbin
+    round-trip exactly: the static flag compiles the term away."""
+    rott = rott_ref[0]                              # (C, B)
+    tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
+    disp = disp_ref[:]                              # (S, C, B)
+    tp = jnp.sum(disp * rott[None], axis=2)
+    amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
+    base = disp
+    if apply_nyq:
+        nbin = disp.shape[-1]
+        alt = (1.0 - 2.0 * (jax.lax.broadcasted_iota(
+            jnp.int32, (nbin,), 0) % 2)).astype(disp.dtype)
+        nyqcoef = jnp.sum(disp * alt[None, None, :], axis=2)
+        base = disp + nyqcoef[:, :, None] * nyq_ref[0][None]
+    resid = amp[:, :, None] * rott[None] - base
+    wres = resid * w_ref[0][:, :, None]             # apply_weights
+    _write_diags(wres, m_ref[0], cos_ref, sin_ref,
+                 std_ref, mean_ref, ptp_ref, fft_ref, num_k)
+
+
 def _cell_stats_dedisp_kernel(ded_ref, t_ref, win_ref, w_ref, m_ref,
                               cos_ref, sin_ref, tt_ref,
                               std_ref, mean_ref, ptp_ref, fft_ref, *, num_k):
@@ -765,6 +801,72 @@ def cell_diagnostics_pallas(ded, disp_base, rot_t, template, weights,
     the pallas_call."""
     return _fused_dispersed(ded, disp_base, rot_t, template,
                             weights.astype(jnp.float32), cell_mask)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_k", "interpret", "blocks",
+                                    "apply_nyq"))
+def _cell_stats_disp_call(disp, rot_t, nyq_row, tt_info, weights,
+                          cell_mask, cos_t, sin_t, num_k, interpret,
+                          blocks, apply_nyq):
+    sc = _FusedScaffold(*disp.shape[1:], num_k, batch=disp.shape[0],
+                        blocks=blocks)
+    weights, cell_mask = sc.pad_cells(weights, cell_mask)
+    return sc.launch(
+        functools.partial(_cell_stats_disp_kernel, apply_nyq=apply_nyq),
+        (sc.pad_cube(disp), sc.pad_chan_row(rot_t),
+         sc.pad_chan_row(nyq_row), weights, cell_mask),
+        (sc.cube_spec, sc.chan_row_spec, sc.chan_row_spec, sc.cell_spec,
+         sc.cell_spec),
+        cos_t, sin_t, tt_info, interpret,
+    )
+
+
+def _fused_disp_batched(disp, rot_t, nyq_row, template, weights, cell_mask,
+                        apply_nyq):
+    cos_t, sin_t, num_k, interpret = _fused_tables(disp.shape[-1],
+                                                   disp.dtype)
+    return _cell_stats_disp_call(disp, rot_t, nyq_row, _tt_info(template),
+                                 weights.astype(jnp.float32), cell_mask,
+                                 cos_t, sin_t, num_k, interpret,
+                                 _cell_blocks(disp.shape[-1]), apply_nyq)
+
+
+@functools.lru_cache(maxsize=2)
+def _fused_disp_fn(apply_nyq: bool):
+    from jax.custom_batching import custom_vmap as _custom_vmap
+
+    @_custom_vmap
+    def f(disp, rot_t, nyq_row, template, weights, cell_mask):
+        outs = _fused_disp_batched(disp[None], rot_t[None], nyq_row[None],
+                                   template[None], weights[None],
+                                   cell_mask[None], apply_nyq)
+        return tuple(o[0] for o in outs)
+
+    @f.def_vmap
+    def _rule(axis_size, in_batched, *args):
+        return (_fused_disp_batched(
+            *_batch_args(axis_size, in_batched, *args), apply_nyq),
+            (True,) * 4)
+
+    return f
+
+
+def cell_diagnostics_pallas_disp(disp, rot_t, nyq_row, template, weights,
+                                 cell_mask):
+    """Dispersed-frame ONE-read fused diagnostics (pulse window inactive):
+    fit + residual + four diagnostics with the fit evaluated against the
+    per-channel rotated template, so the dedispersed cube is never read
+    (engine/loop.py ``disp_iteration``).  ``nyq_row`` is the per-channel
+    Nyquist-correction row (``None`` for roll rotation / odd nbin, where
+    the rotation round-trips exactly).  Returns (d_std, d_mean, d_ptp,
+    d_fft); batches under ``vmap`` like :func:`cell_diagnostics_pallas`."""
+    apply_nyq = nyq_row is not None
+    if nyq_row is None:
+        nyq_row = jnp.zeros_like(rot_t)
+    return _fused_disp_fn(apply_nyq)(
+        disp, rot_t, nyq_row, template,
+        weights.astype(jnp.float32), cell_mask)
 
 
 @functools.partial(jax.jit,

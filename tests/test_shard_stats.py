@@ -111,15 +111,22 @@ def _archive(nsub=16, nchan=32, nbin=64, seed=3):
     return ar
 
 
-@pytest.mark.parametrize("stats_frame", ["dispersed", "dedispersed"])
-def test_sharded_pallas_clean_matches_single_device(stats_frame):
+@pytest.mark.parametrize("stats_frame,rotation", [
+    ("dispersed", "roll"),
+    ("dispersed", "fourier"),   # default rotation: exercises the sharded
+                                # Nyquist-correction rows (_CHAN_ROW
+                                # nyq_row wiring of the disp_iteration
+                                # fused kernel) — the production combo
+    ("dedispersed", "roll"),
+])
+def test_sharded_pallas_clean_matches_single_device(stats_frame, rotation):
     """Full sharded cleaning with median_impl='pallas' + stats_impl='fused'
     produces the same mask as the single-device engine (both impl pairs)."""
     from iterative_cleaner_tpu.backends.jax_backend import clean_cube
 
     ar = _archive()
-    kw = dict(max_iter=3, rotation="roll", fft_mode="dft", dtype="float32",
-              stats_frame=stats_frame)
+    kw = dict(max_iter=3, rotation=rotation, fft_mode="dft",
+              dtype="float32", stats_frame=stats_frame)
     cfg_pallas = CleanConfig(median_impl="pallas", stats_impl="fused", **kw)
     cfg_sort = CleanConfig(median_impl="sort", stats_impl="xla", **kw)
 
