@@ -94,16 +94,27 @@ def _hbm_peak(device_kind: str):
 
 
 def _cube_passes(stats_impl, stats_frame, baseline_mode="integration"):
-    """HBM cube reads per iteration for the bytes-moved model: the template
-    einsum always reads the cube once; the fused kernel reads ded+disp_base
-    (dispersed frame) or just ded (dedispersed frame); the XLA path
-    additionally materialises the residual cube (write + two stat-pass
-    reads on top of the fit/base reads).  The integration baseline mode
-    adds one pass: the per-iteration consensus correction smooths the
-    current-weights total of the baseline-removed cube."""
-    base = 1.0 if baseline_mode == "integration" else 0.0
+    """HBM cube reads per iteration for the bytes-moved model.
+
+    The DEFAULT config (integration baseline + dispersed stats frame +
+    pulse window off) runs the dispersed-frame iteration
+    (engine/loop.py ``disp_iteration``): ONE marginal pass over
+    disp_clean covers the template AND the consensus correction, and the
+    fused one-read kernel covers fit + residual + diagnostics — 2 cube
+    passes total.  The dedispersed frame keeps its own one-read kernel
+    plus the template einsum (2) + the correction pass (1).  XLA paths
+    additionally materialise the residual cube (write + two stat-pass
+    reads on top of the marginal/fit reads)."""
     if stats_impl == "fused":
+        if baseline_mode == "integration" and stats_frame == "dispersed":
+            return 2.0                       # disp_iteration: marginal+kernel
+        base = 1.0 if baseline_mode == "integration" else 0.0
         return base + (2.0 if stats_frame == "dedispersed" else 3.0)
+    if baseline_mode == "integration" and stats_frame == "dispersed":
+        # disp_iteration XLA twin: marginal + fit read + resid write
+        # + 2 stat reads
+        return 5.0
+    base = 1.0 if baseline_mode == "integration" else 0.0
     # template + fit read + base read + resid write + 2 stat reads
     return base + 6.0
 

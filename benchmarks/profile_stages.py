@@ -223,6 +223,42 @@ def main():
         timeit(label, one_iter,
                ded, disp_base, weights, cell_mask, shifts, passes=passes)
 
+    # round 5: the dispersed-frame iteration's stages (the production
+    # default path — engine/loop.py disp_iteration)
+    from iterative_cleaner_tpu.ops.dsp import weighted_marginal_totals
+
+    disp_clean = jax.jit(lambda c, v: c - v[..., None])(cube, v_offsets)
+    timeit("marginal pass (A + t1, one read)",
+           lambda d, w: weighted_marginal_totals(d, w, jnp),
+           disp_clean, weights, passes=1)
+    if on_tpu and fused_ok:
+        from iterative_cleaner_tpu.stats.pallas_kernels import (
+            cell_diagnostics_pallas_disp)
+
+        nyq_row = jax.jit(lambda s: (
+            (jnp.cos(np.pi * (s - jnp.round(s))) ** 2 - 1.0)
+            / args.nbin)[:, None]
+            * (1.0 - 2.0 * (jnp.arange(args.nbin) % 2))[None, :])(shifts)
+        timeit("cell diagnostics (disp one-read)",
+               lambda d, rt, nq, t, w, m: cell_diagnostics_pallas_disp(
+                   d, rt, nq, t, w, m),
+               disp_clean, rot_t, nyq_row, template, weights, cell_mask,
+               passes=1)
+
+    def one_iter_disp(disp_clean, weights, cell_mask, shifts, v):
+        new_w, _ = iteration_step(
+            disp_clean, disp_clean, weights, weights, cell_mask, shifts,
+            chanthresh=5.0, subintthresh=5.0, pulse_slice=(0, 0),
+            pulse_scale=1.0, pulse_active=False, rotation="fourier",
+            fft_mode="dft" if on_tpu else "fft",
+            median_impl="pallas" if on_tpu else "sort",
+            stats_impl="fused" if (on_tpu and fused_ok) else "xla",
+            baseline_corr=(disp_clean, v, 0.15), disp_iteration=True)
+        return new_w
+
+    timeit("iteration_step (DISP-FRAME, default)", one_iter_disp,
+           disp_clean, weights, cell_mask, shifts, v_offsets, passes=2)
+
     if on_tpu and fused_ok:
         def one_iter_dedisp(ded, weights, cell_mask, shifts):
             new_w, _ = iteration_step(

@@ -27,9 +27,34 @@ for axis in (0, 1):
     jax.block_until_ready(out); print(f"scaled_sides axis={axis}: OK")
 EOF
 
-# 1. Headline bench (round 3: ONE fused scaler launch per orientation +
-#    34-pass adjacent-rank selection — expect well under the recorded
-#    34.3 ms/iteration; also emits the zap-quality scorecard).
+# 0b. (round 5) Mosaic-lowering validation of the dispersed-frame
+#     iteration's kernels at full size: the one-read fused disp kernel
+#     (with the Nyquist-correction rows) and the marginal pass it pairs
+#     with.  Interpret tests prove bit-parity, not lowering legality.
+python - <<'EOF0B'
+import numpy as np, jax, jax.numpy as jnp
+from iterative_cleaner_tpu.ops.dsp import weighted_marginal_totals
+from iterative_cleaner_tpu.stats.pallas_kernels import (
+    cell_diagnostics_pallas_disp)
+rng = np.random.default_rng(0)
+nsub, nchan, nbin = 1024, 4096, 128
+disp = jnp.asarray(rng.normal(size=(nsub, nchan, nbin)).astype(np.float32))
+w = jnp.asarray((rng.random((nsub, nchan)) > 0.1).astype(np.float32))
+rot_t = jnp.asarray(rng.normal(size=(nchan, nbin)).astype(np.float32))
+t = jnp.asarray(rng.normal(size=nbin).astype(np.float32))
+s = jnp.asarray(rng.uniform(-20, 20, nchan).astype(np.float32))
+nyq = ((jnp.cos(np.pi*(s - jnp.round(s)))**2 - 1.0)/nbin)[:, None] \
+    * (1.0 - 2.0*(jnp.arange(nbin) % 2))[None, :]
+a, t1 = jax.jit(lambda d, ww: weighted_marginal_totals(d, ww, jnp))(disp, w)
+jax.block_until_ready((a, t1)); print("marginal pass: OK")
+outs = jax.jit(cell_diagnostics_pallas_disp)(disp, rot_t, nyq, t, w, w == 0)
+jax.block_until_ready(outs); print("disp one-read kernel (nyq): OK")
+EOF0B
+
+# 1. Headline bench (round 5: the DISPERSED-FRAME iteration — 2 cube
+#    passes/iteration vs round-2's 3+ — expect well under the 28.1 ms
+#    dispersed / 25.8 ms dedisp round-2 profile numbers; also emits the
+#    zap-quality scorecard).
 python bench.py >  "benchmarks/measured/bench_tpu_${STAMP}.json" \
                2> "benchmarks/measured/bench_tpu_${STAMP}.stderr.txt"
 

@@ -159,3 +159,114 @@ def test_weighted_template(xp):
     # all-zero weights must not divide by zero
     t0 = np.asarray(weighted_template(xp.asarray(cube), xp.zeros((2, 3)), xp))
     np.testing.assert_array_equal(t0, 0.0)
+
+
+# --- dispersed-frame iteration identities (engine/loop.py disp_iteration) --
+
+
+class TestDispIterationIdentities:
+    """The three algebraic identities the dispersed-frame fast path rests
+    on, pinned numerically so a rotate_bins change that breaks one fails
+    HERE and not as an unexplained parity drift."""
+
+    def _fixture(self, nbin=64):
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(3, 5, nbin))
+        s = rng.uniform(-10, 10, size=5)     # fractional per-channel shifts
+        t = rng.normal(size=nbin)
+        w = rng.random((3, 5))
+        return x, s, t, w
+
+    @pytest.mark.parametrize("nbin", [64, 63])
+    def test_fourier_roundtrip_is_rank_one_nyquist(self, nbin):
+        """R(s)R(-s)x = x + (cos^2(pi s) - 1) * nyq(x): the fourier
+        round trip attenuates exactly the Nyquist component (even nbin);
+        odd nbin round-trips exactly (no Nyquist bin)."""
+        from iterative_cleaner_tpu.ops.dsp import rotate_bins
+
+        x, s, _, _ = self._fixture(nbin)
+        back = rotate_bins(rotate_bins(x, -s, np, method="fourier"), s, np,
+                           method="fourier")
+        if nbin % 2:
+            np.testing.assert_allclose(back, x, rtol=0, atol=1e-12)
+            return
+        alt = (-1.0) ** np.arange(nbin)
+        nyq = (x @ alt)[..., None] * alt / nbin
+        pred = x + (np.cos(np.pi * s)[None, :, None] ** 2 - 1.0) * nyq
+        np.testing.assert_allclose(back, pred, rtol=0, atol=1e-12)
+
+    @pytest.mark.parametrize("method", ["fourier", "roll"])
+    def test_fit_adjoint_identity(self, method):
+        """<R(-s)x, t> == <x, R(s)t> EXACTLY (to fp): rotation is
+        self-adjoint up to shift sign, Nyquist attenuation included — the
+        dispersed-frame fit needs NO correction term."""
+        from iterative_cleaner_tpu.ops.dsp import rotate_bins
+
+        x, s, t, _ = self._fixture()
+        if method == "roll":
+            s = np.round(s)
+        ded = rotate_bins(x, -s, np, method=method)
+        rot_t = rotate_bins(np.broadcast_to(t, (5, len(t))), s, np,
+                            method=method)
+        lhs = np.einsum("scb,b->sc", ded, t)
+        rhs = np.einsum("scb,cb->sc", x, rot_t)
+        np.testing.assert_allclose(lhs, rhs, rtol=1e-10, atol=1e-10)
+
+    @pytest.mark.parametrize("method", ["fourier", "roll"])
+    def test_template_marginal_identity(self, method):
+        """sum_{s,c} w * R(-s)disp == sum_c R_c(-s)(A_c) where A is the
+        per-channel weighted marginal — the template never needs the
+        dedispersed cube."""
+        from iterative_cleaner_tpu.ops.dsp import (
+            rotate_bins,
+            template_numerator_from_channel_profiles,
+            weighted_marginal_totals,
+        )
+
+        x, s, _, w = self._fixture()
+        if method == "roll":
+            s = np.round(s)
+        ded = rotate_bins(x, -s, np, method=method)
+        direct = np.einsum("sc,scb->b", w, ded)
+        a, t1 = weighted_marginal_totals(x, w, np)
+        via_a = template_numerator_from_channel_profiles(a, s, method, np)
+        np.testing.assert_allclose(via_a, direct, rtol=1e-12, atol=1e-12)
+        # and the sibling marginal is the correction's per-subint totals
+        np.testing.assert_allclose(t1, np.einsum("sc,scb->sb", w, x),
+                                   rtol=1e-13)
+
+    def test_disp_iteration_scores_match_faithful_path(self):
+        """End-to-end teeth: the dispersed-frame engine's SCORES (not just
+        masks) reproduce the faithful double-rotation path to fp-noise
+        level on the default fourier config."""
+        import jax.numpy as jnp
+
+        from iterative_cleaner_tpu.engine.loop import clean_dedispersed_jax
+        from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+        from iterative_cleaner_tpu.ops.dsp import (
+            prepare_cube_with_correction,
+        )
+
+        ar, _ = make_synthetic_archive(nsub=10, nchan=14, nbin=64, seed=3,
+                                       n_rfi_cells=4, n_prezapped=6,
+                                       dtype=np.float64)
+        cube = jnp.asarray(ar.total_intensity(), dtype=jnp.float64)
+        w = jnp.asarray(ar.weights, dtype=jnp.float64)
+        f = jnp.asarray(ar.freqs_mhz, dtype=jnp.float64)
+        ded, shifts, corr = prepare_cube_with_correction(
+            cube, w, f, ar.dm, ar.centre_freq_mhz, ar.period_s, jnp,
+            baseline_duty=0.15, rotation="fourier",
+            baseline_mode="integration")
+        kw = dict(max_iter=3, chanthresh=5.0, subintthresh=5.0,
+                  pulse_slice=(0, 0), pulse_scale=1.0, pulse_active=False,
+                  rotation="fourier", baseline_corr=corr)
+        old = clean_dedispersed_jax(ded, w, shifts, disp_iteration=False,
+                                    **kw)
+        new = clean_dedispersed_jax(ded, w, shifts, disp_iteration=True,
+                                    **kw)
+        np.testing.assert_array_equal(np.asarray(old.final_weights) == 0,
+                                      np.asarray(new.final_weights) == 0)
+        assert int(old.loops) == int(new.loops)
+        np.testing.assert_allclose(np.asarray(new.scores),
+                                   np.asarray(old.scores),
+                                   rtol=1e-11, atol=1e-11)
