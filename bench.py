@@ -93,28 +93,39 @@ def _hbm_peak(device_kind: str):
     return None
 
 
-def _cube_passes(stats_impl, stats_frame, baseline_mode="integration"):
+def _cube_passes(stats_impl, stats_frame, baseline_mode="integration",
+                 shape=None):
     """HBM cube reads per iteration for the bytes-moved model.
 
     The DEFAULT config (integration baseline + dispersed stats frame +
     pulse window off) runs the dispersed-frame iteration
-    (engine/loop.py ``disp_iteration``): ONE marginal pass over
-    disp_clean covers the template AND the consensus correction, and the
-    fused one-read kernel covers fit + residual + diagnostics — 2 cube
-    passes total.  The dedispersed frame keeps its own one-read kernel
-    plus the template einsum (2) + the correction pass (1).  XLA paths
+    (engine/loop.py ``disp_iteration``): the one-read Pallas marginal
+    pass over disp_clean covers the template AND the consensus
+    correction, and the fused one-read kernel covers fit + residual +
+    diagnostics — 2 cube passes total.  When the marginal kernel is
+    ineligible (``shape`` beyond its VMEM cap, or no shape given) the
+    dual-dot fallback reads the cube twice: 3.  The dedispersed frame
+    keeps its own one-read kernel plus the template einsum (2) + the
+    correction pass (1).  XLA paths use the dual-dot marginals (2) and
     additionally materialise the residual cube (write + two stat-pass
-    reads on top of the marginal/fit reads)."""
-    if stats_impl == "fused":
-        if baseline_mode == "integration" and stats_frame == "dispersed":
-            return 2.0                       # disp_iteration: marginal+kernel
-        base = 1.0 if baseline_mode == "integration" else 0.0
-        return base + (2.0 if stats_frame == "dedispersed" else 3.0)
+    reads on top of the fit read)."""
     if baseline_mode == "integration" and stats_frame == "dispersed":
-        # disp_iteration XLA twin: marginal + fit read + resid write
-        # + 2 stat reads
-        return 5.0
+        # disp_iteration (the default engine path)
+        marginal = 2.0
+        if stats_impl == "fused" and shape is not None:
+            from iterative_cleaner_tpu.stats.pallas_kernels import (
+                marginals_pallas_eligible,
+            )
+
+            if marginals_pallas_eligible(*shape):
+                marginal = 1.0
+        if stats_impl == "fused":
+            return marginal + 1.0            # + the one-read cell kernel
+        # XLA twin: marginals + fit read + resid write + 2 stat reads
+        return marginal + 4.0
     base = 1.0 if baseline_mode == "integration" else 0.0
+    if stats_impl == "fused":
+        return base + (2.0 if stats_frame == "dedispersed" else 3.0)
     # template + fit read + base read + resid write + 2 stat reads
     return base + 6.0
 
@@ -277,7 +288,8 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=4):
         # contains the ~20-100 ms fixed dispatch/D2H cost that would
         # silently skew the utilisation figure low.
         stats_frame = "dispersed"  # build_clean_fn default above
-        passes = _cube_passes(stats_impl, stats_frame, "integration")
+        passes = _cube_passes(stats_impl, stats_frame, "integration",
+                              shape=(nsub, nchan, nbin))
         bytes_per_iter = passes * cube.nbytes
         achieved = bytes_per_iter / per_iter
         hbm_util = achieved / peak

@@ -35,9 +35,11 @@ python - <<'EOF0B'
 import numpy as np, jax, jax.numpy as jnp
 from iterative_cleaner_tpu.ops.dsp import weighted_marginal_totals
 from iterative_cleaner_tpu.stats.pallas_kernels import (
-    cell_diagnostics_pallas_disp)
+    cell_diagnostics_pallas_disp, marginals_pallas_eligible,
+    weighted_marginals_pallas)
 rng = np.random.default_rng(0)
 nsub, nchan, nbin = 1024, 4096, 128
+assert marginals_pallas_eligible(nsub, nchan, nbin)
 disp = jnp.asarray(rng.normal(size=(nsub, nchan, nbin)).astype(np.float32))
 w = jnp.asarray((rng.random((nsub, nchan)) > 0.1).astype(np.float32))
 rot_t = jnp.asarray(rng.normal(size=(nchan, nbin)).astype(np.float32))
@@ -45,8 +47,17 @@ t = jnp.asarray(rng.normal(size=nbin).astype(np.float32))
 s = jnp.asarray(rng.uniform(-20, 20, nchan).astype(np.float32))
 nyq = ((jnp.cos(np.pi*(s - jnp.round(s)))**2 - 1.0)/nbin)[:, None] \
     * (1.0 - 2.0*(jnp.arange(nbin) % 2))[None, :]
-a, t1 = jax.jit(lambda d, ww: weighted_marginal_totals(d, ww, jnp))(disp, w)
-jax.block_until_ready((a, t1)); print("marginal pass: OK")
+# the ENGINE's one-read pallas marginal kernel (dynamic-slice scratch
+# accumulation): lowering legality AND on-device agreement with the
+# XLA dual-dot form
+a_k, t1_k = jax.jit(weighted_marginals_pallas)(disp, w)
+jax.block_until_ready((a_k, t1_k))
+a_x, t1_x = jax.jit(lambda d, ww: weighted_marginal_totals(d, ww, jnp))(disp, w)
+np.testing.assert_allclose(np.asarray(a_k), np.asarray(a_x), rtol=2e-5,
+                           atol=2e-4)
+np.testing.assert_allclose(np.asarray(t1_k), np.asarray(t1_x), rtol=2e-5,
+                           atol=2e-4)
+print("marginal pallas kernel: OK (lowered + matches XLA dual-dot)")
 outs = jax.jit(cell_diagnostics_pallas_disp)(disp, rot_t, nyq, t, w, w == 0)
 jax.block_until_ready(outs); print("disp one-read kernel (nyq): OK")
 EOF0B

@@ -165,7 +165,22 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
         )
 
         _, base_offsets, duty = baseline_corr
-        a, t1 = weighted_marginal_totals(disp_base, weights, jnp)
+        use_pallas_marginals = False
+        if stats_impl == "fused" and shard_mesh is None \
+                and disp_base.dtype == jnp.float32:
+            from iterative_cleaner_tpu.stats.pallas_kernels import (
+                marginals_pallas_eligible,
+                weighted_marginals_pallas,
+            )
+
+            use_pallas_marginals = marginals_pallas_eligible(
+                *disp_base.shape)
+        if use_pallas_marginals:
+            # ONE cube read for both marginals (two XLA dots would read
+            # it twice: TPU does not fuse sibling dots)
+            a, t1 = weighted_marginals_pallas(disp_base, weights)
+        else:
+            a, t1 = weighted_marginal_totals(disp_base, weights, jnp)
         num = template_numerator_from_channel_profiles(
             a, back_shifts, rotation, jnp)
         den = jnp.sum(weights)

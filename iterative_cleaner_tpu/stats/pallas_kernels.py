@@ -473,6 +473,126 @@ FUSED_STATS_AUTO_MAX_NBIN = min(FUSED_STATS_MAX_NBIN, int(_os.environ.get(
     "ICLEAN_FUSED_AUTO_MAX_NBIN", "1024")))
 
 
+def _marginals_kernel(disp_ref, w_ref, a_ref, t1_ref, a_acc, t1_acc):
+    """Both weighted marginals of the dispersed cube in ONE sweep: the
+    per-channel profiles ``A[c] = sum_s w*disp`` and the per-subint totals
+    ``t1[s] = sum_c w*disp`` (ops.dsp.weighted_marginal_totals — two XLA
+    dots would read the cube twice; TPU does not fuse sibling dots).
+
+    The full (nc, nbin) / (ns, nbin) accumulators live in VMEM scratch
+    for the whole launch (grid steps are sequential on TPU, so the
+    accumulation order is deterministic: s-blocks outer, c-blocks inner);
+    each (S_BLK, C_BLK, nbin) cube block contributes one weighted sum to
+    each.  The outputs are written from scratch on the final step."""
+    i, j = pl.program_id(0), pl.program_id(1)
+    s_blk, c_blk, _ = disp_ref.shape
+
+    @pl.when((i == 0) & (j == 0))
+    def _zero():
+        a_acc[...] = jnp.zeros_like(a_acc)
+        t1_acc[...] = jnp.zeros_like(t1_acc)
+
+    wx = disp_ref[:] * w_ref[0][:, :, None]         # (S, C, B)
+    a_acc[pl.ds(j * c_blk, c_blk), :] += jnp.sum(wx, axis=0)
+    t1_acc[pl.ds(i * s_blk, s_blk), :] += jnp.sum(wx, axis=1)
+
+    @pl.when((i == pl.num_programs(0) - 1) & (j == pl.num_programs(1) - 1))
+    def _writeout():
+        a_ref[...] = a_acc[...]
+        t1_ref[...] = t1_acc[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _marginals_call(disp, weights, interpret):
+    nsub, nchan, nbin = disp.shape
+    s_blk, c_blk = 8, 128
+    pad_s, pad_c = (-nsub) % s_blk, (-nchan) % c_blk
+    if pad_s or pad_c:
+        disp = jnp.pad(disp, ((0, pad_s), (0, pad_c), (0, 0)))
+        weights = jnp.pad(weights, ((0, pad_s), (0, pad_c)))
+    ns, nc = nsub + pad_s, nchan + pad_c
+    grid = (ns // s_blk, nc // c_blk)
+    # weights travel chunk-major like the fused kernels' cell planes so
+    # the (1, S_BLK, C_BLK) block's last dim is a full (reshaped) dim
+    w_rows = weights.reshape(ns, nc // c_blk, c_blk).swapaxes(0, 1)
+    a, t1 = pl.pallas_call(
+        _marginals_kernel,
+        out_shape=[jax.ShapeDtypeStruct((nc, nbin), jnp.float32),
+                   jax.ShapeDtypeStruct((ns, nbin), jnp.float32)],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((s_blk, c_blk, nbin), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_blk, c_blk), lambda i, j: (j, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((nc, nbin), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ns, nbin), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[pltpu.VMEM((nc, nbin), jnp.float32),
+                        pltpu.VMEM((ns, nbin), jnp.float32)],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_SCALER_VMEM_BYTES),
+    )(disp, w_rows)
+    return a[:nchan], t1[:nsub]
+
+
+# the accumulators (and their output twins) must all fit VMEM alongside a
+# cube block; past this the engine falls back to the two-dot XLA form
+MARGINALS_PALLAS_MAX_BYTES = 24 * 2**20
+
+
+def marginals_pallas_eligible(nsub: int, nchan: int, nbin: int) -> bool:
+    """THE eligibility predicate for :func:`weighted_marginals_pallas` —
+    callers (engine/loop.py, bench.py's bytes-moved model) must use this,
+    not re-derive the scratch formula: scratch + out accumulators =
+    ``2 * (nchan + nsub) * nbin * 4`` bytes, capped so they fit VMEM
+    alongside a cube block."""
+    return 2 * (nchan + nsub) * nbin * 4 <= MARGINALS_PALLAS_MAX_BYTES
+
+
+@functools.lru_cache(maxsize=1)
+def _marginals_fn():
+    from jax.custom_batching import custom_vmap as _custom_vmap
+
+    @_custom_vmap
+    def f(disp, weights):
+        return _marginals_call(disp, weights, _interpret_default())
+
+    @f.def_vmap
+    def _rule(axis_size, in_batched, disp, weights):
+        # batched archives: the XLA dual-dot form — a vmapped pallas_call
+        # would prepend a batch grid dim and silently break the kernel's
+        # program_id bookkeeping
+        from iterative_cleaner_tpu.ops.dsp import weighted_marginal_totals
+
+        disp, weights = _batch_args(axis_size, in_batched, disp, weights)
+        outs = jax.vmap(
+            lambda d, w: weighted_marginal_totals(d, w, jnp))(disp, weights)
+        return outs, (True, True)
+
+    return f
+
+
+def weighted_marginals_pallas(disp, weights):
+    """One-read (A, t1) weighted marginals of a float32 dispersed cube —
+    the Pallas twin of :func:`ops.dsp.weighted_marginal_totals` for the
+    dispersed-frame iteration's template stage.  Accumulation order is
+    deterministic (sequential grid) but regrouped vs the XLA dots — the
+    same already-tolerated ulp class as every other kernel/XLA pairing.
+    Callers must check :data:`MARGINALS_PALLAS_MAX_BYTES` (scratch =
+    2 * (nchan + nsub) * nbin * 4 bytes) and fall back to the XLA form.
+    Under ``vmap`` the XLA form takes over (see the custom_vmap rule)."""
+    if disp.dtype != jnp.float32:
+        raise TypeError("weighted_marginals_pallas requires float32, got %s"
+                        % disp.dtype)
+    return _marginals_fn()(disp, weights.astype(jnp.float32))
+
+
 def _write_diags(wres, mask, cos_ref, sin_ref,
                  std_ref, mean_ref, ptp_ref, fft_ref, num_k):
     """Shared diagnostics tail: the four per-cell statistics of a weighted
