@@ -52,14 +52,18 @@ def _use_matmul_rotation(x, shift_bins, xp, method):
     nchan, nbin = x.shape[-2], x.shape[-1]
     elems = nchan * nbin * nbin  # the (nchan, nbin, nbin) operator tensor
     if method == "fourier":
-        # fourier-only constraints: the (nbin//2+1, nbin, nbin) cos/sin
-        # tables, and float32 only — the rounding differs at ulp level from
-        # the FFT form, and float64 is the oracle-bit-parity mode where both
-        # backends must share one algorithm (the one-hot roll matmul is
-        # bit-exact, so it needs neither restriction)
+        # fourier-only constraints: float32 only — the rounding differs at
+        # ulp level from the FFT form, and float64 is the oracle-bit-parity
+        # mode where both backends must share one algorithm (the one-hot
+        # roll matmul is bit-exact, so it needs neither restriction)
         if np.dtype(x.dtype) != np.float32:
             return False
-        elems = max(elems, (nbin // 2 + 1) * nbin * nbin)
+        if x.ndim == 2:
+            # the 2-D branch never builds the operator tensor — only the
+            # (nbin, nbin//2+1) cos/sin tables
+            elems = 2 * nbin * (nbin // 2 + 1)
+        else:
+            elems = max(elems, (nbin // 2 + 1) * nbin * nbin)
     return elems <= _ROT_MATMUL_MAX_ELEMS
 
 
@@ -120,6 +124,33 @@ def rotate_bins(x, shift_bins, xp, method="fourier"):
         b = xp.arange(nbin, dtype=x.dtype)
         # irfft reconstruction weights: DC and (even-n) Nyquist count once
         w = xp.where((k == 0) | (k == nbin // 2) & (nbin % 2 == 0), 1.0, 2.0)
+        if x.ndim == 2:
+            # Per-channel ROWS (the iteration's rot_t / channel-profile
+            # matrices): the rFFT -> phase -> irfft decomposition as three
+            # small matmuls against the (nbin, nk) tables — building the
+            # (nchan, nbin, nbin) operator tensor (268 MB at 4096x128,
+            # rebuilt per call) would dwarf the 2-D operand it rotates.
+            # Same reconstruction weights, same math as the tensor form
+            # (ulp-level fp regrouping only); cubes keep the tensor path,
+            # where it amortises over the nsub rows.
+            ang = (2.0 * np.pi / nbin) * xp.outer(b, kf)
+            cos_bk = xp.cos(ang).astype(x.dtype)
+            sin_bk = xp.sin(ang).astype(x.dtype)
+            hi = jax.lax.Precision.HIGHEST
+
+            def dot(a_, b_):
+                return jax.lax.dot_general(a_, b_, (((1,), (0,)), ((), ())),
+                                           precision=hi)
+
+            xr = dot(x, cos_bk)
+            xi = -dot(x, sin_bk)
+            theta = (2.0 * np.pi / nbin) * xp.outer(s_chan, kf)
+            pr = xp.cos(theta).astype(x.dtype)
+            pi_ = -xp.sin(theta).astype(x.dtype)
+            xr_p = xr * pr - xi * pi_
+            xi_p = xr * pi_ + xi * pr
+            wk = (w / nbin).astype(x.dtype)[None, :]
+            return (dot(xr_p * wk, cos_bk.T) - dot(xi_p * wk, sin_bk.T))
         # R_c[b, i] = (1/n) sum_k w_k cos(2*pi*k*(i - b - s_c)/n), expanded
         # via cos(a - t) = cos a cos t + sin a sin t into two small real
         # einsums against static (k, b, i) tables — all-real MXU work, much
