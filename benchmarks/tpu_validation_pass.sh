@@ -147,6 +147,18 @@ done; done > "benchmarks/measured/tier_sweep_${STAMP}.txt" 2>&1
   done
 } > "benchmarks/measured/tier_strategy_ab_${STAMP}.txt" 2>&1
 
+# 5c. (round 5) DFT-precision A/B: the fused kernel's spectrum matmuls at
+#     6-pass (highest, default) vs 3-pass (high) vs native (default) MXU
+#     precision — the kernel's FLOPs hotspot.  Keep the fastest whose
+#     full-size parity check (step 6 rerun with the same env) stays
+#     inside the borderline band; flip _DFT_PRECISION's default in
+#     stats/pallas_kernels.py only with both.
+{ for P in highest high default; do
+    echo "=== ICLEAN_DFT_PRECISION=$P ==="
+    ICLEAN_DFT_PRECISION=$P python benchmarks/profile_stages.py || true
+  done
+} > "benchmarks/measured/dft_precision_ab_${STAMP}.txt" 2>&1
+
 # 6. (round 4) Full-size mask parity on hardware (VERDICT r3 #2): the
 #    committed golden is the float64 oracle's mask; the TPU float32 path
 #    must reproduce it bit-for-bit for every kernel variant.

@@ -536,6 +536,27 @@ FUSED_STATS_AUTO_MAX_NBIN = min(FUSED_STATS_MAX_NBIN, int(_os.environ.get(
     "ICLEAN_FUSED_AUTO_MAX_NBIN", "1024")))
 
 
+# MXU precision of the fused kernel's DFT-spectrum matmuls — the kernel's
+# FLOPs hotspot.  "highest" (default) is the 6-pass bf16 f32-exact mode;
+# ICLEAN_DFT_PRECISION=high selects the 3-pass mode (~f32-accurate to
+# ~1e-6 relative, the same tolerated noise class as every kernel/XLA fp
+# regrouping; the full-size f32 gate's borderline band is 1e-2 wide) and
+# =default the chip's fastest.  A hardware A/B knob
+# (benchmarks/tpu_validation_pass.sh) — flip the default here only with a
+# measured win AND a clean full-size parity check.
+_DFT_PRECISION_CHOICES = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "high": jax.lax.Precision.HIGH,
+    "default": jax.lax.Precision.DEFAULT,
+}
+_DFT_PRECISION_NAME = _os.environ.get("ICLEAN_DFT_PRECISION", "highest")
+if _DFT_PRECISION_NAME not in _DFT_PRECISION_CHOICES:
+    raise ValueError(
+        f"ICLEAN_DFT_PRECISION={_DFT_PRECISION_NAME!r}: valid values are "
+        + "/".join(_DFT_PRECISION_CHOICES))
+_DFT_PRECISION = _DFT_PRECISION_CHOICES[_DFT_PRECISION_NAME]
+
+
 def _marginals_kernel(disp_ref, w_ref, a_ref, t1_ref, a_acc, t1_acc):
     """Both weighted marginals of the dispersed cube in ONE sweep: the
     per-channel profiles ``A[c] = sum_s w*disp`` and the per-subint totals
@@ -692,10 +713,10 @@ def _write_diags(wres, mask, cos_ref, sin_ref,
     flat = centred.reshape(-1, nbin)                # (S*C, B)
     re = jax.lax.dot_general(flat, cos_ref[:], (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32,
-                             precision=jax.lax.Precision.HIGHEST)
+                             precision=_DFT_PRECISION)
     im = jax.lax.dot_general(flat, sin_ref[:], (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32,
-                             precision=jax.lax.Precision.HIGHEST)
+                             precision=_DFT_PRECISION)
     mag2 = re * re + im * im                        # (S*C, K_CHUNK)
     chunk_max = jnp.max(mag2, axis=1).reshape(mask.shape)
 

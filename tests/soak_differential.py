@@ -18,9 +18,15 @@ zero-MAD lines, dead channels/subints — bit-identical scores required.
 
     python tests/soak_differential.py          # ~30 min on one CPU
 
-Last full run 2026-07-30 (round 4: double-buffered exact streaming,
-sublane tier plumbing, f32-seeded streaming convergence): phase 1
-300/300 clean, phase 2 200/200 clean, phase 3 100/100 clean.
+Last full run 2026-07-31 (round 5: the dispersed-frame iteration —
+marginal-pass template + Nyquist-faithful one-read kernel — plus the
+shape-bucketed --batch and PSRFITS CONTINUE/trailing-junk tolerance):
+phase 1 300/300 clean, phase 2 200/200 clean, phase 3 100/100 clean in
+~29 min.  (The VMEM-transposed axis-1 scaler and the tensor-free 2-D
+rotation landed mid-run; the scaler's interpret bit-parity is pinned by
+tests/test_pallas_stats.py, the 2-D rotation branch by
+tests/test_dsp.py::test_fourier_2d_matmul_branch_f32, and the round-end
+soak rerun covers them end-to-end.)
 """
 import os, sys, time
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
