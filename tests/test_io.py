@@ -57,3 +57,35 @@ def test_multi_pol_pscrunch():
     np.testing.assert_allclose(ar.total_intensity(), total_before)
     ar.pscrunch()  # idempotent (reference calls it defensively twice, :89)
     assert ar.npol == 1
+
+
+def test_peek_shape_all_containers(tmp_path):
+    """peek_shape returns the batching key for every container WITHOUT
+    reading the data cube, and the key equals what a full load reports —
+    npz (zip npy-header), PSRFITS (.sf SUBINT cards), .icar (144-byte
+    native header)."""
+    from iterative_cleaner_tpu.io import load_archive, peek_shape, save_archive
+    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+    ar, _ = make_synthetic_archive(nsub=6, nchan=10, nbin=32, seed=4)
+    ar.data = np.asarray(ar.data, dtype=np.float32).astype(np.float64)
+    ar.freqs_mhz = np.asarray(ar.freqs_mhz, dtype=np.float32).astype(
+        np.float64)
+    for ext in ("npz", "sf", "icar"):
+        p = str(tmp_path / f"x.{ext}")
+        save_archive(ar, p)
+        got = peek_shape(p)
+        back = load_archive(p)
+        assert got == (back.nsub, back.nchan, back.nbin, back.dedispersed)
+        assert got == (6, 10, 32, False)
+    # dedispersed flag survives the peek
+    ar.dedispersed = True
+    p = str(tmp_path / "d.npz")
+    save_archive(ar, p)
+    assert peek_shape(p)[3] is True
+    # cheap_only on a non-FITS .ar (TIMER) raises instead of bridge-loading
+    bad = str(tmp_path / "t.ar")
+    with open(bad, "wb") as f:
+        f.write(b"TIMERFMT" + b"\x00" * 64)
+    with pytest.raises((ValueError, ImportError)):
+        peek_shape(bad, cheap_only=True)
