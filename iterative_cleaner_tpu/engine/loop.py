@@ -166,16 +166,24 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
 
         _, base_offsets, duty = baseline_corr
         use_pallas_marginals = False
-        if stats_impl == "fused" and shard_mesh is None \
-                and disp_base.dtype == jnp.float32:
+        if stats_impl == "fused" and disp_base.dtype == jnp.float32:
             from iterative_cleaner_tpu.stats.pallas_kernels import (
                 marginals_pallas_eligible,
                 weighted_marginals_pallas,
             )
 
+            # sharded: the kernel sees only its shard — eligibility is
+            # per-shard, and conservatively checked on the global shape
             use_pallas_marginals = marginals_pallas_eligible(
                 *disp_base.shape)
-        if use_pallas_marginals:
+        if use_pallas_marginals and shard_mesh is not None:
+            from iterative_cleaner_tpu.parallel.shard_stats import (
+                sharded_weighted_marginals,
+            )
+
+            a, t1 = sharded_weighted_marginals(shard_mesh, disp_base,
+                                               weights)
+        elif use_pallas_marginals:
             # ONE cube read for both marginals (two XLA dots would read
             # it twice: TPU does not fuse sibling dots)
             a, t1 = weighted_marginals_pallas(disp_base, weights)

@@ -117,6 +117,29 @@ def sharded_cell_diagnostics_fused(mesh, ded, disp_base, rot_t, template,
         return fn(ded, disp_base, rot_t, template, weights, cell_mask)
 
 
+def sharded_weighted_marginals(mesh, disp, weights):
+    """One-read dual-marginal kernel per shard + the two collectives its
+    marginals need: the per-channel profiles ``A`` sum over the 'sub'
+    mesh axis, the per-subint totals ``t1`` over 'chan'.  Outputs land
+    replicated on the respective surviving axis (chan-sharded A rows,
+    sub-sharded t1 rows), matching how GSPMD lays out the XLA dual-dot
+    form."""
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        weighted_marginals_pallas,
+    )
+
+    def local(disp, weights):
+        a, t1 = weighted_marginals_pallas(disp, weights)
+        return (jax.lax.psum(a, "sub"), jax.lax.psum(t1, "chan"))
+
+    fn = jax.shard_map(
+        local, mesh=mesh, in_specs=(_CUBE, _CELL),
+        out_specs=(P("chan", None), P("sub", None)), check_vma=False,
+    )
+    with pallas_interpret(_mesh_interpret(mesh)):
+        return fn(disp, weights)
+
+
 def sharded_cell_diagnostics_fused_disp(mesh, disp, rot_t, nyq_row,
                                         template, weights, cell_mask):
     """Dispersed-frame ONE-read fused diagnostics kernel

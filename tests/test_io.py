@@ -87,5 +87,5 @@ def test_peek_shape_all_containers(tmp_path):
     bad = str(tmp_path / "t.ar")
     with open(bad, "wb") as f:
         f.write(b"TIMERFMT" + b"\x00" * 64)
-    with pytest.raises((ValueError, ImportError)):
+    with pytest.raises(ValueError, match="no header-only shape peek"):
         peek_shape(bad, cheap_only=True)
