@@ -18,15 +18,15 @@ zero-MAD lines, dead channels/subints — bit-identical scores required.
 
     python tests/soak_differential.py          # ~30 min on one CPU
 
-Last full run 2026-07-31 (round 5: the dispersed-frame iteration —
-marginal-pass template + Nyquist-faithful one-read kernel — plus the
-shape-bucketed --batch and PSRFITS CONTINUE/trailing-junk tolerance):
-phase 1 300/300 clean, phase 2 200/200 clean, phase 3 100/100 clean in
-~29 min.  (The VMEM-transposed axis-1 scaler and the tensor-free 2-D
-rotation landed mid-run; the scaler's interpret bit-parity is pinned by
-tests/test_pallas_stats.py, the 2-D rotation branch by
-tests/test_dsp.py::test_fourier_2d_matmul_branch_f32, and the round-end
-soak rerun covers them end-to-end.)
+Last full runs 2026-07-31 (round 5), both clean — phase 1 300/300,
+phase 2 200/200, phase 3 100/100:
+
+1. after the dispersed-frame iteration landed (marginal-pass template +
+   Nyquist-faithful one-read kernel, shape-bucketed --batch, PSRFITS
+   CONTINUE/trailing-junk tolerance), ~29 min;
+2. after the round's full kernel set (VMEM-transposed axis-1 scaler,
+   tensor-free 2-D rotation, dual-marginal kernel incl. its vmap
+   fallback), ~25 min.
 """
 import os, sys, time
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
