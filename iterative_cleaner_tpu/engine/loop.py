@@ -6,9 +6,15 @@ Semantics mirror the reference engine (``/root/reference/iterative_cleaner.py:65
   previous iteration's weights (the reference re-clones the archive at :97
   and :124, so zaps are re-derived from scratch each round — a cell can be
   un-zapped; SURVEY.md 2.4 quirk 1).
-- The baseline-removed, dedispersed cube is iteration-invariant (the
-  reference recomputes it from identical clones every round, :97-100); here
-  it is computed once and stays in HBM.
+- The baseline-removed cube is iteration-invariant (the reference
+  recomputes it from identical clones every round, :97-100); here it is
+  computed once and stays in HBM.  On the default configuration
+  (``disp_iteration``) that one resident cube is the DISPERSED
+  ``disp_clean`` — the cube is never rotated at all; only (nbin,)-rows
+  are — and each iteration reads it twice (marginal pass + one-read
+  diagnostics kernel).  Non-default configs (pulse window, DEDISP=1
+  inputs, profile baselines, dedispersed stats frame) keep the hoisted
+  dedispersed-cube layout this module grew up with.
 - Convergence is cycle detection against *every* earlier weight matrix
   (reference :135-141), implemented as an equality scan over a fixed
   (max_iter+1)-deep history buffer seeded with the original weights (:78-79).
