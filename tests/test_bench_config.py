@@ -84,3 +84,29 @@ def test_tpu_validation_pass_script_parses():
                                   "tpu_validation_pass.sh")],
         capture_output=True, text=True)
     assert proc.returncode == 0, proc.stderr
+
+
+def test_cube_passes_model_tracks_engine_routes():
+    """The bytes-moved model must mirror the engine's actual route
+    selection: 2 passes only when the Pallas marginal kernel is eligible,
+    3 on its dual-dot fallback, 6 for the XLA twin, and the non-default
+    configs unchanged."""
+    spec = importlib.util.spec_from_file_location(
+        "bench2", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        marginals_pallas_eligible,
+    )
+
+    full = (1024, 4096, 128)
+    assert marginals_pallas_eligible(*full)
+    assert bench._cube_passes("fused", "dispersed", shape=full) == 2.0
+    big = (1024, 4096, 1024)           # beyond the marginal kernel's cap
+    assert not marginals_pallas_eligible(*big)
+    assert bench._cube_passes("fused", "dispersed", shape=big) == 3.0
+    assert bench._cube_passes("fused", "dispersed", shape=None) == 3.0
+    assert bench._cube_passes("xla", "dispersed", shape=full) == 6.0
+    assert bench._cube_passes("fused", "dedispersed") == 3.0
+    assert bench._cube_passes("fused", "dispersed", "profile") == 3.0
+    assert bench._cube_passes("xla", "dispersed", "profile") == 6.0
