@@ -24,12 +24,15 @@ Memory: prepared tiles live in HOST RAM; the device holds one tile at a
 time (the jax path pays one H2D per tile per pass — the price of exact
 semantics on observations larger than HBM).  Cost: two passes over the
 cube per iteration (template + diagnostics) instead of the online mode's
-single pass per tile.  Under the default INTEGRATION baseline mode the
-raw tiles are kept alongside the prepared ones (the per-iteration
-template correction smooths the current-weights raw total), doubling the
-host-RAM footprint — for observations where only one copy fits, pass
-``baseline_mode='profile'`` (or ``--baseline_mode profile``), whose
-baselines need no correction and no raw retention.
+single pass per tile.  On the DEFAULT configuration the tiles are the
+pristine dispersed ``disp_clean`` (the whole-archive engine's
+``disp_iteration`` gate): the template AND consensus-correction partials
+both come from each tile's one marginal pass, so no raw-cube tiles are
+kept or uploaded — ONE host copy, two H2D passes per tile per
+iteration.  Non-default integration configs (pulse window, DEDISP=1)
+keep the raw tiles alongside the dedispersed ones (the correction
+smooths the current-weights raw total), doubling host RAM and adding a
+third per-tile upload; ``baseline_mode='profile'`` needs neither.
 
 Exactness: every per-cell quantity is computed by the same code as the
 whole-archive path on identical inputs; the only re-grouped reduction is
