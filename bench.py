@@ -303,6 +303,17 @@ def bench_jax(nsub, nchan, nbin, max_iter=5, repeats=4):
         "ms_per_iter": None if per_iter is None else round(per_iter * 1e3, 2),
         "loops": loops,
     }
+    # convergence trajectory from the engine's on-device iteration history
+    # (telemetry tentpole): lets a bench JSON line show *how* the run
+    # converged, not just how fast it went
+    im = np.asarray(outs.iter_metrics)[:loops]
+    if im.size:
+        extras["iter_history"] = {
+            "zap_count": [int(v) for v in im[:, 0]],
+            "mask_churn": [int(v) for v in im[:, 1]],
+            "residual_std_final": round(float(im[-1, 2]), 4),
+            "template_peak_final": round(float(im[-1, 3]), 4),
+        }
     return rate, dev.platform, hbm_util, quality, extras
 
 

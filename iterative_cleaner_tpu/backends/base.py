@@ -26,6 +26,11 @@ class CleanResult:
     # populated when config.record_history — feeds checkpoint/resume and
     # regression diffing (utils/checkpoint.py); no reference counterpart.
     weight_history: Optional[np.ndarray] = None
+    # (loops, 4) float32 convergence telemetry, one row per iteration run:
+    # columns are telemetry.ITER_METRIC_FIELDS (zap_count, mask_churn,
+    # residual_std, template_peak).  Recorded on-device inside the loop
+    # carry; no reference counterpart.
+    iter_metrics: Optional[np.ndarray] = None
 
     @property
     def rfi_fraction(self) -> float:

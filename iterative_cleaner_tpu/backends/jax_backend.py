@@ -210,4 +210,5 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         loop_diffs=np.asarray(outs.loop_diffs)[:loops],
         loop_rfi_frac=np.asarray(outs.loop_rfi_frac)[:loops],
         weight_history=history,
+        iter_metrics=np.asarray(outs.iter_metrics)[:loops],
     )

@@ -60,6 +60,7 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
     loops = config.max_iter
     loop_diffs = []
     loop_rfi_frac = []
+    iter_metrics = []
 
     for x in range(1, config.max_iter + 1):
         template = weighted_template(ded, weights, np)
@@ -84,6 +85,16 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         new_weights = np.where(scores >= 1.0, 0.0, orig_weights)  # ref :300-305
         loop_diffs.append(int(np.sum(new_weights != weights)))
         loop_rfi_frac.append(float(np.mean(new_weights == 0)))
+        # convergence telemetry row, same definitions as the jax engine
+        # (telemetry.ITER_METRIC_FIELDS): residual robust std is the median
+        # over valid cells of the per-cell residual std diagnostic
+        d_std = np.std(weighted, axis=2)
+        valid = ~cell_mask
+        rstd = float(np.median(d_std[valid])) if valid.any() else 0.0
+        iter_metrics.append((float(np.sum(new_weights == 0)),
+                             float(np.sum((new_weights == 0)
+                                          != (weights == 0))),
+                             rstd, float(np.max(template))))
 
         # cycle detection against every earlier weight matrix (ref :135-141)
         if any(np.array_equal(new_weights, old) for old in history):
@@ -104,4 +115,6 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         loop_diffs=np.asarray(loop_diffs),
         loop_rfi_frac=np.asarray(loop_rfi_frac),
         weight_history=np.stack(history) if config.record_history else None,
+        iter_metrics=np.asarray(iter_metrics, dtype=np.float32).reshape(
+            len(iter_metrics), 4),
     )

@@ -14,6 +14,7 @@ bridge is available); see :mod:`iterative_cleaner_tpu.io`.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import os
 import sys
@@ -133,7 +134,35 @@ def build_parser() -> argparse.ArgumentParser:
                              "result/checkpoint (regression diffing).")
     parser.add_argument("--trace", type=str, default="", metavar="DIR",
                         help="Capture a jax.profiler device trace of the "
-                             "whole run into DIR (TensorBoard/Perfetto).")
+                             "whole run into DIR (TensorBoard/Perfetto). "
+                             "Engine phases appear as icln_template / "
+                             "icln_residual_stats / icln_scores / icln_zap "
+                             "scopes; host phases as icln:load etc.")
+    parser.add_argument("--metrics-json", "--metrics_json", type=str,
+                        default="", dest="metrics_json", metavar="PATH",
+                        help="Write a JSON run report (counters, phase "
+                             "timings, per-archive iteration histories — "
+                             "ARCHITECTURE.md 'Observability') to PATH at "
+                             "session end.")
+    parser.add_argument("--prom-textfile", "--prom_textfile", type=str,
+                        default="", dest="prom_textfile", metavar="PATH",
+                        help="Write the run metrics in Prometheus text "
+                             "exposition format to PATH at session end "
+                             "(atomic write; point PATH into a node_exporter "
+                             "textfile-collector directory).")
+    parser.add_argument("--log-format", "--log_format",
+                        choices=("text", "json"), default="text",
+                        dest="log_format",
+                        help="'json' additionally emits a JSON-lines "
+                             "run-event log (one event per archive/"
+                             "iteration/phase) to clean.events.jsonl; the "
+                             "reference-format clean.log is unaffected.")
+    parser.add_argument("--event-log", "--event_log", type=str, default="",
+                        dest="event_log", metavar="PATH",
+                        help="Path for the JSON-lines event log (implies "
+                             "--log-format json behaviour for events; "
+                             "default clean.events.jsonl when --log-format "
+                             "json).")
     parser.add_argument("--timing", action="store_true",
                         help="Print per-archive load/clean/write wall-clock.")
     parser.add_argument("--keep_going", action="store_true",
@@ -231,12 +260,21 @@ def output_name(ar, args: argparse.Namespace, in_path: str) -> str:
 
 
 def clean_one(in_path: str, args: argparse.Namespace,
-              timer=None, preloaded=None, result=None) -> str:
+              timer=None, preloaded=None, result=None,
+              telemetry=None) -> str:
     """Load (unless ``preloaded``), clean (unless ``result`` is a
     precomputed CleanResult, e.g. from the batched path), and write one
-    archive; returns the output path."""
+    archive; returns the output path.
+
+    ``timer`` is normally the session-level PhaseTimer from
+    :func:`run_session` (which prints the one deterministic report at
+    session end); standalone callers that leave it None get a private
+    timer and the per-archive report under ``--timing``.  ``telemetry``
+    (a :class:`~iterative_cleaner_tpu.telemetry.run.RunTelemetry`) folds
+    the cleaned result into the run report and event log."""
     from iterative_cleaner_tpu.utils.tracing import PhaseTimer
 
+    own_timer = timer is None
     timer = timer if timer is not None else PhaseTimer()
     with timer.phase("load"):
         if preloaded is None:
@@ -352,11 +390,37 @@ def clean_one(in_path: str, args: argparse.Namespace,
 
         append_clean_log(ar_name, args, result.loops)
 
+    if telemetry is not None:
+        telemetry.record_archive(in_path, result)
+
     if not args.quiet:
         print("Cleaned archive: %s" % o_name)
-    if args.timing:
+    if args.timing and own_timer:
         print(timer.report())
     return o_name
+
+
+@contextlib.contextmanager
+def run_session(args):
+    """One CLI session, shared by the batch and sequential paths: the
+    ``--trace`` device-trace capture, the run-level telemetry sink
+    (``--metrics-json`` / ``--prom-textfile`` / event log), and — at
+    session end — the metric exports and the one deterministic
+    ``--timing`` report.  Yields the session's
+    :class:`~iterative_cleaner_tpu.telemetry.run.RunTelemetry`."""
+    from iterative_cleaner_tpu.telemetry import RunTelemetry
+    from iterative_cleaner_tpu.utils.tracing import device_trace
+
+    telemetry = RunTelemetry.from_args(args)
+    if telemetry.events is not None:
+        telemetry.events.emit("run_start", n_archives=len(args.archive))
+    try:
+        with device_trace(args.trace):
+            yield telemetry
+    finally:
+        telemetry.finalize()
+        if args.timing:
+            print(telemetry.registry.timer.report())
 
 
 def _iter_archives(paths, prefetch: int):
@@ -412,14 +476,20 @@ def _bucket_by_shape(paths: list) -> list:
     return [p for k in order for p in buckets[k]] + unpeekable
 
 
-def _run_batched(args) -> list:
+def _run_batched(args, telemetry=None) -> list:
     """--batch driver: bucket the input by shape, then group equal-shaped
     archives and clean each group in one compiled vmap program;
     per-archive outputs, console lines and logs are identical to the
-    sequential path (processing order follows the shape buckets)."""
+    sequential path (processing order follows the shape buckets).
+    Group loads and cleans are timed into the session timer (the write
+    phase is covered inside :func:`clean_one`)."""
     from iterative_cleaner_tpu.parallel.batch import clean_archives_batched
 
     cfg = config_from_args(args)
+    timer = (telemetry.registry.timer if telemetry is not None
+             else None)
+    phase = (timer.phase if timer is not None
+             else (lambda name: contextlib.nullcontext()))
     mesh = None
     if getattr(args, "mesh", "off") == "batch":
         from iterative_cleaner_tpu.parallel.mesh import batch_mesh
@@ -432,6 +502,9 @@ def _run_batched(args) -> list:
         if not args.keep_going:
             raise exc
         failed.extend(bad_paths)
+        if telemetry is not None:
+            for p in bad_paths:
+                telemetry.record_failure(p, exc)
         print("ERROR cleaning %s: %s: %s"
               % (", ".join(bad_paths), type(exc).__name__, exc),
               file=sys.stderr)
@@ -448,7 +521,8 @@ def _run_batched(args) -> list:
             p = paths[i]
             i += 1
             try:
-                ar = ar_io.load_archive(p)
+                with phase("load"):
+                    ar = ar_io.load_archive(p)
             except Exception as exc:
                 record_failure([p], exc)
                 continue
@@ -464,13 +538,15 @@ def _run_batched(args) -> list:
         if not group:
             continue
         try:
-            results = clean_archives_batched(ars, cfg, mesh)
+            with phase("clean"):
+                results = clean_archives_batched(ars, cfg, mesh)
         except Exception as exc:
             record_failure(group, exc)
             continue
         for p, ar, res in zip(group, ars, results):
             try:
-                clean_one(p, args, preloaded=ar, result=res)
+                clean_one(p, args, timer=timer, preloaded=ar, result=res,
+                          telemetry=telemetry)
             except Exception as exc:
                 record_failure([p], exc)
     return failed
@@ -549,30 +625,26 @@ def main(argv=None) -> int:
         os.environ["ICLEAN_PLATFORM"] = "cpu"
     apply_platform_override()
     enable_compile_cache(args.compile_cache)
-    from iterative_cleaner_tpu.utils.tracing import device_trace
 
     failed = []
-    if args.batch > 1:
-        with device_trace(args.trace):
-            failed = _run_batched(args)
-        if failed:
-            print("Failed %d/%d archives: %s"
-                  % (len(failed), len(args.archive), ", ".join(failed)),
-                  file=sys.stderr)
-            return 1
-        return 0
-
-    with device_trace(args.trace):
-        for in_path, preloaded in _iter_archives(list(args.archive),
-                                                 args.prefetch):
-            try:
-                clean_one(in_path, args, preloaded=preloaded)
-            except Exception as exc:  # per-archive isolation (--keep_going)
-                if not args.keep_going:
-                    raise
-                failed.append(in_path)
-                print("ERROR cleaning %s: %s: %s"
-                      % (in_path, type(exc).__name__, exc), file=sys.stderr)
+    with run_session(args) as telemetry:
+        if args.batch > 1:
+            failed = _run_batched(args, telemetry)
+        else:
+            for in_path, preloaded in _iter_archives(list(args.archive),
+                                                     args.prefetch):
+                try:
+                    clean_one(in_path, args,
+                              timer=telemetry.registry.timer,
+                              preloaded=preloaded, telemetry=telemetry)
+                except Exception as exc:  # per-archive isolation
+                    if not args.keep_going:
+                        raise
+                    failed.append(in_path)
+                    telemetry.record_failure(in_path, exc)
+                    print("ERROR cleaning %s: %s: %s"
+                          % (in_path, type(exc).__name__, exc),
+                          file=sys.stderr)
     if failed:
         print("Failed %d/%d archives: %s"
               % (len(failed), len(args.archive), ", ".join(failed)),

@@ -42,8 +42,14 @@ from iterative_cleaner_tpu.ops.dsp import (
 )
 from iterative_cleaner_tpu.stats.masked_jax import (
     cell_diagnostics_jax,
+    masked_median,
     scale_and_combine,
 )
+
+# Columns of CleanOutputs.iter_metrics, matching
+# iterative_cleaner_tpu.telemetry.ITER_METRIC_FIELDS (kept as a local
+# constant so the engine never imports the host-side telemetry package).
+ITER_METRICS_WIDTH = 4  # zap_count, mask_churn, residual_std, template_peak
 
 
 def _pulse_window(nbin, pulse_slice, pulse_scale, pulse_active, dtype):
@@ -104,6 +110,11 @@ class CleanOutputs(NamedTuple):
     loop_rfi_frac: jax.Array   # (max_iter,) zero-weight fraction per loop
     history: jax.Array         # (max_iter+1, nsub, nchan) weight matrices;
     history_count: jax.Array   # entries [0:history_count] are populated
+    # (max_iter, ITER_METRICS_WIDTH) float32 per-iteration convergence
+    # telemetry: zap_count, mask_churn, residual_std, template_peak
+    # (telemetry.ITER_METRIC_FIELDS).  Recorded inside the while_loop carry
+    # — rides the normal result fetch, no callbacks, no extra transfers.
+    iter_metrics: jax.Array
 
 
 class _Carry(NamedTuple):
@@ -117,39 +128,14 @@ class _Carry(NamedTuple):
     template_weights: jax.Array
     loop_diffs: jax.Array
     loop_rfi_frac: jax.Array
+    iter_metrics: jax.Array
 
 
-def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
-                   back_shifts, *, chanthresh, subintthresh, pulse_slice,
-                   pulse_scale, pulse_active, rotation, fft_mode="fft",
-                   median_impl="sort", stats_impl="xla",
-                   stats_frame="dispersed", shard_mesh=None,
-                   baseline_corr=None, disp_iteration=False):
-    """One cleaning iteration: template -> fit -> residual stats -> new weights.
-
-    ``weights`` are the previous iteration's (template) weights;
-    ``orig_weights``/``cell_mask`` never change (reference :112,:115-117).
-    ``disp_base`` is :func:`dispersed_residual_base` of the cube: the
-    per-iteration work touches the full cube only in the template einsum and
-    the per-cell statistics — no cube-sized rotation and no materialised
-    residual.  With ``stats_impl='fused'`` the whole per-cell half (fit,
-    residual, weighting, four diagnostics) runs as one Pallas kernel in two
-    cube reads.  With ``stats_frame='dedispersed'`` the statistics run on
-    the dedispersed residual directly (bin reductions are rotation-
-    invariant up to interpolation rounding): ``disp_base`` may be None and
-    the fused kernel reads the cube once instead of twice.  Returns
-    (new_weights, scores).
-
-    ``shard_mesh`` (a 2-D ('sub', 'chan') Mesh) routes the Pallas paths
-    through :mod:`iterative_cleaner_tpu.parallel.shard_stats` so they stay
-    partitioned under GSPMD — a bare ``pallas_call`` in a sharded program
-    would gather its operands onto every device.  The XLA/sort paths ignore
-    it (GSPMD partitions them natively).
-    """
-    if stats_impl == "fused" and fft_mode == "fft":
-        raise ValueError(
-            "stats_impl='fused' computes DFT-flavoured rFFT magnitudes; "
-            "pass fft_mode='dft'")
+def _build_template(ded_cube, disp_base, weights, back_shifts, *, rotation,
+                    stats_impl, shard_mesh, baseline_corr, disp_iteration):
+    """Template stage of one iteration (reference :88-94): the global
+    weighted template, the integration-consensus correction when active,
+    and the reference's x10000 scaling."""
     if disp_iteration:
         # Dispersed-frame iteration (the default config's fast path): the
         # whole template stage — global weighted template AND the
@@ -217,27 +203,87 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
             disp_clean, base_offsets, duty = baseline_corr
             template = template + template_correction(
                 disp_clean, base_offsets, weights, duty, jnp)
-    template = template * 10000.0  # ref :94
-    diags = diagnostics_given_template(
-        ded_cube, disp_base, template, orig_weights, cell_mask, back_shifts,
-        pulse_slice=pulse_slice, pulse_scale=pulse_scale,
-        pulse_active=pulse_active, rotation=rotation, fft_mode=fft_mode,
-        stats_impl=stats_impl, stats_frame=stats_frame,
-        shard_mesh=shard_mesh, disp_iteration=disp_iteration,
-    )
-    if shard_mesh is not None and median_impl == "pallas":
-        from iterative_cleaner_tpu.parallel.shard_stats import (
-            sharded_scale_and_combine,
-        )
+    return template * 10000.0  # ref :94
 
-        scores = sharded_scale_and_combine(shard_mesh, diags, cell_mask,
-                                           chanthresh, subintthresh,
-                                           median_impl)
-    else:
-        scores = scale_and_combine(diags, cell_mask, chanthresh,
-                                   subintthresh, median_impl)
-    new_weights = jnp.where(scores >= 1.0, 0.0, orig_weights)  # ref :300-305
-    return new_weights, scores
+
+def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
+                   back_shifts, *, chanthresh, subintthresh, pulse_slice,
+                   pulse_scale, pulse_active, rotation, fft_mode="fft",
+                   median_impl="sort", stats_impl="xla",
+                   stats_frame="dispersed", shard_mesh=None,
+                   baseline_corr=None, disp_iteration=False,
+                   with_metrics=False):
+    """One cleaning iteration: template -> fit -> residual stats -> new weights.
+
+    ``weights`` are the previous iteration's (template) weights;
+    ``orig_weights``/``cell_mask`` never change (reference :112,:115-117).
+    ``disp_base`` is :func:`dispersed_residual_base` of the cube: the
+    per-iteration work touches the full cube only in the template einsum and
+    the per-cell statistics — no cube-sized rotation and no materialised
+    residual.  With ``stats_impl='fused'`` the whole per-cell half (fit,
+    residual, weighting, four diagnostics) runs as one Pallas kernel in two
+    cube reads.  With ``stats_frame='dedispersed'`` the statistics run on
+    the dedispersed residual directly (bin reductions are rotation-
+    invariant up to interpolation rounding): ``disp_base`` may be None and
+    the fused kernel reads the cube once instead of twice.  Returns
+    (new_weights, scores), or with ``with_metrics=True``
+    (new_weights, scores, (residual_std, template_peak)) where the extras
+    are on-device scalars for the iteration-telemetry buffer.
+
+    Each stage runs under a ``jax.named_scope`` (``icln_template``,
+    ``icln_residual_stats``, ``icln_scores``, ``icln_zap``) so ``--trace``
+    captures group the fused HLO under recognisable phase names.
+
+    ``shard_mesh`` (a 2-D ('sub', 'chan') Mesh) routes the Pallas paths
+    through :mod:`iterative_cleaner_tpu.parallel.shard_stats` so they stay
+    partitioned under GSPMD — a bare ``pallas_call`` in a sharded program
+    would gather its operands onto every device.  The XLA/sort paths ignore
+    it (GSPMD partitions them natively).
+    """
+    if stats_impl == "fused" and fft_mode == "fft":
+        raise ValueError(
+            "stats_impl='fused' computes DFT-flavoured rFFT magnitudes; "
+            "pass fft_mode='dft'")
+    with jax.named_scope("icln_template"):
+        template = _build_template(
+            ded_cube, disp_base, weights, back_shifts, rotation=rotation,
+            stats_impl=stats_impl, shard_mesh=shard_mesh,
+            baseline_corr=baseline_corr, disp_iteration=disp_iteration)
+    with jax.named_scope("icln_residual_stats"):
+        diags = diagnostics_given_template(
+            ded_cube, disp_base, template, orig_weights, cell_mask,
+            back_shifts,
+            pulse_slice=pulse_slice, pulse_scale=pulse_scale,
+            pulse_active=pulse_active, rotation=rotation, fft_mode=fft_mode,
+            stats_impl=stats_impl, stats_frame=stats_frame,
+            shard_mesh=shard_mesh, disp_iteration=disp_iteration,
+        )
+    with jax.named_scope("icln_scores"):
+        if shard_mesh is not None and median_impl == "pallas":
+            from iterative_cleaner_tpu.parallel.shard_stats import (
+                sharded_scale_and_combine,
+            )
+
+            scores = sharded_scale_and_combine(shard_mesh, diags, cell_mask,
+                                               chanthresh, subintthresh,
+                                               median_impl)
+        else:
+            scores = scale_and_combine(diags, cell_mask, chanthresh,
+                                       subintthresh, median_impl)
+    with jax.named_scope("icln_zap"):
+        new_weights = jnp.where(scores >= 1.0, 0.0,
+                                orig_weights)  # ref :300-305
+    if not with_metrics:
+        return new_weights, scores
+    with jax.named_scope("icln_iter_metrics"):
+        # residual robust std: masked median of the per-cell residual-std
+        # diagnostic over valid cells — a scalar that rides the loop carry
+        # (the sharded median kernel is line-oriented; the plain sort path
+        # is correct under GSPMD and this is off the cube-sized hot path)
+        rstd = masked_median(diags[0].reshape(1, -1),
+                             cell_mask.reshape(1, -1), axis=1)[0, 0]
+        tpeak = jnp.max(template)
+    return new_weights, scores, (rstd, tpeak)
 
 
 def diagnostics_given_template(ded_cube, disp_base, template, orig_weights,
@@ -434,13 +480,15 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
         template_weights=orig_weights,
         loop_diffs=jnp.zeros((max_iter,), dtype=jnp.int32),
         loop_rfi_frac=jnp.zeros((max_iter,), dtype=ded_cube.dtype),
+        iter_metrics=jnp.zeros((max_iter, ITER_METRICS_WIDTH),
+                               dtype=jnp.float32),
     )
 
     def cond(c: _Carry):
         return (c.x < max_iter) & ~c.converged
 
     def body(c: _Carry) -> _Carry:
-        new_w, scores = iteration_step(
+        new_w, scores, (rstd, tpeak) = iteration_step(
             ded_cube, disp_base, c.weights, orig_weights, cell_mask,
             back_shifts,
             chanthresh=chanthresh, subintthresh=subintthresh,
@@ -449,6 +497,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
             median_impl=median_impl, stats_impl=stats_impl,
             stats_frame=stats_frame, shard_mesh=shard_mesh,
             baseline_corr=baseline_corr, disp_iteration=disp_iteration,
+            with_metrics=True,
         )
         seen = jnp.arange(max_iter + 1) < c.count
         matches = jnp.all(c.history == new_w[None], axis=(1, 2)) & seen
@@ -457,6 +506,13 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
         # per-loop operator telemetry (reference :129-134)
         diff = jnp.sum(new_w != c.weights).astype(jnp.int32)
         frac = jnp.mean((new_w == 0).astype(ded_cube.dtype))
+        # convergence telemetry row (telemetry.ITER_METRIC_FIELDS order);
+        # zap_count includes pre-zapped cells so the final row equals the
+        # returned weights' zero-cell count
+        zap = jnp.sum(new_w == 0).astype(jnp.float32)
+        churn = jnp.sum((new_w == 0) != (c.weights == 0)).astype(jnp.float32)
+        row = jnp.stack([zap, churn, rstd.astype(jnp.float32),
+                         tpeak.astype(jnp.float32)])
         stepped = _Carry(
             x=c.x + 1,
             weights=new_w,
@@ -468,6 +524,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
             template_weights=c.weights,
             loop_diffs=c.loop_diffs.at[c.x].set(diff),
             loop_rfi_frac=c.loop_rfi_frac.at[c.x].set(frac),
+            iter_metrics=c.iter_metrics.at[c.x].set(row),
         )
         # Under vmap, while_loop keeps running the body until every batch
         # element's cond is false; freeze already-finished elements so batched
@@ -487,6 +544,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
         loop_rfi_frac=out.loop_rfi_frac,
         history=out.history,
         history_count=out.count,
+        iter_metrics=out.iter_metrics,
     )
 
 

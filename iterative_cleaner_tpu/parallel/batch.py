@@ -146,6 +146,7 @@ def unpack_batch_results(outs, n: int,
     conv_v = np.asarray(outs.converged)
     diffs = np.asarray(outs.loop_diffs)
     fracs = np.asarray(outs.loop_rfi_frac)
+    im = np.asarray(outs.iter_metrics)
     for i in range(n):
         loops = int(loops_v[i])
         result = CleanResult(
@@ -155,6 +156,7 @@ def unpack_batch_results(outs, n: int,
             converged=bool(conv_v[i]),
             loop_diffs=diffs[i][:loops],
             loop_rfi_frac=fracs[i][:loops],
+            iter_metrics=im[i][:loops],
         )
         results.append(apply_bad_parts(result, config))
     return results

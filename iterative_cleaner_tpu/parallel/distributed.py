@@ -116,6 +116,31 @@ def host_fetch(outputs):
         outputs)
 
 
+def aggregate_metrics_across_processes(counters: dict) -> dict:
+    """Sum a ``{name: value}`` counter dict across every process of a
+    distributed run (each process cleans its own archive slice, so run
+    totals need one cross-host reduction before the coordinator exports
+    them).  Single-process runs return the dict unchanged — no collective,
+    callable without ``jax.distributed`` bootstrap.
+
+    Collective discipline: all processes must call this with the SAME key
+    set in the same program position (keys are reduced in sorted order);
+    values must be numeric.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return dict(counters)
+    from jax.experimental import multihost_utils
+
+    names = sorted(counters)
+    stacked = np.asarray([float(counters[k]) for k in names],
+                         dtype=np.float64)
+    summed = np.asarray(multihost_utils.process_allgather(stacked)).sum(
+        axis=0)
+    return {k: float(v) for k, v in zip(names, summed)}
+
+
 def hybrid_batch_cell_mesh(batch: Optional[int] = None,
                            devices: Optional[Sequence] = None):
     """3-D ('batch', 'sub', 'chan') mesh: archives sharded over hosts (DCN),

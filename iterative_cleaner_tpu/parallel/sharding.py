@@ -156,6 +156,7 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
         converged=bool(outs.converged),
         loop_diffs=np.asarray(outs.loop_diffs)[:loops],
         loop_rfi_frac=np.asarray(outs.loop_rfi_frac)[:loops],
+        iter_metrics=np.asarray(outs.iter_metrics)[:loops],
     )
     if apply_bad_parts:
         base.apply_bad_parts(result, config)

@@ -172,6 +172,42 @@ class StreamingCleaner:
         return tile
 
 
+def combine_tile_iter_metrics(tiles: List[StreamTileResult], nchan: int,
+                              chunk_nsub: int) -> Optional[np.ndarray]:
+    """Observation-level per-iteration telemetry from per-tile matrices.
+
+    Tiles iterate independently, so row i aggregates every tile's i-th
+    iteration: zap counts and mask churn sum (padding rows of the final
+    partial tile are all zero-weight — a constant ``pad * nchan`` zap
+    contribution per row, subtracted out), residual std averages weighted
+    by valid subints, template peak takes the max.  A tile that converged
+    in fewer iterations holds its final zap/residual values (its mask has
+    stopped moving, churn 0) for the remaining rows.
+    """
+    mats = [t.result.iter_metrics for t in tiles]
+    if not mats or any(m is None or len(m) == 0 for m in mats):
+        return None
+    max_loops = max(m.shape[0] for m in mats)
+    cols = {0: [], 1: [], 2: [], 3: []}
+    weights = []
+    for t, m in zip(tiles, mats):
+        tail = max_loops - m.shape[0]
+        pad_cells = (chunk_nsub - t.n_valid) * nchan
+        cols[0].append(np.concatenate(
+            [m[:, 0], np.repeat(m[-1, 0], tail)]) - pad_cells)
+        cols[1].append(np.concatenate([m[:, 1], np.zeros(tail)]))
+        cols[2].append(np.concatenate([m[:, 2], np.repeat(m[-1, 2], tail)]))
+        cols[3].append(np.concatenate([m[:, 3], np.repeat(m[-1, 3], tail)]))
+        weights.append(t.n_valid)
+    w = np.asarray(weights, dtype=np.float64)[:, None]
+    out = np.empty((max_loops, 4), dtype=np.float32)
+    out[:, 0] = np.sum(cols[0], axis=0)
+    out[:, 1] = np.sum(cols[1], axis=0)
+    out[:, 2] = np.sum(np.stack(cols[2]) * w, axis=0) / np.sum(w)
+    out[:, 3] = np.max(cols[3], axis=0)
+    return out
+
+
 def clean_streaming(archive: Archive, chunk_nsub: int,
                     config: CleanConfig, mesh=None,
                     mode: str = "exact") -> CleanResult:
@@ -216,6 +252,8 @@ def clean_streaming(archive: Archive, chunk_nsub: int,
         scores=scores,
         loops=max(t.result.loops for t in tiles),
         converged=all(t.result.converged for t in tiles),
+        iter_metrics=combine_tile_iter_metrics(
+            tiles, archive.nchan, sc.chunk_nsub),
     )
     # the bad-parts sweep runs once over the whole reassembled observation
     # (reference :156-157 semantics), never per tile

@@ -61,22 +61,31 @@ def _tile_slices(nsub: int, chunk: int) -> List[slice]:
 def _run_iterations(orig_weights, config: CleanConfig, step) -> CleanResult:
     """Host-side convergence driver shared by both backends' exact modes.
 
-    ``step(cur_weights) -> (new_weights, scores)`` is one full iteration
-    (both tile passes).  Control flow mirrors the whole-archive engines:
-    history seeded with the original weights (reference :78-79), cycle
-    detection against every earlier matrix (:135-141), per-loop telemetry
-    (:129-134), loops set on convergence or exhaustion (:139/:146).
+    ``step(cur_weights) -> (new_weights, scores[, aux])`` is one full
+    iteration (both tile passes); the optional ``aux`` is the
+    ``(residual_std, template_peak)`` pair for the iteration-telemetry
+    matrix (zap count and mask churn are recomputed here from the returned
+    weights — they are host-side in this mode anyway).  Control flow
+    mirrors the whole-archive engines: history seeded with the original
+    weights (reference :78-79), cycle detection against every earlier
+    matrix (:135-141), per-loop telemetry (:129-134), loops set on
+    convergence or exhaustion (:139/:146).
     """
     history = [orig_weights.copy()]
     cur = orig_weights
     scores = np.zeros_like(orig_weights)
     converged = False
     loops = config.max_iter
-    loop_diffs, loop_rfi = [], []
+    loop_diffs, loop_rfi, iter_rows = [], [], []
     for x in range(1, config.max_iter + 1):
-        new_w, scores = step(cur)
+        out = step(cur)
+        new_w, scores = out[0], out[1]
+        aux = out[2] if len(out) > 2 else (np.nan, np.nan)
         loop_diffs.append(int(np.sum(new_w != cur)))
         loop_rfi.append(float(np.mean(new_w == 0)))
+        iter_rows.append((float(np.sum(new_w == 0)),
+                          float(np.sum((new_w == 0) != (cur == 0))),
+                          float(aux[0]), float(aux[1])))
         if any(np.array_equal(new_w, old) for old in history):
             converged, loops, cur = True, x, new_w
             history.append(new_w)
@@ -88,6 +97,8 @@ def _run_iterations(orig_weights, config: CleanConfig, step) -> CleanResult:
         loop_diffs=np.asarray(loop_diffs),
         loop_rfi_frac=np.asarray(loop_rfi),
         weight_history=np.stack(history) if config.record_history else None,
+        iter_metrics=np.asarray(iter_rows, dtype=np.float32).reshape(
+            len(iter_rows), 4),
     )
 
 
@@ -177,7 +188,12 @@ def _clean_exact_numpy(cube, weights, freqs, dm, ref_freq, period, config,
                                     axis=0))
         scores = scale_and_combine_numpy(diags, config.chanthresh,
                                          config.subintthresh)
-        return np.where(scores >= 1.0, 0.0, orig_weights), scores
+        # telemetry aux, same definitions as the whole-archive engines
+        valid = ~cell_mask
+        rstd = (float(np.median(np.ma.getdata(diags[0])[valid]))
+                if valid.any() else 0.0)
+        return (np.where(scores >= 1.0, 0.0, orig_weights), scores,
+                (rstd, float(np.max(template))))
 
     return _run_iterations(orig_weights, config, step)
 
@@ -534,15 +550,18 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
         diag_host.append(
             tuple(np.asarray(x) for x in host_fetch(pending_d)))
 
-        diags = tuple(
-            jnp.asarray(np.concatenate([t[i] for t in diag_host],
-                                       axis=0)[:nsub])
-            for i in range(4))
+        diag_np = [np.concatenate([t[i] for t in diag_host], axis=0)[:nsub]
+                   for i in range(4)]
+        diags = tuple(jnp.asarray(d) for d in diag_np)
         new_w_d, scores_d = combine(
             diags, jnp.asarray(cell_mask_full),
             jnp.asarray(orig_weights.astype(dtype)))
+        # telemetry aux, same definitions as the whole-archive engines
+        valid = ~cell_mask_full
+        rstd = (float(np.median(diag_np[0][valid])) if valid.any() else 0.0)
+        tpeak = float(np.max(np.asarray(template)))
         return (np.asarray(new_w_d, dtype=np.float64),
-                np.asarray(scores_d))
+                np.asarray(scores_d), (rstd, tpeak))
 
     return _run_iterations(orig_weights, config, step)
 

@@ -1,0 +1,76 @@
+"""Telemetry: convergence metrics, run reports, legible device traces.
+
+The reference tool's only observability is console prints and an
+append-only ``clean.log`` (SURVEY.md §5), and the jitted port makes the
+gap worse: once the engine enters its ``lax.while_loop`` nothing about
+convergence is visible until the loop exits.  This package is the metrics
+layer that closes that gap without touching the hot loop's host/device
+boundary:
+
+- :class:`~iterative_cleaner_tpu.telemetry.registry.MetricsRegistry` —
+  counters, gauges, histograms and wall-clock phase timings (absorbing
+  ``utils/tracing.PhaseTimer``), exported as JSON or a Prometheus
+  textfile (:mod:`iterative_cleaner_tpu.telemetry.exporters`).
+- **On-device iteration history** — the engine records a bounded
+  ``(max_iter, K)`` float32 buffer inside the while_loop carry
+  (``engine/loop.py``): per-iteration zap count, mask churn, residual
+  robust std and template peak.  It rides the existing result fetch, so
+  the loop stays callback-free and adds zero extra device↔host
+  transfers; :data:`ITER_METRIC_FIELDS` names the columns.
+- :class:`~iterative_cleaner_tpu.telemetry.events.RunEventLog` — a
+  JSON-lines run-event log (CLI ``--log-format json``), one event per
+  archive / iteration / phase, alongside the reference-parity
+  ``clean.log``.
+- ``jax.named_scope`` annotations on the engine's phases and
+  ``jax.profiler.TraceAnnotation`` spans on the host phases, so
+  ``--trace`` captures read as template/diagnostics/scalers/zap in
+  Perfetto instead of a wall of fused HLO names.
+
+Everything here is jax-free (importable by the numpy-oracle path); the
+device-side recording lives in the engine.
+"""
+
+from __future__ import annotations
+
+# Columns of the on-device iteration-history buffer, in storage order.
+# zap_count:    zero-weight cells after the iteration (includes prezapped)
+# mask_churn:   cells whose zap state flipped vs the previous iteration
+# residual_std: robust (masked-median over valid cells) per-cell residual std
+# template_peak: max of the iteration's (scaled) template profile
+ITER_METRIC_FIELDS = ("zap_count", "mask_churn", "residual_std",
+                      "template_peak")
+
+METRICS_SCHEMA = "icln-run-report/1"
+EVENT_SCHEMA = "icln-event/1"
+
+from iterative_cleaner_tpu.telemetry.events import RunEventLog  # noqa: E402,F401
+from iterative_cleaner_tpu.telemetry.exporters import (  # noqa: E402,F401
+    metrics_to_json,
+    metrics_to_prometheus,
+    write_metrics_json,
+    write_prometheus_textfile,
+)
+from iterative_cleaner_tpu.telemetry.registry import (  # noqa: E402,F401
+    MetricsRegistry,
+    PhaseTimer,
+)
+from iterative_cleaner_tpu.telemetry.run import RunTelemetry  # noqa: E402,F401
+
+
+def iter_metrics_dict(iter_metrics) -> dict:
+    """``(loops, K)`` iteration-history matrix -> ``{field: [per-loop]}``
+    with counts as ints and the float columns as plain floats (JSON-ready).
+    ``None`` (a strategy without an iteration loop) maps to ``{}``."""
+    if iter_metrics is None:
+        return {}
+    import numpy as np
+
+    m = np.asarray(iter_metrics)
+    out = {}
+    for j, name in enumerate(ITER_METRIC_FIELDS):
+        col = m[:, j]
+        if name in ("zap_count", "mask_churn"):
+            out[name] = [int(round(float(v))) for v in col]
+        else:
+            out[name] = [float(v) for v in col]
+    return out

@@ -1,0 +1,62 @@
+"""JSON-lines run-event log: one event object per line.
+
+The structured sibling of the reference ``clean.log`` — machine-parseable
+where that file is byte-for-byte human prose.  Events share one schema
+tag (:data:`~iterative_cleaner_tpu.telemetry.EVENT_SCHEMA`) and carry a
+wall-clock timestamp, an event kind, and kind-specific fields:
+
+``run_start`` / ``run_end``
+    CLI session bounds; ``run_end`` carries ``ok``/``failed`` counts.
+``archive``
+    one cleaned archive: path, loops, zapped cells, per-phase seconds.
+``iteration``
+    one engine iteration (emitted post-hoc from the on-device history
+    buffer): index plus the :data:`ITER_METRIC_FIELDS` values.
+``phase``
+    one completed host phase (load/clean/write) with its duration.
+``error``
+    a failed archive under ``--keep_going``.
+
+Appends go through :func:`~iterative_cleaner_tpu.utils.logging.locked_append`
+so concurrent batch workers can share one event file without interleaving
+lines.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+from typing import Optional
+
+
+class RunEventLog:
+    """Append-only JSON-lines event sink bound to one file path."""
+
+    def __init__(self, path: str, schema: Optional[str] = None) -> None:
+        from iterative_cleaner_tpu.telemetry import EVENT_SCHEMA
+
+        self.path = path
+        self.schema = schema or EVENT_SCHEMA
+
+    def emit(self, event: str, **fields) -> None:
+        """Append one event line.  ``fields`` must be JSON-serialisable;
+        a ``ts`` field may be passed to pin the timestamp (tests)."""
+        from iterative_cleaner_tpu.utils.logging import locked_append
+
+        doc = {"schema": self.schema, "event": event}
+        if "ts" not in fields:
+            doc["ts"] = datetime.datetime.now().isoformat()
+        doc.update(fields)
+        locked_append(self.path, json.dumps(doc, sort_keys=True) + "\n")
+
+
+def read_events(path: str) -> list:
+    """Parse a JSON-lines event file back into a list of dicts (tests and
+    ad-hoc analysis; blank lines are skipped)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

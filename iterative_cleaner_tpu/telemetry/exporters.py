@@ -1,0 +1,125 @@
+"""Metric exporters: JSON run report and Prometheus textfile.
+
+Both consume :meth:`MetricsRegistry.snapshot` (or a compatible plain
+dict).  The Prometheus output follows the text exposition format the
+node_exporter textfile collector scrapes — write it to the collector
+directory and the run's counters ride the existing monitoring stack; the
+write is atomic (tmp + rename) per that collector's contract, so a
+scrape never sees a torn file.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    """Sanitise to the Prometheus metric-name charset."""
+    n = _NAME_RE.sub("_", name)
+    return f"{prefix}_{n}" if prefix else n
+
+
+def _prom_num(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    return repr(float(v))
+
+
+def metrics_to_json(snapshot: dict, extra: dict = None) -> str:
+    """One JSON document: the snapshot sections plus any ``extra``
+    top-level fields (e.g. the per-archive iteration histories).  Keys are
+    sorted — byte-stable for identical inputs."""
+    doc = dict(snapshot)
+    if extra:
+        doc.update(extra)
+    return json.dumps(doc, sort_keys=True, indent=2)
+
+
+def write_metrics_json(path: str, snapshot: dict, extra: dict = None) -> None:
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(metrics_to_json(snapshot, extra))
+            f.write("\n")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def metrics_to_prometheus(snapshot: dict, prefix: str = "icln") -> str:
+    """Prometheus text exposition of the snapshot.
+
+    Counters gain the conventional ``_total`` suffix, phase timings export
+    as ``<prefix>_phase_seconds_total{phase="..."}``, histograms as the
+    standard ``_bucket``/``_sum``/``_count`` triplet with cumulative
+    ``le`` buckets.
+    """
+    lines = []
+
+    for name in sorted(snapshot.get("counters", {})):
+        m = _prom_name(name, prefix)
+        if not m.endswith("_total"):
+            m += "_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_prom_num(snapshot['counters'][name])}")
+
+    for name in sorted(snapshot.get("gauges", {})):
+        m = _prom_name(name, prefix)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_prom_num(snapshot['gauges'][name])}")
+
+    phases = snapshot.get("phases_s", {})
+    if phases:
+        m = _prom_name("phase_seconds", prefix) + "_total"
+        lines.append(f"# TYPE {m} counter")
+        for name in sorted(phases):
+            lines.append('%s{phase="%s"} %s'
+                         % (m, name, _prom_num(phases[name])))
+
+    for name in sorted(snapshot.get("histograms", {})):
+        h = snapshot["histograms"][name]
+        m = _prom_name(name, prefix)
+        lines.append(f"# TYPE {m} histogram")
+        bounds = list(h["buckets"]) + [float("inf")]
+        for le, c in zip(bounds, h["cumulative_counts"]):
+            lines.append('%s_bucket{le="%s"} %d'
+                         % (m, _prom_num(le), c))
+        lines.append(f"{m}_sum {_prom_num(h['sum'])}")
+        lines.append(f"{m}_count {h['count']}")
+
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_prometheus_textfile(path: str, snapshot: dict,
+                              prefix: str = "icln") -> None:
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(metrics_to_prometheus(snapshot, prefix))
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Inverse of :func:`metrics_to_prometheus` for round-trip testing and
+    quick scraping: ``{metric_name_with_labels: float_value}``.  Comment
+    and blank lines are skipped."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        out[key] = float(val)
+    return out

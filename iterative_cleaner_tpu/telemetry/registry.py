@@ -1,0 +1,166 @@
+"""MetricsRegistry: counters, gauges, histograms, phase timings.
+
+The process-local metric store the CLI, bench and library callers write
+into and the exporters (:mod:`iterative_cleaner_tpu.telemetry.exporters`)
+read out of.  Deliberately tiny and dependency-free — a dict of floats,
+not a client library — because the consumers are a JSON report and a
+Prometheus textfile, both snapshot-at-exit formats.
+
+:class:`PhaseTimer` lives here (``utils/tracing`` re-exports it for
+compatibility): the registry absorbs it as its ``phases`` section, and it
+gained two abilities over the original — deterministic (sorted) reports,
+and a per-completion callback so the JSON-lines event log can emit one
+event per phase without re-instrumenting every call site.  When a jax
+profiler trace is active, each phase also opens a
+``jax.profiler.TraceAnnotation`` span so ``--trace`` captures show
+load/clean/write bands above the device lanes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+# Default histogram bucket upper bounds (seconds / loops / generic small
+# counts); callers can pass their own per-histogram.
+DEFAULT_BUCKETS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0)
+
+
+@contextlib.contextmanager
+def _trace_annotation(name: str) -> Iterator[None]:
+    """``jax.profiler.TraceAnnotation`` span when jax is already imported
+    (never imports jax itself — the numpy-oracle path stays jax-free)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        yield
+        return
+    try:
+        ann = jax.profiler.TraceAnnotation(name)
+    except Exception:
+        yield
+        return
+    with ann:
+        yield
+
+
+class PhaseTimer:
+    """Accumulates wall-clock per named phase (load / clean / write).
+
+    ``on_phase(name, seconds)`` — optional callback invoked after every
+    completed phase (the event log hook).  ``report()`` is deterministic:
+    phases print in sorted name order.
+    """
+
+    def __init__(self, on_phase: Optional[Callable[[str, float],
+                                                   None]] = None) -> None:
+        self.seconds: Dict[str, float] = {}
+        self._on_phase = on_phase
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            with _trace_annotation("icln:" + name):
+                yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.seconds[name] = self.seconds.get(name, 0.0) + dt
+            if self._on_phase is not None:
+                self._on_phase(name, dt)
+
+    def report(self) -> str:
+        total = sum(self.seconds.values())
+        parts = ["%s %.3fs" % (k, self.seconds[k])
+                 for k in sorted(self.seconds)]
+        return "Timing: %s (total %.3fs)" % (", ".join(parts), total)
+
+
+class Histogram:
+    """Prometheus-style cumulative histogram: fixed upper bounds, +Inf
+    implicit, plus sum and count."""
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        self.bounds: Tuple[float, ...] = tuple(sorted(buckets))
+        self.bucket_counts: List[int] = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.sum += v
+        self.count += 1
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.bucket_counts[i] += 1
+                return
+        self.bucket_counts[-1] += 1
+
+    def snapshot(self) -> dict:
+        # cumulative counts, Prometheus exposition convention
+        cum, acc = [], 0
+        for c in self.bucket_counts:
+            acc += c
+            cum.append(acc)
+        return {
+            "buckets": list(self.bounds),
+            "cumulative_counts": cum,  # last entry == count (the +Inf bucket)
+            "sum": self.sum,
+            "count": self.count,
+        }
+
+
+class MetricsRegistry:
+    """Counters (monotonic), gauges (last value), histograms, phases.
+
+    Thread-safe for the CLI's concurrent paths (prefetch loader threads,
+    batch workers appending through one registry).
+    """
+
+    def __init__(self, on_phase: Optional[Callable[[str, float],
+                                                   None]] = None) -> None:
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.histograms: Dict[str, Histogram] = {}
+        self.timer = PhaseTimer(on_phase=on_phase)
+
+    # -- writers ----------------------------------------------------------
+    def counter_inc(self, name: str, value: float = 1.0) -> None:
+        if value < 0:
+            raise ValueError(f"counter {name!r} cannot decrease ({value})")
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + float(value)
+
+    def gauge_set(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def histogram_observe(self, name: str, value: float,
+                          buckets: Tuple[float, ...] = DEFAULT_BUCKETS
+                          ) -> None:
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram(buckets)
+            h.observe(value)
+
+    def phase(self, name: str):
+        """Time a phase into the registry's PhaseTimer (context manager)."""
+        return self.timer.phase(name)
+
+    # -- readers ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Deterministic (sorted-key) plain-dict view, JSON-ready."""
+        with self._lock:
+            return {
+                "counters": {k: self.counters[k]
+                             for k in sorted(self.counters)},
+                "gauges": {k: self.gauges[k] for k in sorted(self.gauges)},
+                "histograms": {k: self.histograms[k].snapshot()
+                               for k in sorted(self.histograms)},
+                "phases_s": {k: self.timer.seconds[k]
+                             for k in sorted(self.timer.seconds)},
+            }

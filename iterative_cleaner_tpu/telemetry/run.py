@@ -1,0 +1,166 @@
+"""RunTelemetry: the one object the CLI threads through a session.
+
+Bundles the :class:`MetricsRegistry`, the optional JSON-lines event log,
+and the per-archive iteration histories, and knows how to flush all of it
+to the ``--metrics-json`` / ``--prom-textfile`` destinations at session
+end.  Library callers can use it too, but the primary consumer is
+``cli.run_session``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from iterative_cleaner_tpu.telemetry.events import RunEventLog
+from iterative_cleaner_tpu.telemetry.exporters import (
+    write_metrics_json,
+    write_prometheus_textfile,
+)
+from iterative_cleaner_tpu.telemetry.registry import MetricsRegistry
+
+
+# Counters reduced across processes in a distributed run.  A FIXED key set
+# (missing keys count 0) keeps the allgather shape identical on every
+# process even when their archive slices diverge (e.g. failures on one
+# host only) — the collective-discipline requirement of
+# ``aggregate_metrics_across_processes``.
+_AGGREGATED_COUNTERS = ("archives_cleaned", "archives_converged",
+                        "archives_failed", "cells_total", "cells_zapped",
+                        "iterations_total")
+
+
+class RunTelemetry:
+    """Session-scoped metric/event sink.
+
+    ``metrics_json`` / ``prom_textfile`` are output paths (``None`` to
+    skip that exporter); ``events`` is an already-bound
+    :class:`RunEventLog` or ``None``.  Phase timings recorded through
+    ``self.registry.phase(...)`` also emit ``phase`` events when the
+    event log is active.
+    """
+
+    def __init__(self, metrics_json: Optional[str] = None,
+                 prom_textfile: Optional[str] = None,
+                 events: Optional[RunEventLog] = None) -> None:
+        self.metrics_json = metrics_json
+        self.prom_textfile = prom_textfile
+        self.events = events
+        self.registry = MetricsRegistry(on_phase=self._on_phase)
+        self.archives: list = []  # per-archive report entries, append order
+
+    @classmethod
+    def from_args(cls, args) -> "RunTelemetry":
+        """Build from the parsed CLI namespace (``--metrics-json``,
+        ``--prom-textfile``, ``--event-log`` / ``--log-format json``)."""
+        event_path = getattr(args, "event_log", None) or None
+        if event_path is None and getattr(args, "log_format", "text") == "json":
+            event_path = "clean.events.jsonl"
+        events = RunEventLog(event_path) if event_path else None
+        return cls(metrics_json=getattr(args, "metrics_json", None) or None,
+                   prom_textfile=getattr(args, "prom_textfile", None) or None,
+                   events=events)
+
+    @property
+    def enabled(self) -> bool:
+        return (self.metrics_json is not None
+                or self.prom_textfile is not None
+                or self.events is not None)
+
+    def _on_phase(self, name: str, seconds: float) -> None:
+        if self.events is not None:
+            self.events.emit("phase", phase=name, seconds=seconds)
+
+    # -- recording --------------------------------------------------------
+    def record_archive(self, path: str, result, loops: Optional[int] = None
+                       ) -> None:
+        """Fold one cleaned archive's :class:`CleanResult` into the run
+        totals, keep its iteration history for the JSON report, and emit
+        ``archive`` + per-``iteration`` events."""
+        from iterative_cleaner_tpu.telemetry import iter_metrics_dict
+
+        r = self.registry
+        w = result.final_weights
+        zapped = int(w.size) - int((w != 0).sum())
+        loops = int(result.loops if loops is None else loops)
+
+        r.counter_inc("archives_cleaned")
+        r.counter_inc("iterations_total", loops)
+        r.counter_inc("cells_total", int(w.size))
+        r.counter_inc("cells_zapped", zapped)
+        if result.converged:
+            r.counter_inc("archives_converged")
+        r.gauge_set("last_rfi_fraction", float(result.rfi_fraction))
+        r.histogram_observe("loops_per_archive", loops)
+
+        history = iter_metrics_dict(getattr(result, "iter_metrics", None))
+        entry = {
+            "path": str(path),
+            "loops": loops,
+            "converged": bool(result.converged),
+            "cells_zapped": zapped,
+            "rfi_fraction": float(result.rfi_fraction),
+            "iter_history": history,
+        }
+        self.archives.append(entry)
+
+        if self.events is not None:
+            if history:
+                n = len(next(iter(history.values())))
+                for i in range(n):
+                    self.events.emit(
+                        "iteration", path=str(path), iteration=i,
+                        **{k: v[i] for k, v in history.items()})
+            self.events.emit("archive", **entry)
+
+    def record_failure(self, path: str, error: BaseException) -> None:
+        self.registry.counter_inc("archives_failed")
+        if self.events is not None:
+            self.events.emit("error", path=str(path),
+                             error=f"{type(error).__name__}: {error}")
+
+    # -- flushing ---------------------------------------------------------
+    def report(self) -> dict:
+        """The full run report: registry snapshot + schema + archives.
+
+        In a multi-process run the core counters are summed across all
+        processes (every process must reach this point — it sits on the
+        shared CLI session-exit path); single-process runs never touch a
+        collective.  ``sys.modules.get`` keeps this module importable and
+        usable without jax (the numpy-oracle path)."""
+        import sys
+
+        from iterative_cleaner_tpu.telemetry import METRICS_SCHEMA
+
+        doc = self.registry.snapshot()
+        jax = sys.modules.get("jax")
+        if jax is not None and jax.process_count() > 1:
+            from iterative_cleaner_tpu.parallel.distributed import (
+                aggregate_metrics_across_processes,
+            )
+
+            local = {k: doc["counters"].get(k, 0.0)
+                     for k in _AGGREGATED_COUNTERS}
+            doc["counters"].update(
+                {k: v for k, v in
+                 aggregate_metrics_across_processes(local).items() if v})
+        doc["schema"] = METRICS_SCHEMA
+        doc["archives"] = list(self.archives)
+        return doc
+
+    def finalize(self, failed: Optional[int] = None) -> None:
+        """Write the configured exporter outputs and the ``run_end``
+        event (``failed`` defaults to the ``archives_failed`` counter).
+        Safe to call when nothing is configured (no-op)."""
+        if failed is None:
+            failed = int(self.registry.counters.get("archives_failed", 0))
+        if self.events is not None:
+            self.events.emit("run_end",
+                             ok=len(self.archives), failed=int(failed))
+        if self.metrics_json is None and self.prom_textfile is None:
+            return
+        doc = self.report()
+        if self.metrics_json is not None:
+            # snapshot sections + schema/archives are already one doc
+            write_metrics_json(self.metrics_json, doc)
+        if self.prom_textfile is not None:
+            write_prometheus_textfile(self.prom_textfile, doc)

@@ -107,6 +107,8 @@ def save_clean_checkpoint(path: str, result: CleanResult,
         arrays["loop_rfi_frac"] = np.asarray(result.loop_rfi_frac)
     if result.weight_history is not None:
         arrays["weight_history"] = result.weight_history
+    if result.iter_metrics is not None:
+        arrays["iter_metrics"] = np.asarray(result.iter_metrics)
     # per-writer tmp name: checkpoint dirs are legitimately shared between
     # racing processes (batch fan-out), and a FIXED tmp name would let one
     # writer truncate/steal another's half-written inode mid-rename
@@ -143,6 +145,8 @@ def load_clean_checkpoint(path: str) -> Tuple[CleanResult, str, str]:
                            else None),
             weight_history=(z["weight_history"] if "weight_history" in z
                             else None),
+            iter_metrics=(z["iter_metrics"] if "iter_metrics" in z
+                          else None),
         )
         return result, str(z["fingerprint"]), str(z["config"])
 

@@ -3,13 +3,52 @@
 from __future__ import annotations
 
 import datetime
+import os
+
+
+def locked_append(path: str, text: str) -> None:
+    """Append ``text`` to ``path`` under an exclusive advisory lock.
+
+    Concurrent batch workers (CLI ``--keep_going`` fan-outs, library
+    callers cleaning from several processes) append to one shared log;
+    without the lock two writers' lines can interleave mid-line on
+    filesystems where O_APPEND atomicity does not cover multi-write
+    buffers.  ``flock`` is advisory and POSIX-only; where it is
+    unavailable (non-POSIX hosts) the plain append is kept — identical
+    bytes, just without cross-process exclusion.
+    """
+    with open(path, "a") as f:
+        try:
+            import fcntl
+
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            locked = True
+        except (ImportError, OSError):
+            locked = False
+        try:
+            # seek after acquiring: another appender may have grown the
+            # file between open and lock
+            f.seek(0, os.SEEK_END)
+            f.write(text)
+            f.flush()
+        finally:
+            if locked:
+                import fcntl
+
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
 
 
 def append_clean_log(ar_name: str, args_namespace, loops: int,
-                     log_path: str = "clean.log") -> None:
+                     log_path: str = "clean.log", timestamp=None) -> None:
     """One line per cleaned archive: timestamp, archive name, the full
     argument namespace repr, and the loop count — the reference's exact
-    format."""
-    with open(log_path, "a") as f:
-        f.write("\n %s: Cleaned %s with %s, required loops=%s"
-                % (datetime.datetime.now(), ar_name, args_namespace, loops))
+    format, byte-for-byte in the single-process path.
+
+    ``timestamp`` (a ``datetime.datetime``; default now) makes the line
+    reproducible for tests and lets batch drivers stamp the time the
+    archive finished rather than the time the append won the lock.
+    """
+    if timestamp is None:
+        timestamp = datetime.datetime.now()
+    locked_append(log_path, "\n %s: Cleaned %s with %s, required loops=%s"
+                  % (timestamp, ar_name, args_namespace, loops))

@@ -4,13 +4,20 @@ The reference's only observability is console prints and clean.log
 (SURVEY.md section 5 "Tracing / profiling" — absent).  This adds the TPU
 story: ``jax.profiler`` device traces viewable in TensorBoard/Perfetto and
 lightweight wall-clock phase timing, both zero-cost when disabled.
+
+``PhaseTimer`` moved into the telemetry subsystem
+(:mod:`iterative_cleaner_tpu.telemetry.registry`), where the
+:class:`~iterative_cleaner_tpu.telemetry.registry.MetricsRegistry` absorbs
+it as its phase-timing section; the import here is kept so existing
+``utils.tracing.PhaseTimer`` callers keep working.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
-from typing import Dict, Iterator, Optional
+from typing import Iterator, Optional
+
+from iterative_cleaner_tpu.telemetry.registry import PhaseTimer  # noqa: F401
 
 
 @contextlib.contextmanager
@@ -27,24 +34,3 @@ def device_trace(trace_dir: Optional[str]) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
-
-
-class PhaseTimer:
-    """Accumulates wall-clock per named phase (load / clean / write)."""
-
-    def __init__(self) -> None:
-        self.seconds: Dict[str, float] = {}
-
-    @contextlib.contextmanager
-    def phase(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.seconds[name] = (self.seconds.get(name, 0.0)
-                                  + time.perf_counter() - t0)
-
-    def report(self) -> str:
-        total = sum(self.seconds.values())
-        parts = ["%s %.3fs" % (k, v) for k, v in self.seconds.items()]
-        return "Timing: %s (total %.3fs)" % (", ".join(parts), total)

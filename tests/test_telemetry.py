@@ -1,0 +1,468 @@
+"""Telemetry subsystem tests: registry/exporter round-trips, the locked
+clean.log append, the JSON-lines event log, the on-device iteration
+history (jit-compatibility + numpy-oracle parity), per-shard aggregation,
+and the CLI --metrics-json acceptance path."""
+
+import datetime
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.backends import clean_archive
+from iterative_cleaner_tpu.backends.base import CleanResult
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io import make_synthetic_archive, save_archive
+from iterative_cleaner_tpu.telemetry import (
+    EVENT_SCHEMA,
+    ITER_METRIC_FIELDS,
+    METRICS_SCHEMA,
+    MetricsRegistry,
+    PhaseTimer,
+    RunEventLog,
+    RunTelemetry,
+    iter_metrics_dict,
+)
+from iterative_cleaner_tpu.telemetry.events import read_events
+from iterative_cleaner_tpu.telemetry.exporters import (
+    metrics_to_json,
+    metrics_to_prometheus,
+    parse_prometheus_text,
+    write_metrics_json,
+    write_prometheus_textfile,
+)
+from iterative_cleaner_tpu.utils.logging import append_clean_log, locked_append
+
+
+# ---------------------------------------------------------------------------
+# registry + exporters
+# ---------------------------------------------------------------------------
+
+def _populated_registry():
+    r = MetricsRegistry()
+    r.counter_inc("archives_cleaned", 3)
+    r.counter_inc("cells_zapped", 120)
+    r.gauge_set("last_rfi_fraction", 0.25)
+    for v in (1, 2, 2, 7):
+        r.histogram_observe("loops_per_archive", v)
+    with r.phase("clean"):
+        pass
+    with r.phase("load"):
+        pass
+    return r
+
+
+def test_registry_snapshot_sections():
+    snap = _populated_registry().snapshot()
+    assert snap["counters"] == {"archives_cleaned": 3, "cells_zapped": 120}
+    assert snap["gauges"] == {"last_rfi_fraction": 0.25}
+    h = snap["histograms"]["loops_per_archive"]
+    assert h["count"] == 4 and h["sum"] == 12
+    # cumulative_counts covers every bucket plus +Inf
+    assert len(h["cumulative_counts"]) == len(h["buckets"]) + 1
+    assert h["cumulative_counts"][-1] == 4
+    assert set(snap["phases_s"]) == {"clean", "load"}
+
+
+def test_counter_rejects_negative_and_keys_sorted():
+    r = MetricsRegistry()
+    with pytest.raises(ValueError):
+        r.counter_inc("x", -1)
+    r.counter_inc("zeta")
+    r.counter_inc("alpha")
+    assert list(r.snapshot()["counters"]) == ["alpha", "zeta"]
+
+
+def test_json_export_round_trip():
+    snap = _populated_registry().snapshot()
+    doc = json.loads(metrics_to_json(snap, extra={"schema": METRICS_SCHEMA}))
+    assert doc["schema"] == METRICS_SCHEMA
+    assert doc["counters"] == snap["counters"]
+    assert doc["histograms"]["loops_per_archive"]["count"] == 4
+    # byte-stable for identical inputs
+    assert metrics_to_json(snap) == metrics_to_json(dict(snap))
+
+
+def test_json_export_file_round_trip(tmp_path):
+    snap = _populated_registry().snapshot()
+    path = str(tmp_path / "m.json")
+    write_metrics_json(path, snap)
+    with open(path) as f:
+        assert json.load(f)["counters"] == snap["counters"]
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+
+
+def test_prometheus_export_round_trip(tmp_path):
+    snap = _populated_registry().snapshot()
+    path = str(tmp_path / "m.prom")
+    write_prometheus_textfile(path, snap)
+    parsed = parse_prometheus_text(open(path).read())
+    assert parsed["icln_archives_cleaned_total"] == 3.0
+    assert parsed["icln_cells_zapped_total"] == 120.0
+    assert parsed["icln_last_rfi_fraction"] == 0.25
+    assert parsed["icln_loops_per_archive_sum"] == 12.0
+    assert parsed["icln_loops_per_archive_count"] == 4.0
+    assert parsed['icln_loops_per_archive_bucket{le="+Inf"}'] == 4.0
+    # phase timings export as labelled counter samples
+    assert any(k.startswith('icln_phase_seconds_total{phase="clean"}')
+               for k in parsed)
+
+
+def test_prometheus_buckets_cumulative():
+    r = MetricsRegistry()
+    for v in (1, 3, 100):
+        r.histogram_observe("h", v, buckets=(2.0, 10.0))
+    text = metrics_to_prometheus(r.snapshot())
+    parsed = parse_prometheus_text(text)
+    assert parsed['icln_h_bucket{le="2.0"}'] == 1.0
+    assert parsed['icln_h_bucket{le="10.0"}'] == 2.0
+    assert parsed['icln_h_bucket{le="+Inf"}'] == 3.0
+
+
+def test_phase_timer_report_sorted_deterministic():
+    t = PhaseTimer()
+    for name in ("write", "clean", "load"):
+        with t.phase(name):
+            pass
+    rep = t.report()
+    assert rep == t.report()  # deterministic
+    assert rep.index("clean") < rep.index("load") < rep.index("write")
+    assert rep.startswith("Timing: ") and "total" in rep
+
+
+# ---------------------------------------------------------------------------
+# clean.log: explicit timestamp + concurrent appends
+# ---------------------------------------------------------------------------
+
+def test_append_clean_log_timestamp_byte_format(tmp_path):
+    path = str(tmp_path / "clean.log")
+    ts = datetime.datetime(2026, 8, 5, 12, 0, 1, 500000)
+    append_clean_log("obs.npz", "Namespace(x=1)", 4, log_path=path,
+                     timestamp=ts)
+    text = open(path).read()
+    assert text == ("\n %s: Cleaned obs.npz with Namespace(x=1), "
+                    "required loops=4" % ts)
+
+
+def test_locked_append_concurrent_lines_intact(tmp_path):
+    path = str(tmp_path / "shared.log")
+    n_threads, n_lines = 8, 40
+
+    def writer(i):
+        for j in range(n_lines):
+            locked_append(path, f"t{i}:{j}:{'x' * 64}\n")
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = open(path).read().splitlines()
+    assert len(lines) == n_threads * n_lines
+    assert all(line.endswith("x" * 64) for line in lines)
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+def test_event_log_emit_and_read(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    log = RunEventLog(path)
+    log.emit("run_start", n_archives=2, ts="2026-08-05T00:00:00")
+    log.emit("iteration", iteration=0, zap_count=5)
+    events = read_events(path)
+    assert [e["event"] for e in events] == ["run_start", "iteration"]
+    assert all(e["schema"] == EVENT_SCHEMA for e in events)
+    assert events[0]["ts"] == "2026-08-05T00:00:00"  # pinned
+    assert "ts" in events[1]  # auto-stamped
+    assert events[1]["zap_count"] == 5
+
+
+def test_iter_metrics_dict_contract():
+    im = np.array([[10.0, 9.0, 1.5, 2.5],
+                   [11.0, 1.0, 1.4, 2.6]], dtype=np.float32)
+    d = iter_metrics_dict(im)
+    assert list(d) == list(ITER_METRIC_FIELDS)
+    assert d["zap_count"] == [10, 11] and d["mask_churn"] == [9, 1]
+    assert isinstance(d["zap_count"][0], int)
+    assert d["residual_std"] == pytest.approx([1.5, 1.4])
+    assert iter_metrics_dict(None) == {}
+
+
+# ---------------------------------------------------------------------------
+# RunTelemetry
+# ---------------------------------------------------------------------------
+
+def _fake_result(loops=2):
+    w = np.ones((4, 4))
+    w[0, :2] = 0
+    return CleanResult(
+        final_weights=w, scores=np.zeros((4, 4)), loops=loops,
+        converged=True,
+        iter_metrics=np.array([[2, 2, 1.0, 3.0], [2, 0, 0.9, 3.1]],
+                              dtype=np.float32),
+    )
+
+
+def test_run_telemetry_report_and_finalize(tmp_path):
+    mj = str(tmp_path / "out.json")
+    mp = str(tmp_path / "out.prom")
+    ev = str(tmp_path / "ev.jsonl")
+    tel = RunTelemetry(metrics_json=mj, prom_textfile=mp,
+                       events=RunEventLog(ev))
+    tel.record_archive("a.npz", _fake_result())
+    tel.finalize()
+
+    doc = json.load(open(mj))
+    assert doc["schema"] == METRICS_SCHEMA
+    assert doc["counters"]["archives_cleaned"] == 1
+    assert doc["counters"]["cells_zapped"] == 2
+    assert doc["counters"]["iterations_total"] == 2
+    arch = doc["archives"][0]
+    assert arch["path"] == "a.npz" and arch["loops"] == 2
+    assert arch["iter_history"]["zap_count"] == [2, 2]
+    # final zap row equals the returned weights' zapped-cell count
+    assert arch["iter_history"]["zap_count"][-1] == arch["cells_zapped"]
+
+    parsed = parse_prometheus_text(open(mp).read())
+    assert parsed["icln_archives_cleaned_total"] == 1.0
+
+    kinds = [e["event"] for e in read_events(ev)]
+    assert kinds == ["iteration", "iteration", "archive", "run_end"]
+
+
+def test_run_telemetry_failure_counts(tmp_path):
+    ev = str(tmp_path / "ev.jsonl")
+    tel = RunTelemetry(events=RunEventLog(ev))
+    tel.record_failure("bad.npz", RuntimeError("boom"))
+    tel.finalize()
+    events = read_events(ev)
+    assert events[0]["event"] == "error" and "boom" in events[0]["error"]
+    assert events[-1] == {**events[-1], "event": "run_end", "ok": 0,
+                          "failed": 1}
+
+
+def test_from_args_normalises_empty_strings():
+    import argparse
+
+    ns = argparse.Namespace(metrics_json="", prom_textfile="",
+                            event_log="", log_format="text")
+    tel = RunTelemetry.from_args(ns)
+    assert not tel.enabled
+    ns.log_format = "json"
+    assert RunTelemetry.from_args(ns).events is not None
+
+
+# ---------------------------------------------------------------------------
+# engine iteration history: jit compatibility + oracle parity
+# ---------------------------------------------------------------------------
+
+def _prepared_cube(seed=0, nsub=8, nchan=16, nbin=32):
+    rng = np.random.default_rng(seed)
+    cube = rng.normal(size=(nsub, nchan, nbin)).astype(np.float64)
+    cube[2, 3] += 40.0  # one hot cell so the loop actually zaps
+    weights = np.ones((nsub, nchan))
+    shifts = np.zeros(nchan, dtype=np.int32)
+    return cube, weights, shifts
+
+
+def test_iteration_history_jit_compatible_no_callbacks():
+    """The acceptance invariant 'zero extra device-to-host transfers inside
+    the iteration loop': the whole clean program (history recording
+    included) must stage into one jaxpr with no host-callback or
+    infeed/outfeed primitives anywhere."""
+    import jax
+
+    from iterative_cleaner_tpu.engine.loop import clean_dedispersed_jax
+
+    cube, weights, shifts = _prepared_cube()
+
+    def run(c, w, s):
+        return clean_dedispersed_jax(
+            c, w, s, max_iter=3, chanthresh=5.0, subintthresh=5.0,
+            pulse_slice=(0, 0), pulse_scale=1.0, pulse_active=False,
+            rotation="roll", fft_mode="dft")
+
+    jaxpr = jax.make_jaxpr(run)(cube, weights, shifts)
+    forbidden = ("callback", "infeed", "outfeed", "io_callback",
+                 "debug_callback")
+    prims = {eqn.primitive.name for eqn in jaxpr.jaxpr.eqns}
+
+    def walk(jxp):
+        for eqn in jxp.eqns:
+            prims.add(eqn.primitive.name)
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr)
+                elif isinstance(v, (list, tuple)):
+                    for u in v:
+                        if hasattr(u, "jaxpr"):
+                            walk(u.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    bad = {p for p in prims if any(f in p for f in forbidden)}
+    assert not bad, f"host-transfer primitives in clean program: {bad}"
+    # and the history output really is there, device-shaped
+    outs = jax.jit(run)(cube, weights, shifts)
+    assert outs.iter_metrics.shape == (3, 4)
+
+
+def test_iteration_history_matches_numpy_oracle():
+    """zap_count/mask_churn recomputed by the jax-free numpy oracle must
+    match the on-device history row-for-row (float64 = exact parity
+    regime, same as test_backend_parity)."""
+    ar, _ = make_synthetic_archive(seed=11, nsub=8, nchan=16, nbin=64,
+                                   n_rfi_cells=3)
+    res_np = clean_archive(ar.clone(),
+                           CleanConfig(backend="numpy", dtype="float64"))
+    res_jx = clean_archive(ar.clone(),
+                           CleanConfig(backend="jax", dtype="float64"))
+    assert res_np.iter_metrics is not None
+    assert res_jx.iter_metrics is not None
+    assert res_np.iter_metrics.shape == res_jx.iter_metrics.shape
+    # integer columns: exact
+    np.testing.assert_array_equal(res_np.iter_metrics[:, :2],
+                                  res_jx.iter_metrics[:, :2])
+    # final zap count == zapped cells in the returned weights (both stacks)
+    for res in (res_np, res_jx):
+        assert int(res.iter_metrics[-1, 0]) == int(
+            np.sum(res.final_weights == 0))
+    # churn sums to total mask movement: first row counts the first zaps
+    assert res_jx.iter_metrics[0, 1] == res_jx.iter_metrics[0, 0] - np.sum(
+        ar.weights == 0)
+
+
+def test_iteration_history_zap_matches_weight_history():
+    """Cross-check against the independently-recorded weight-history
+    feature: per-iteration zero counts of the history matrices equal the
+    zap_count column."""
+    ar, _ = make_synthetic_archive(seed=12)
+    res = clean_archive(ar.clone(),
+                        CleanConfig(backend="jax", dtype="float64",
+                                    record_history=True))
+    assert res.weight_history is not None
+    for i in range(res.loops):
+        assert int(res.iter_metrics[i, 0]) == int(
+            np.sum(res.weight_history[i + 1] == 0))
+        assert int(res.iter_metrics[i, 1]) == int(
+            np.sum((res.weight_history[i + 1] == 0)
+                   != (res.weight_history[i] == 0)))
+
+
+# ---------------------------------------------------------------------------
+# streaming + distributed aggregation + checkpoint round-trip
+# ---------------------------------------------------------------------------
+
+def test_combine_tile_iter_metrics():
+    from iterative_cleaner_tpu.parallel.streaming import (
+        StreamTileResult,
+        combine_tile_iter_metrics,
+    )
+
+    def tile(n_valid, rows):
+        w = np.ones((4, 2))
+        return StreamTileResult(
+            start_subint=0, n_valid=n_valid,
+            result=CleanResult(final_weights=w, scores=w, loops=len(rows),
+                               converged=True,
+                               iter_metrics=np.asarray(rows, np.float32)))
+
+    # tile B is the padded final tile (2 valid of 4 -> 4 padding cells in
+    # every row) and converged one iteration early
+    a = tile(4, [[3, 3, 1.0, 10.0], [5, 2, 0.8, 11.0]])
+    b = tile(2, [[6, 2, 2.0, 9.0]])
+    out = combine_tile_iter_metrics([a, b], nchan=2, chunk_nsub=4)
+    assert out.shape == (2, 4)
+    np.testing.assert_allclose(out[:, 0], [3 + (6 - 4), 5 + (6 - 4)])
+    np.testing.assert_allclose(out[:, 1], [5, 2])  # churn: zeros tail
+    np.testing.assert_allclose(out[0, 2], (1.0 * 4 + 2.0 * 2) / 6)
+    np.testing.assert_allclose(out[:, 3], [10.0, 11.0])
+
+
+def test_streaming_result_carries_iter_metrics():
+    from iterative_cleaner_tpu.parallel.streaming import clean_streaming
+
+    ar, _ = make_synthetic_archive(seed=13, nsub=8, nchan=16, nbin=64)
+    cfg = CleanConfig(backend="jax", dtype="float64", max_iter=3)
+    for mode in ("online", "exact"):
+        res = clean_streaming(ar.clone(), 4, cfg, mode=mode)
+        assert res.iter_metrics is not None, mode
+        assert res.iter_metrics.shape[1] == 4
+        assert res.iter_metrics.shape[0] == res.loops or mode == "online"
+
+
+def test_aggregate_metrics_single_process_noop():
+    from iterative_cleaner_tpu.parallel.distributed import (
+        aggregate_metrics_across_processes,
+    )
+
+    counters = {"b": 2.0, "a": 1.0}
+    out = aggregate_metrics_across_processes(counters)
+    assert out == counters and out is not counters
+
+
+def test_checkpoint_round_trips_iter_metrics(tmp_path):
+    from iterative_cleaner_tpu.utils.checkpoint import (
+        load_clean_checkpoint,
+        save_clean_checkpoint,
+    )
+
+    res = _fake_result()
+    path = str(tmp_path / "c.ckpt.npz")
+    save_clean_checkpoint(path, res, CleanConfig(), "fp")
+    loaded, fp, _ = load_clean_checkpoint(path)
+    np.testing.assert_array_equal(loaded.iter_metrics, res.iter_metrics)
+    # absent stays absent
+    res2 = _fake_result()
+    res2.iter_metrics = None
+    save_clean_checkpoint(path, res2, CleanConfig(), "fp")
+    loaded2, _, _ = load_clean_checkpoint(path)
+    assert loaded2.iter_metrics is None
+
+
+# ---------------------------------------------------------------------------
+# CLI acceptance
+# ---------------------------------------------------------------------------
+
+def test_cli_metrics_json_acceptance(tmp_path, monkeypatch):
+    """ISSUE acceptance: --metrics-json produces a report whose
+    per-iteration arrays exist and whose final zap total equals the
+    written archive's zapped-cell count."""
+    from iterative_cleaner_tpu.cli import main
+    from iterative_cleaner_tpu.io import load_archive
+
+    monkeypatch.chdir(tmp_path)
+    ar, _ = make_synthetic_archive(nsub=8, nchan=16, nbin=64, seed=0)
+    save_archive(ar, "obs.npz")
+    main(["-q", "-l", "--metrics-json", "out.json", "--prom-textfile",
+          "out.prom", "--log-format", "json", "obs.npz"])
+
+    doc = json.load(open("out.json"))
+    assert doc["schema"] == METRICS_SCHEMA
+    hist = doc["archives"][0]["iter_history"]
+    for field in ITER_METRIC_FIELDS:
+        assert len(hist[field]) == doc["archives"][0]["loops"]
+    cleaned = load_archive("obs.npz_cleaned.npz")
+    assert hist["zap_count"][-1] == int(np.sum(cleaned.weights == 0))
+
+    parsed = parse_prometheus_text(open("out.prom").read())
+    assert parsed["icln_archives_cleaned_total"] == 1.0
+    events = read_events("clean.events.jsonl")
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    assert "archive" in kinds and "iteration" in kinds
+
+
+def test_cli_underscore_flag_aliases():
+    from iterative_cleaner_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["--metrics_json", "a.json", "--prom_textfile", "b.prom",
+         "--log_format", "json", "--event_log", "e.jsonl", "x.npz"])
+    assert args.metrics_json == "a.json"
+    assert args.prom_textfile == "b.prom"
+    assert args.log_format == "json" and args.event_log == "e.jsonl"
