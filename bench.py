@@ -326,11 +326,17 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
     model is transfer-bound where the whole-archive path is HBM-bound.
     Reports tiles/s, effective transfer GB/s, and the wall-clock ratio
     vs the whole-archive clean of the SAME archive.
-    ``streaming_eff_gbps`` is a cube-tile-upload MODEL (n_tiles x loops x
-    passes x padded-tile bytes over wall time), not measured bytes: the
-    smaller per-tile weight/mask/offset uploads are not counted, so it
-    slightly understates the real transfer (ADVICE r4).  Wall-clock (not
-    in-program differential) is the honest metric here: the per-tile
+    ``streaming_eff_gbps`` is MEASURED: the tile cache
+    (parallel/tile_cache.py) counts every H2D byte it actually moves into
+    the run's MetricsRegistry (``stream_h2d_bytes``), so the figure
+    reflects residency — a cache that pins tiles across iterations moves
+    fewer bytes and the rate drops with wall time, as it should.  The old
+    cube-tile-upload MODEL (n_tiles x loops x passes x padded-tile bytes
+    over wall time, which assumed every pass re-uploads and skipped the
+    small weight/mask/offset uploads) is kept one release as
+    ``modeled_streaming_eff_gbps`` so existing capture tooling can
+    cross-check before switching.  Wall-clock (not in-program
+    differential) is the honest denominator here: the per-tile
     dispatch+H2D cost IS the thing being measured, amortised over
     loops x tiles x passes dispatches.
     """
@@ -343,6 +349,7 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
         make_synthetic_archive,
     )
     from iterative_cleaner_tpu.parallel import clean_streaming_exact
+    from iterative_cleaner_tpu.telemetry import MetricsRegistry
 
     t0 = time.perf_counter()
     ar, _ = make_synthetic_archive(
@@ -359,8 +366,9 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
     _log(f"streaming stage: whole-archive clean {t_whole:.1f}s "
          f"(loops={whole.loops})")
 
+    reg = MetricsRegistry()
     t0 = time.perf_counter()
-    stream = clean_streaming_exact(ar.clone(), chunk, cfg)
+    stream = clean_streaming_exact(ar.clone(), chunk, cfg, registry=reg)
     t_stream = time.perf_counter() - t0
     assert np.array_equal(whole.final_weights == 0,
                           stream.final_weights == 0), \
@@ -370,11 +378,15 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
     passes = 3 if cfg.baseline_mode == "integration" else 2
     tile_bytes = chunk * nchan * nbin * 4
     tiles_per_s = n_tiles * stream.loops * passes / t_stream
-    eff_gbps = tiles_per_s * tile_bytes / 1e9
+    modeled_gbps = tiles_per_s * tile_bytes / 1e9
+    h2d = int(reg.counters.get("stream_h2d_bytes", 0))
+    eff_gbps = h2d / t_stream / 1e9
+    hits = int(reg.counters.get("stream_cache_hits", 0))
     _log(f"streaming-exact ({nsub}x{nchan}x{nbin}, chunk {chunk}): "
          f"{t_stream:.2f}s vs whole {t_whole:.2f}s "
          f"({t_stream / t_whole:.2f}x), {tiles_per_s:.1f} tile-passes/s, "
-         f"{eff_gbps:.1f} GB/s effective transfer")
+         f"{eff_gbps:.3f} GB/s measured H2D ({h2d} bytes, {hits} cache "
+         f"hits; model said {modeled_gbps:.3f})")
     import jax
 
     return {
@@ -385,8 +397,76 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
         "streaming_geometry": f"{nsub}x{nchan}x{nbin}/chunk{chunk}",
         "streaming_platform": jax.default_backend(),
         "streaming_tile_passes_per_s": round(tiles_per_s, 1),
-        "streaming_eff_gbps": round(eff_gbps, 2),
+        "streaming_eff_gbps": round(eff_gbps, 3),
+        "modeled_streaming_eff_gbps": round(modeled_gbps, 2),
+        "streaming_h2d_bytes": h2d,
         "streaming_vs_whole": round(t_stream / t_whole, 2),
+    }
+
+
+def bench_batch(n_archives, nsub, nchan, nbin, max_iter=3):
+    """Batch-mode row: N equal-shaped archives through one compiled
+    vmap program (parallel/batch.py, BASELINE.md config 4) vs the same N
+    cleaned sequentially with per-archive ``clean_archive`` calls.
+
+    The sequential denominator reuses one compiled program across the
+    loop (equal shapes hit the jit cache after archive 0), so the ratio
+    isolates what batching actually buys: one dispatch + one H2D instead
+    of N, and device parallelism across the batch axis where available.
+    Masks must match the sequential path bit-for-bit (batch.py compiles
+    the same per-archive math under vmap).  ``batch_h2d_bytes`` is the
+    measured stacked-input upload size from the registry counter the
+    batch path maintains.
+    """
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io.synthetic import (
+        bench_rfi_density,
+        make_synthetic_archive,
+    )
+    from iterative_cleaner_tpu.parallel import clean_archives_batched
+    from iterative_cleaner_tpu.telemetry import MetricsRegistry
+
+    t0 = time.perf_counter()
+    archives = []
+    for i in range(n_archives):
+        ar, _ = make_synthetic_archive(
+            nsub=nsub, nchan=nchan, nbin=nbin,
+            **bench_rfi_density(nsub, nchan), seed=i, dtype=np.float32,
+        )
+        archives.append(ar)
+    _log(f"batch stage: {n_archives} archives generated in "
+         f"{time.perf_counter() - t0:.1f}s")
+    cfg = CleanConfig(backend="jax", max_iter=max_iter)
+
+    t0 = time.perf_counter()
+    seq = [clean_archive(a.clone(), cfg) for a in archives]
+    t_seq = time.perf_counter() - t0
+    _log(f"batch stage: sequential x{n_archives} in {t_seq:.2f}s")
+
+    reg = MetricsRegistry()
+    t0 = time.perf_counter()
+    batched = clean_archives_batched(archives, cfg, registry=reg)
+    t_batch = time.perf_counter() - t0
+    for i, (s, b) in enumerate(zip(seq, batched)):
+        assert np.array_equal(s.final_weights == 0, b.final_weights == 0), \
+            f"batched mask diverged from sequential (archive {i})"
+
+    loops = max(b.loops for b in batched)
+    rate = n_archives * nsub * nchan * loops / t_batch
+    _log(f"batch ({n_archives} x {nsub}x{nchan}x{nbin}): {t_batch:.2f}s vs "
+         f"sequential {t_seq:.2f}s ({t_batch / t_seq:.2f}x), "
+         f"{rate:.3e} cell-iters/s")
+    import jax
+
+    return {
+        "batch_n": n_archives,
+        "batch_geometry": f"{nsub}x{nchan}x{nbin}",
+        "batch_platform": jax.default_backend(),
+        "batch_cell_iters_per_s": round(rate, 1),
+        "batch_vs_sequential": round(t_batch / t_seq, 2),
+        "batch_per_archive_ms": round(t_batch / n_archives * 1e3, 1),
+        "batch_h2d_bytes": int(reg.counters.get("batch_h2d_bytes", 0)),
     }
 
 
@@ -413,22 +493,22 @@ def bench_numpy(nsub, nchan, nbin, max_iter=5):
     return rate
 
 
-def _streaming_row_subprocess(nsub, nchan, nbin, chunk, timeout):
-    """Run bench_streaming in a KILLABLE subprocess with its own deadline.
+def _bench_row_subprocess(env_key, payload, timeout, label):
+    """Run one bench stage in a KILLABLE subprocess with its own deadline.
 
     The 2026-07-31 TPU window lost its headline JSON to a wedge inside the
     streaming stage: a C-level stall the in-process watchdog could only
     answer with os._exit(3), taking the already-measured headline numbers
     down with it.  A subprocess bounds the stage without risking the rest
-    of the run.  Returns the streaming row dict, or None on timeout /
-    environment failure; a mask-PARITY failure (assert inside
-    bench_streaming) re-raises — a correctness regression is never benign.
+    of the run.  `env_key` selects the child's stage branch in main()
+    (BENCH_STREAMING_ONLY / BENCH_BATCH_ONLY), `payload` is its kwargs.
+    Returns the row dict, or None on timeout / environment failure; a
+    mask-PARITY failure (the stage's assert, signalled by rc 7)
+    re-raises — a correctness regression is never benign.
     """
     import subprocess
 
-    env = {**os.environ,
-           "BENCH_STREAMING_ONLY": json.dumps(
-               {"nsub": nsub, "nchan": nchan, "nbin": nbin, "chunk": chunk})}
+    env = {**os.environ, env_key: json.dumps(payload)}
     try:
         # stderr is INHERITED: the child's stage logs stream live (and
         # survive a timeout kill); only the one-line JSON is captured
@@ -436,43 +516,45 @@ def _streaming_row_subprocess(nsub, nchan, nbin, chunk, timeout):
             [sys.executable, os.path.abspath(__file__)], env=env,
             stdout=subprocess.PIPE, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
-        _log(f"streaming bench killed after {timeout:.0f}s (wedged tunnel "
+        _log(f"{label} bench killed after {timeout:.0f}s (wedged tunnel "
              "dispatch?); headline row unaffected")
         return None
     if out.returncode == 7:
-        # the child's dedicated parity-failure code (see the
-        # BENCH_STREAMING_ONLY branch): a correctness regression, fatal
+        # the child's dedicated parity-failure code (see the *_ONLY
+        # branches): a correctness regression, fatal
         raise AssertionError(
-            "exact streaming mask diverged from whole-archive (subprocess)")
+            f"{label} masks diverged from the reference path (subprocess)")
     if out.returncode != 0:
-        _log(f"streaming bench subprocess failed (rc={out.returncode}); "
+        _log(f"{label} bench subprocess failed (rc={out.returncode}); "
              "skipping the row")
         return None
     try:
         row = json.loads(out.stdout.strip().splitlines()[-1])
         return row if isinstance(row, dict) else None
     except (ValueError, IndexError):
-        _log("streaming bench subprocess returned no JSON; skipping")
+        _log(f"{label} bench subprocess returned no JSON; skipping")
         return None
 
 
 def main():
     from iterative_cleaner_tpu.utils import fallback_to_cpu_if_unreachable
 
-    if os.environ.get("BENCH_STREAMING_ONLY"):
-        geom = json.loads(os.environ["BENCH_STREAMING_ONLY"])
-        fallback_to_cpu_if_unreachable(
-            "BENCH_PROBE_TIMEOUT", log=_log,
-            message="device unreachable; streaming row on CPU")
-        try:
-            print(json.dumps(bench_streaming(**geom)))
-        except AssertionError as e:
-            # distinct exit code: the parent must treat a mask-parity
-            # failure as fatal, but ONLY that — scraping stderr for the
-            # word AssertionError would promote unrelated crashes
-            _log(f"streaming parity failure: {e}")
-            sys.exit(7)
-        return
+    for env_key, stage in (("BENCH_STREAMING_ONLY", bench_streaming),
+                           ("BENCH_BATCH_ONLY", bench_batch)):
+        if os.environ.get(env_key):
+            geom = json.loads(os.environ[env_key])
+            fallback_to_cpu_if_unreachable(
+                "BENCH_PROBE_TIMEOUT", log=_log,
+                message=f"device unreachable; {stage.__name__} row on CPU")
+            try:
+                print(json.dumps(stage(**geom)))
+            except AssertionError as e:
+                # distinct exit code: the parent must treat a mask-parity
+                # failure as fatal, but ONLY that — scraping stderr for the
+                # word AssertionError would promote unrelated crashes
+                _log(f"{stage.__name__} parity failure: {e}")
+                sys.exit(7)
+            return
 
     # Dead accelerator tunnel: fall back to CPU so the run still produces
     # a (clearly labelled) number instead of hanging into the watchdog.
@@ -515,9 +597,26 @@ def main():
     s_nsub, s_nchan, s_nbin = ((32, 64, 64) if small else
                                (max(8, jax_cfg[0] // 2),
                                 jax_cfg[1], jax_cfg[2]))
-    row = _streaming_row_subprocess(
-        s_nsub, s_nchan, s_nbin, chunk=max(8, s_nsub // 4),
-        timeout=float(os.environ.get("BENCH_STREAMING_TIMEOUT", "600")))
+    row = _bench_row_subprocess(
+        "BENCH_STREAMING_ONLY",
+        {"nsub": s_nsub, "nchan": s_nchan, "nbin": s_nbin,
+         "chunk": max(8, s_nsub // 4)},
+        timeout=float(os.environ.get("BENCH_STREAMING_TIMEOUT", "600")),
+        label="streaming")
+    if row:
+        extras = {**(extras or {}), **row}
+
+    # batch-mode row (BASELINE.md config 4): 8-32 equal-shaped synthetic
+    # archives through parallel/batch.py's one compiled vmap program vs a
+    # sequential per-archive loop; same killable-subprocess isolation and
+    # parity-is-fatal contract as the streaming row
+    b_n, b_geom = ((8, (16, 32, 32)) if small else (32, (64, 1024, 128)))
+    row = _bench_row_subprocess(
+        "BENCH_BATCH_ONLY",
+        {"n_archives": b_n, "nsub": b_geom[0], "nchan": b_geom[1],
+         "nbin": b_geom[2]},
+        timeout=float(os.environ.get("BENCH_BATCH_TIMEOUT", "600")),
+        label="batch")
     if row:
         extras = {**(extras or {}), **row}
 

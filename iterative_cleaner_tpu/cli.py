@@ -190,6 +190,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "than HBM; 0 (default) disables. Composes "
                              "with --mesh cell (each tile sharded, either "
                              "stream mode).")
+    parser.add_argument("--stream_hbm_mb", type=float, default=None,
+                        metavar="MB",
+                        help="HBM byte budget (MiB) for the exact stream "
+                             "mode's device tile cache "
+                             "(parallel/tile_cache.py): prepared tiles "
+                             "that fit stay pinned on device, so "
+                             "iterations beyond the first re-upload "
+                             "nothing. Default: the ICLEAN_STREAM_HBM_MB "
+                             "env var, else ~40%% of the device's "
+                             "reported memory. 0 disables pinning (the "
+                             "classic two-tile streaming footprint).")
     parser.add_argument("--stream_mode", choices=("exact", "online"),
                         default="exact",
                         help="exact (default): two-pass drift-free tiling "
@@ -243,6 +254,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         stats_frame=args.stats_frame,
         fft_mode=args.fft_mode,
         baseline_mode=args.baseline_mode,
+        stream_hbm_mb=getattr(args, "stream_hbm_mb", None),
         unload_res=args.unload_res,
         record_history=args.record_history,
     )
@@ -315,7 +327,9 @@ def clean_one(in_path: str, args: argparse.Namespace,
                     mesh = cell_mesh()
                 result = clean_streaming(
                     ar, stream, cfg, mesh,
-                    mode=getattr(args, "stream_mode", "exact"))
+                    mode=getattr(args, "stream_mode", "exact"),
+                    registry=(telemetry.registry
+                              if telemetry is not None else None))
             elif mesh_mode == "cell":
                 from iterative_cleaner_tpu.parallel.mesh import cell_mesh
                 from iterative_cleaner_tpu.parallel.sharding import (
@@ -539,7 +553,10 @@ def _run_batched(args, telemetry=None) -> list:
             continue
         try:
             with phase("clean"):
-                results = clean_archives_batched(ars, cfg, mesh)
+                results = clean_archives_batched(
+                    ars, cfg, mesh,
+                    registry=(telemetry.registry
+                              if telemetry is not None else None))
         except Exception as exc:
             record_failure(group, exc)
             continue

@@ -10,7 +10,7 @@ and library users construct it directly.
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,6 +69,13 @@ class CleanConfig:
     # parallel/streaming_exact's host-RAM note).
     baseline_mode: str = "integration"
     dtype: str = "float32"       # compute dtype on the jax path
+    # HBM byte budget (MiB) for the exact streaming mode's device tile
+    # cache (parallel/tile_cache.py).  None defers to the
+    # ICLEAN_STREAM_HBM_MB env var and then a device-sized default; 0
+    # disables pinning entirely (the classic one-tile-lookahead streaming
+    # behaviour, the right call when the observation must not compete
+    # with anything else for HBM).
+    stream_hbm_mb: Optional[float] = None
     unload_res: bool = False     # -u: also produce the pulse-free residual
     # keep the per-iteration weight matrices in the result (checkpoint/
     # regression-diff support, utils/checkpoint.py); costs one extra D2H of
@@ -120,3 +127,7 @@ class CleanConfig:
                 "order-preserving key mapping is 32-bit)")
         if self.max_iter < 1:
             raise ValueError("max_iter must be >= 1")
+        if self.stream_hbm_mb is not None and self.stream_hbm_mb < 0:
+            raise ValueError(
+                f"stream_hbm_mb must be >= 0 (0 disables the stream tile "
+                f"cache), got {self.stream_hbm_mb}")

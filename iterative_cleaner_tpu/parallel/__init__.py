@@ -24,3 +24,7 @@ from iterative_cleaner_tpu.parallel.streaming import (  # noqa: F401
 from iterative_cleaner_tpu.parallel.streaming_exact import (  # noqa: F401
     clean_streaming_exact,
 )
+from iterative_cleaner_tpu.parallel.tile_cache import (  # noqa: F401
+    TileCache,
+    resolve_budget_bytes,
+)

@@ -163,7 +163,8 @@ def unpack_batch_results(outs, n: int,
 
 
 def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
-                           mesh=None, specs=None) -> List[CleanResult]:
+                           mesh=None, specs=None,
+                           registry=None) -> List[CleanResult]:
     """Clean a batch of equal-shaped archives in one compiled call.
 
     With ``mesh`` (a 1-D ('batch',) mesh from
@@ -176,6 +177,9 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
     hybrid ('batch', 'sub', 'chan') mesh of
     :func:`iterative_cleaner_tpu.parallel.distributed.clean_archives_hybrid`;
     the batch then pads to a multiple of the mesh's 'batch' axis only.
+    ``registry`` (a telemetry ``MetricsRegistry``) receives the measured
+    stacked-input upload bytes as ``batch_h2d_bytes`` — the batch-path
+    counterpart of the streaming tile cache's ``stream_h2d_bytes``.
     """
     import jax
     import jax.numpy as jnp
@@ -192,6 +196,10 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
             per = int(np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
         pad = (-n) % per
     args = stack_archive_batch(archives, pad, jnp.dtype(config.dtype))
+    if registry is not None:
+        registry.counter_inc("batch_h2d_bytes",
+                             sum(int(x.nbytes) for x in args))
+        registry.counter_inc("batch_archives", n)
 
     from iterative_cleaner_tpu.backends.jax_backend import (
         resolve_fft_mode,

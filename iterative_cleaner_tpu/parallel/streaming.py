@@ -210,7 +210,7 @@ def combine_tile_iter_metrics(tiles: List[StreamTileResult], nchan: int,
 
 def clean_streaming(archive: Archive, chunk_nsub: int,
                     config: CleanConfig, mesh=None,
-                    mode: str = "exact") -> CleanResult:
+                    mode: str = "exact", registry=None) -> CleanResult:
     """Clean a whole archive through the streaming path (tile at a time) and
     reassemble a full-archive CleanResult.  Used for testing and for archives
     too large to clean in one device footprint; with ``mesh``, each tile is
@@ -225,13 +225,15 @@ def clean_streaming(archive: Archive, chunk_nsub: int,
     ``mesh`` each tile's device work is sharded over the cell grid in
     either mode.  ``mode="online"`` cleans each tile independently as it
     fills (single pass; ~0.01-0.02% mask drift vs whole-archive cleaning
-    — module docstring)."""
+    — module docstring).  ``registry`` (a telemetry ``MetricsRegistry``)
+    receives the exact mode's measured tile-cache transfer counters."""
     if mode == "exact":
         from iterative_cleaner_tpu.parallel.streaming_exact import (
             clean_streaming_exact,
         )
 
-        return clean_streaming_exact(archive, chunk_nsub, config, mesh=mesh)
+        return clean_streaming_exact(archive, chunk_nsub, config, mesh=mesh,
+                                     registry=registry)
     if mode != "online":
         raise ValueError(f"unknown streaming mode {mode!r}")
     sc = StreamingCleaner(

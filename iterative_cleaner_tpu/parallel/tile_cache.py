@@ -1,0 +1,330 @@
+"""Device tile-residency manager for the exact streaming engine.
+
+The exact mode's cost model is transfer-bound (module docstring of
+:mod:`iterative_cleaner_tpu.parallel.streaming_exact`): every iteration
+re-reads the prepared tiles, and before this cache existed every constant
+cube tile was re-uploaded via ``jnp.asarray`` on every pass of every
+iteration — the whole reason exact streaming lost to whole-archive
+cleaning on configurations that actually fit the device.  Bifrost
+(arXiv:1708.00720) and the exascale RFI-mitigation study (arXiv:1701.08197)
+make the same observation for radio-astronomy stream pipelines generally:
+the winning move is keeping blocks resident and overlapping transfer with
+compute, because transfer cost — not arithmetic — bounds throughput.
+
+:class:`TileCache` keeps up to K tiles pinned on device under an explicit
+byte budget:
+
+- **Budget** (:func:`resolve_budget_bytes`): ``CleanConfig.stream_hbm_mb``
+  wins, then the ``ICLEAN_STREAM_HBM_MB`` env knob, then a device-sized
+  default (a fraction of the device's ``bytes_limit``; a conservative
+  constant when the backend reports none).  ``0`` disables pinning
+  entirely and every transfer degrades to the pre-cache one-tile-lookahead
+  behaviour (the two-tile residency bound that keeps >HBM observations
+  usable).
+- **Hits are live device handles** — no copy, no transfer; the engine's
+  compute consumes them exactly as it would a fresh upload, so masks stay
+  bit-equal (a device→host→device round trip of the same dtype is
+  lossless, and the cache never changes accumulation order).
+- **Planned admission**: the streaming engine knows every constant tile
+  and its size up front, so it calls :meth:`TileCache.plan` once; keys
+  the budget cannot hold are never admitted and stream as transient
+  uploads under the classic two-tile bound.  Without a plan the cache is
+  a plain byte-budgeted LRU: inserting past the budget evicts the
+  least-recently-used entry (the eviction drops the handle; the freed
+  HBM is actually reclaimed at the engine's next host-fetch sync point,
+  the same sync that caps streaming residency — :meth:`mark_sync`).
+- **Measured transfer accounting**: every real upload is counted (bytes
+  and calls, cube-sized tiles separately) into the cache's stats and,
+  when given, a PR-1 :class:`~iterative_cleaner_tpu.telemetry.registry.
+  MetricsRegistry` — ``stream_h2d_bytes`` & friends.  bench.py's
+  ``streaming_eff_gbps`` is derived from these measured bytes, replacing
+  the old cube-upload model (kept one release as
+  ``modeled_streaming_eff_gbps``).
+
+The cache is policy-only: it never imports the engine and holds no jax
+state beyond the handles themselves, so it is unit-testable without a
+device (tests/test_tile_cache.py fakes the uploads).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+# Fraction of the device's reported bytes_limit the default budget claims.
+# Deliberately below half: the engine still needs working VMEM/HBM for the
+# per-tile compute, its outputs, and XLA scratch.
+DEFAULT_BUDGET_FRACTION = 0.4
+
+# Fallback budget when the backend reports no memory stats (CPU devices:
+# "device" memory is host RAM, so a fixed conservative constant).
+FALLBACK_BUDGET_BYTES = 512 * 2 ** 20
+
+
+def resolve_budget_bytes(config_mb: Optional[float] = None,
+                         device=None) -> int:
+    """Byte budget for the tile cache.
+
+    Precedence: explicit ``config_mb`` (``CleanConfig.stream_hbm_mb``) →
+    ``ICLEAN_STREAM_HBM_MB`` env var → ``DEFAULT_BUDGET_FRACTION`` of the
+    device's ``bytes_limit`` → :data:`FALLBACK_BUDGET_BYTES`.  ``0`` (from
+    either source) disables pinning.
+    """
+    if config_mb is not None:
+        if config_mb < 0:
+            raise ValueError(
+                f"stream HBM budget must be >= 0 MiB, got {config_mb}")
+        return int(float(config_mb) * 2 ** 20)
+    env = os.environ.get("ICLEAN_STREAM_HBM_MB")
+    if env is not None and env.strip() != "":
+        mb = float(env)
+        if mb < 0:
+            raise ValueError(
+                f"ICLEAN_STREAM_HBM_MB must be >= 0, got {env!r}")
+        return int(mb * 2 ** 20)
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        stats = device.memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return int(limit * DEFAULT_BUDGET_FRACTION)
+    except Exception:
+        pass
+    return FALLBACK_BUDGET_BYTES
+
+
+class TileCache:
+    """Byte-budgeted device residency for host-backed streaming tiles.
+
+    ``upload`` is the transfer function (defaults to ``jnp.asarray``);
+    injectable so the policy is testable without a device.  ``registry``
+    is an optional MetricsRegistry mirror of the stats counters.
+    """
+
+    def __init__(self, budget_bytes: int, registry=None,
+                 upload: Optional[Callable] = None,
+                 prefix: str = "stream") -> None:
+        if budget_bytes < 0:
+            raise ValueError(f"budget must be >= 0, got {budget_bytes}")
+        self.budget = int(budget_bytes)
+        self.registry = registry
+        self.prefix = prefix
+        self._upload = upload
+        # key -> (handle, nbytes); order == LRU (oldest first)
+        self._entries: "OrderedDict[Tuple, Tuple[object, int]]" = OrderedDict()
+        self._resident = 0        # bytes pinned in _entries
+        self._transient = 0       # uploaded-but-unpinned bytes still in
+        #                           flight (cleared at mark_sync)
+        self._plan: Optional[set] = None
+        self.stats: Dict[str, int] = {
+            "h2d_bytes": 0, "h2d_cube_bytes": 0, "h2d_uploads": 0,
+            "hits": 0, "hit_bytes": 0, "misses": 0, "evictions": 0,
+            "adopted_bytes": 0, "d2h_bytes": 0, "peak_bytes": 0,
+        }
+        if registry is not None:
+            registry.gauge_set(f"{prefix}_cache_budget_bytes", self.budget)
+
+    # -- planning ---------------------------------------------------------
+    def plan(self, sizes: Iterable[Tuple[Tuple, int]]) -> bool:
+        """Reserve the budget for a known per-iteration constant tile set.
+
+        ``sizes`` is ``[(key, nbytes), ...]`` in priority order; keys are
+        admitted first-fit while the budget holds them.  Keys left out are
+        never cached (they stream as transient uploads under the two-tile
+        bound).  Returns True when EVERY key fits — the engine's signal
+        that iterations >= 2 will perform zero constant-tile uploads and
+        that the pipelined sweep may dispatch without the two-tile cap.
+        """
+        planned, reserved, all_fit = set(), 0, True
+        for key, nbytes in sizes:
+            if nbytes <= self.budget - reserved:
+                planned.add(key)
+                reserved += int(nbytes)
+            else:
+                all_fit = False
+        self._plan = planned
+        return all_fit
+
+    def plan_covers(self, key: Tuple) -> bool:
+        return self._plan is not None and key in self._plan
+
+    # -- core -------------------------------------------------------------
+    def get(self, key: Optional[Tuple], host_array, cube: bool = False):
+        """Device handle for ``host_array``, keyed by ``key``.
+
+        A hit returns the pinned live handle (no transfer).  A miss
+        uploads, counts the measured bytes, and pins the entry when the
+        key is admissible (within budget; in the plan when one is set) —
+        evicting LRU entries as needed.  ``key=None`` is an always-
+        transient upload (per-iteration varying data, e.g. the current
+        weight tiles).  ``cube=True`` tags the bytes as cube-sized in the
+        stats (the residency-contract tests key off this split).
+        """
+        if key is not None:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.stats["hits"] += 1
+                self.stats["hit_bytes"] += entry[1]
+                return entry[0]
+            self.stats["misses"] += 1
+        handle = self._do_upload(host_array)
+        nbytes = int(host_array.nbytes)
+        self._count_h2d(nbytes, cube)
+        if key is not None and self._admissible(key, nbytes):
+            self._insert(key, handle, nbytes)
+        else:
+            self._transient += nbytes
+        self._note_peak()
+        return handle
+
+    def adopt(self, key: Tuple, handle, nbytes: int) -> bool:
+        """Pin an ALREADY-DEVICE-RESIDENT handle (e.g. a prep output) —
+        zero H2D.  Returns True when pinned; False when the key is not
+        admissible (the caller just lets the handle go out of scope, the
+        pre-cache behaviour)."""
+        if not self._admissible(key, int(nbytes)):
+            return False
+        self._insert(key, handle, int(nbytes))
+        self.stats["adopted_bytes"] += int(nbytes)
+        self._note_peak()
+        return True
+
+    def mark_sync(self) -> None:
+        """A host-fetch sync point: everything dispatched before it has
+        completed, so transient uploads (and any LRU-evicted handles) are
+        reclaimable.  The engine calls this where it already fetches each
+        tile's small result — the same sync that capped residency at two
+        tiles before the cache existed."""
+        self._transient = 0
+
+    def count_d2h(self, nbytes: int) -> None:
+        """Record measured device→host bytes (the drain side of the
+        pipelined sweep; small per-tile results, but measured is
+        measured)."""
+        self.stats["d2h_bytes"] += int(nbytes)
+        if self.registry is not None:
+            self.registry.counter_inc(f"{self.prefix}_d2h_bytes", nbytes)
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def resident_bytes(self) -> int:
+        return self._resident
+
+    @property
+    def peak_bytes(self) -> int:
+        return self.stats["peak_bytes"]
+
+    def flush_stats(self) -> Dict[str, int]:
+        """Final gauges + hit/miss counters into the registry; returns the
+        stats dict.  Call once per clean (streaming_exact does): hits and
+        misses accumulate locally during the sweep — publishing them here
+        instead of per-``get`` keeps the hot path free of registry lock
+        traffic."""
+        if self.registry is not None:
+            self.registry.gauge_set(
+                f"{self.prefix}_cache_resident_bytes", self._resident)
+            self.registry.gauge_set(
+                f"{self.prefix}_cache_peak_bytes", self.stats["peak_bytes"])
+            self.registry.gauge_set(
+                f"{self.prefix}_cache_resident_tiles", len(self._entries))
+            self.registry.counter_inc(
+                f"{self.prefix}_cache_hits", self.stats["hits"])
+            self.registry.counter_inc(
+                f"{self.prefix}_cache_hit_bytes", self.stats["hit_bytes"])
+            self.registry.counter_inc(
+                f"{self.prefix}_cache_misses", self.stats["misses"])
+        return dict(self.stats)
+
+    # -- internals --------------------------------------------------------
+    def _do_upload(self, host_array):
+        if self._upload is not None:
+            return self._upload(host_array)
+        import jax.numpy as jnp
+
+        return jnp.asarray(host_array)
+
+    def _admissible(self, key: Tuple, nbytes: int) -> bool:
+        if nbytes > self.budget:
+            return False
+        if self._plan is not None:
+            return key in self._plan
+        return True
+
+    def _insert(self, key: Tuple, handle, nbytes: int) -> None:
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._resident -= old[1]
+        while self._resident + nbytes > self.budget and self._entries:
+            _, (_, evicted_bytes) = self._entries.popitem(last=False)
+            self._resident -= evicted_bytes
+            self.stats["evictions"] += 1
+            if self.registry is not None:
+                self.registry.counter_inc(f"{self.prefix}_cache_evictions")
+        self._entries[key] = (handle, nbytes)
+        self._resident += nbytes
+
+    def _count_h2d(self, nbytes: int, cube: bool) -> None:
+        self.stats["h2d_bytes"] += nbytes
+        self.stats["h2d_uploads"] += 1
+        if cube:
+            self.stats["h2d_cube_bytes"] += nbytes
+        if self.registry is not None:
+            self.registry.counter_inc(f"{self.prefix}_h2d_bytes", nbytes)
+            self.registry.counter_inc(f"{self.prefix}_h2d_uploads")
+            if cube:
+                self.registry.counter_inc(
+                    f"{self.prefix}_h2d_cube_bytes", nbytes)
+
+    def _note_peak(self) -> None:
+        live = self._resident + self._transient
+        if live > self.stats["peak_bytes"]:
+            self.stats["peak_bytes"] = live
+
+
+def pipelined_sweep(n_tiles: int, put, run, drain,
+                    depth: int = 1, on_sync=None) -> None:
+    """The exact-streaming tile scheduler.
+
+    ``put(i)`` stages tile *i*'s device inputs (uploads or cache hits —
+    jax dispatch is async, so a real upload overlaps the previous tile's
+    compute), ``run(i, inputs)`` enqueues the tile's program, ``drain(i,
+    out)`` host-fetches its SMALL result.  At ``depth=1`` this is the
+    classic one-tile-lookahead: each tile's result is fetched before the
+    tile after next is enqueued, and that host fetch is the sync that caps
+    device residency at two tiles (block_until_ready would be a no-op on
+    the lazily-materialising tunnel executor — benchmarks/README.md
+    "Tunnel timing rules" — a host fetch is not).  When every input is
+    cache-resident the caller raises ``depth`` to ``n_tiles``: no H2D is
+    in flight, outputs are plane-sized, so dispatching the whole pass
+    before draining costs no cube residency and removes n_tiles host
+    round-trip stalls.  Results are always drained in tile order, so the
+    caller's host-side accumulation order — and therefore the masks — is
+    identical at every depth.  ``on_sync`` (the cache's ``mark_sync``)
+    runs after each drain.
+    """
+    depth = max(1, int(depth))
+    pending = []  # (index, out) in dispatch order
+    if n_tiles <= 0:
+        return
+
+    def flush_one():
+        i, out = pending.pop(0)
+        drain(i, out)
+        if on_sync is not None:
+            on_sync()
+
+    nxt = put(0)
+    for i in range(n_tiles):
+        out = run(i, nxt)
+        if i + 1 < n_tiles:
+            nxt = put(i + 1)
+        pending.append((i, out))
+        while len(pending) > depth:
+            flush_one()
+    while pending:
+        flush_one()

@@ -220,6 +220,88 @@ def scale_and_combine(diagnostics, cell_mask, chanthresh, subintthresh,
     return jnp.median(jnp.stack(per_diag), axis=0)
 
 
+def _masked_median_1gather(values, mask, axis, n):
+    """:func:`masked_median` (sort impl) with the two order-statistic picks
+    in ONE ``take_along_axis`` — indices concatenated along the sort axis,
+    the pair split back off afterwards.  Gathers copy elements, so the
+    result is bit-identical; one gather op instead of two matters only for
+    program compile latency (see :func:`scale_and_combine_compact`).
+    ``n`` is the caller's precomputed unmasked count (keepdims)."""
+    sentinel = jnp.asarray(jnp.inf, dtype=values.dtype)
+    ordered = jnp.sort(jnp.where(mask, sentinel, values), axis=axis)
+    size = values.shape[axis]
+    idx = jnp.concatenate([jnp.clip((n - 1) // 2, 0, size - 1),
+                           jnp.clip(n // 2, 0, size - 1)], axis=axis)
+    picks = jnp.take_along_axis(ordered, idx, axis=axis)
+    lo = jax.lax.slice_in_dim(picks, 0, 1, axis=axis)
+    hi = jax.lax.slice_in_dim(picks, 1, 2, axis=axis)
+    med = 0.5 * (lo + hi)
+    return jnp.where(n == 0, jnp.zeros_like(med), med)
+
+
+def _scaled_sides_stacked(diagnostics, mask, axis, thresh, median_impl):
+    """One orientation of all four scalers over a STACKED (4, nsub, nchan)
+    array: the two medians inside cost one sort each instead of one per
+    diagnostic.  Sort, take_along_axis and every elementwise op act per
+    line, so each slice is bit-identical to the unstacked route — the
+    masked slices to :func:`scale_lines_masked`, the rFFT slice to
+    :func:`scale_lines_plain` (its ``jnp.median`` equals the all-false-mask
+    ``masked_median`` with NaN-bearing lines patched; locked in by
+    tests/test_stats_parity.py)."""
+    stacked = jnp.stack(diagnostics)
+    mask4 = jnp.concatenate([
+        jnp.broadcast_to(mask, (3,) + mask.shape),
+        jnp.zeros((1,) + mask.shape, dtype=bool),  # rFFT: plain path
+    ])
+    ax = axis + 1
+    n = jnp.sum(~mask4, axis=ax, keepdims=True)
+    # quirk-5 NaN patches apply to the plain slice only; a broadcast
+    # selector keeps them as cheap `where`s instead of scatter updates
+    plain = jnp.arange(4).reshape((4,) + (1,) * mask.ndim) == 3
+    med = _masked_median_1gather(stacked, mask4, ax, n)
+    med = jnp.where(
+        plain & jnp.any(jnp.isnan(stacked), axis=ax, keepdims=True),
+        jnp.nan, med)
+    centred = jnp.where(mask4, stacked, stacked - med)
+    abs_centred = jnp.abs(centred)
+    mad = _masked_median_1gather(abs_centred, mask4, ax, n)
+    mad = jnp.where(
+        plain & jnp.any(jnp.isnan(abs_centred), axis=ax, keepdims=True),
+        jnp.nan, mad)
+    masked_out = _masked_side(centred[:3], mad[:3], mask4[:3], n[:3], thresh)
+    plain_out = jnp.abs(centred[3] / mad[3]) / thresh
+    return [masked_out[0], masked_out[1], masked_out[2], plain_out]
+
+
+def scale_and_combine_compact(diagnostics, cell_mask, chanthresh,
+                              subintthresh, median_impl="sort"):
+    """:func:`scale_and_combine` with the four diagnostics stacked so each
+    orientation costs TWO sort ops instead of eight — bit-identical output
+    (see :func:`_scaled_sides_stacked`).
+
+    Built for callers that compile the combine step as its own standalone
+    XLA program: exact streaming's per-iteration combine
+    (parallel/streaming_exact.py), where program compile latency is paid
+    on the first iteration's critical path and scales with the op count.
+    The whole-archive engines keep :func:`scale_and_combine` — their
+    combine lowers inside one monolithic program where XLA's own CSE and
+    fusion absorb the duplicate sorts and the compile is a single
+    up-front cost.
+    """
+    if median_impl == "pallas":
+        # the fused Pallas scaler is already a single launch per
+        # orientation — nothing left to stack (and the non-float32 rFFT
+        # fallback would need per-slice impls the stacked call can't mix)
+        return scale_and_combine(diagnostics, cell_mask, chanthresh,
+                                 subintthresh, median_impl)
+    chan = _scaled_sides_stacked(diagnostics, cell_mask, 0, chanthresh,
+                                 median_impl)
+    subint = _scaled_sides_stacked(diagnostics, cell_mask, 1, subintthresh,
+                                   median_impl)
+    per_diag = [jnp.maximum(c, s) for c, s in zip(chan, subint)]
+    return jnp.median(jnp.stack(per_diag), axis=0)
+
+
 def surgical_scores_jax(resid_weighted, cell_mask, chanthresh, subintthresh,
                         fft_mode="fft", median_impl="sort"):
     """Zap scores for every (subint, channel) cell; score >= 1 means zap.

@@ -55,6 +55,23 @@ def test_bench_small_end_to_end_json_schema():
     assert out["unit"] == "cell-iters/s"
     assert out["value"] > 0 and out["vs_baseline"] > 0
     assert out["quality"]["precision"] is not None
+    # streaming row: measured-transfer contract (tile cache H2D counter)
+    # plus the one-release-compat modeled figure
+    for key in ("streaming_geometry", "streaming_platform",
+                "streaming_tile_passes_per_s", "streaming_eff_gbps",
+                "modeled_streaming_eff_gbps", "streaming_h2d_bytes",
+                "streaming_vs_whole"):
+        assert key in out, key
+    assert out["streaming_h2d_bytes"] > 0      # measured, never modeled
+    assert out["streaming_vs_whole"] > 0
+    # batch row (equal-shape archives through parallel/batch.py)
+    for key in ("batch_n", "batch_geometry", "batch_platform",
+                "batch_cell_iters_per_s", "batch_vs_sequential",
+                "batch_per_archive_ms", "batch_h2d_bytes"):
+        assert key in out, key
+    assert out["batch_n"] >= 8
+    assert out["batch_h2d_bytes"] > 0
+    assert out["batch_cell_iters_per_s"] > 0
 
 
 def test_profile_stages_small_end_to_end():

@@ -247,9 +247,9 @@ def test_batch_buckets_interleaved_shapes(tmp_path, monkeypatch):
     groups = []
     real = batch_mod.clean_archives_batched
 
-    def spy(ars, cfg, mesh=None):
+    def spy(ars, cfg, mesh=None, **kw):
         groups.append([(a.nsub, a.nchan) for a in ars])
-        return real(ars, cfg, mesh)
+        return real(ars, cfg, mesh, **kw)
 
     monkeypatch.setattr(batch_mod, "clean_archives_batched", spy)
     assert main(["-q", "-l", "--batch", "2"] + paths) == 0

@@ -101,6 +101,46 @@ def test_jax_matches_oracle_float64(case):
     np.testing.assert_array_equal(got >= 1.0, want >= 1.0)
 
 
+@pytest.mark.parametrize("case", range(len(CASES)))
+def test_compact_scaler_bit_equal(case):
+    """scale_and_combine_compact (the stacked-sort single-program scaler
+    exact streaming compiles) must agree BIT-FOR-BIT with the reference
+    scale_and_combine on the same diagnostics — including zero-MAD inf/nan
+    lines and fully-masked rows, where a where-patch slip would show."""
+    from iterative_cleaner_tpu.stats.masked_jax import (
+        cell_diagnostics_jax,
+        scale_and_combine,
+        scale_and_combine_compact,
+    )
+
+    cube, mask = CASES[case]
+    diags = cell_diagnostics_jax(jnp.asarray(cube), jnp.asarray(mask))
+    want = np.asarray(scale_and_combine(diags, jnp.asarray(mask), 5.0, 5.0))
+    got = np.asarray(
+        scale_and_combine_compact(diags, jnp.asarray(mask), 5.0, 5.0))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_compact_scaler_extreme_values_bit_equal():
+    """inf/1e20 diagnostics and a NaN cell: the compact path's jnp.median
+    NaN patch must reproduce masked_median's routing exactly."""
+    from iterative_cleaner_tpu.stats.masked_jax import (
+        cell_diagnostics_jax,
+        scale_and_combine,
+        scale_and_combine_compact,
+    )
+
+    cube, mask = _random_case(7, nsub=9, nchan=6, nbin=31)
+    cube[0, 0, :] = 1e20
+    cube[3, 1, 5] = np.inf
+    cube[5, 2, 0] = np.nan
+    diags = cell_diagnostics_jax(jnp.asarray(cube), jnp.asarray(mask))
+    want = np.asarray(scale_and_combine(diags, jnp.asarray(mask), 5.0, 5.0))
+    got = np.asarray(
+        scale_and_combine_compact(diags, jnp.asarray(mask), 5.0, 5.0))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_masked_cells_never_unmask_scores():
     cube, mask = _adversarial_case()
     scores = np.asarray(surgical_scores_jax(jnp.asarray(cube), jnp.asarray(mask), 5.0, 5.0))

@@ -1,0 +1,287 @@
+"""Tile cache policy + exact-streaming residency contract.
+
+Unit half: TileCache is policy-only (parallel/tile_cache.py module
+docstring), so every admission/eviction/accounting rule is tested with an
+injected fake ``upload`` — no device, no jax arrays.
+
+Integration half: the residency CONTRACT the tentpole promises —
+under budget, iterations >= 2 perform zero constant cube uploads (prep
+pays the one-cube cost once); at budget 0 the engine degrades to the
+classic streaming behaviour whose device residency stays a small multiple
+of one tile's inputs, never the whole cube; and in both regimes the masks
+stay bit-equal to whole-archive cleaning.
+"""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.parallel.tile_cache import (
+    FALLBACK_BUDGET_BYTES,
+    TileCache,
+    pipelined_sweep,
+    resolve_budget_bytes,
+)
+from iterative_cleaner_tpu.telemetry import MetricsRegistry
+
+
+def _arr(n_bytes):
+    return np.zeros(n_bytes, dtype=np.uint8)
+
+
+def _cache(budget, registry=None):
+    uploads = []
+
+    def upload(a):
+        uploads.append(a.nbytes)
+        return ("dev", id(a))  # distinct handle per upload
+
+    c = TileCache(budget, registry=registry, upload=upload)
+    return c, uploads
+
+
+# --- budget resolution ------------------------------------------------------
+
+def test_resolve_budget_precedence(monkeypatch):
+    monkeypatch.setenv("ICLEAN_STREAM_HBM_MB", "16")
+    # explicit config wins over the env
+    assert resolve_budget_bytes(8) == 8 * 2 ** 20
+    assert resolve_budget_bytes(0) == 0
+    # env wins over device defaults
+    assert resolve_budget_bytes(None) == 16 * 2 ** 20
+    monkeypatch.setenv("ICLEAN_STREAM_HBM_MB", "0")
+    assert resolve_budget_bytes(None) == 0
+    with pytest.raises(ValueError, match=">= 0"):
+        resolve_budget_bytes(-1)
+    monkeypatch.setenv("ICLEAN_STREAM_HBM_MB", "-4")
+    with pytest.raises(ValueError, match="ICLEAN_STREAM_HBM_MB"):
+        resolve_budget_bytes(None)
+
+
+def test_resolve_budget_device_fraction_and_fallback(monkeypatch):
+    monkeypatch.delenv("ICLEAN_STREAM_HBM_MB", raising=False)
+
+    class Dev:
+        def __init__(self, stats):
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    assert resolve_budget_bytes(None, Dev({"bytes_limit": 100 * 2 ** 20})) \
+        == int(100 * 2 ** 20 * 0.4)
+    # backends reporting no stats (CPU) get the conservative constant
+    assert resolve_budget_bytes(None, Dev({})) == FALLBACK_BUDGET_BYTES
+    assert resolve_budget_bytes(None, Dev(None)) == FALLBACK_BUDGET_BYTES
+
+
+# --- cache policy (no device) ----------------------------------------------
+
+def test_hit_returns_pinned_handle_without_upload():
+    c, uploads = _cache(1000)
+    a = _arr(100)
+    h1 = c.get(("k",), a)
+    h2 = c.get(("k",), a)
+    assert h1 is h2
+    assert len(uploads) == 1
+    assert c.stats["hits"] == 1 and c.stats["misses"] == 1
+    assert c.stats["hit_bytes"] == 100
+    assert c.resident_bytes == 100
+
+
+def test_lru_eviction_under_budget_pressure():
+    c, uploads = _cache(250)
+    c.get(("a",), _arr(100))
+    c.get(("b",), _arr(100))
+    c.get(("a",), _arr(100))          # refresh a: b is now LRU
+    c.get(("c",), _arr(100))          # needs room -> evicts b
+    assert c.stats["evictions"] == 1
+    assert c.resident_bytes == 200
+    n_before = len(uploads)
+    c.get(("a",), _arr(100))          # a survived the eviction
+    assert len(uploads) == n_before
+    c.get(("b",), _arr(100))          # b did not: re-upload (miss)
+    assert len(uploads) == n_before + 1
+
+
+def test_oversized_and_keyless_stay_transient():
+    c, uploads = _cache(100)
+    c.get(("big",), _arr(200))        # over budget: never pinned
+    c.get(None, _arr(50))             # keyless: per-iteration varying data
+    assert c.resident_bytes == 0
+    assert len(uploads) == 2
+    assert c.peak_bytes == 250        # both still in flight pre-sync
+    c.mark_sync()
+    c.get(None, _arr(10))
+    assert c.peak_bytes == 250        # sync reclaimed the transients
+
+
+def test_plan_admission_first_fit():
+    c, uploads = _cache(250)
+    # only the first two fit: plan() must say not-everything-fits
+    assert c.plan([(("a",), 100), (("b",), 100), (("c",), 100)]) is False
+    assert c.plan_covers(("a",)) and c.plan_covers(("b",))
+    assert not c.plan_covers(("c",))
+    c.get(("c",), _arr(100))          # unplanned key streams transient
+    assert c.resident_bytes == 0
+    c.get(("a",), _arr(100))
+    assert c.resident_bytes == 100
+    # a plan that fully fits is the all-resident signal
+    assert c.plan([(("a",), 100), (("b",), 100)]) is True
+
+
+def test_adopt_pins_without_h2d():
+    c, _ = _cache(100)
+    assert c.adopt(("d",), "handle", 80) is True
+    assert c.resident_bytes == 80
+    assert c.stats["h2d_bytes"] == 0 and c.stats["adopted_bytes"] == 80
+    assert c.get(("d",), _arr(80)) == "handle"   # hit, still no upload
+    assert c.stats["h2d_bytes"] == 0
+    assert c.adopt(("too-big",), "x", 200) is False  # caller lets it go
+
+
+def test_registry_mirrors_measured_transfers():
+    reg = MetricsRegistry()
+    c, _ = _cache(150)
+    c.registry = reg                   # _cache built it without one
+    c.get(("cube", 0), _arr(100), cube=True)
+    c.get(("w", 0), _arr(20))
+    c.get(("cube", 0), _arr(100), cube=True)   # hit: no new bytes
+    c.get(("cube", 1), _arr(100), cube=True)   # 120+100 > 150: evicts both
+    c.count_d2h(8)
+    c.flush_stats()
+    snap = reg.counters
+    assert snap["stream_h2d_bytes"] == 220
+    assert snap["stream_h2d_cube_bytes"] == 200
+    assert snap["stream_h2d_uploads"] == 3
+    assert snap["stream_cache_evictions"] == 2
+    assert snap["stream_cache_hits"] == 1
+    assert snap["stream_cache_misses"] == 3
+    assert snap["stream_d2h_bytes"] == 8
+    assert reg.gauges["stream_cache_peak_bytes"] == c.peak_bytes
+
+
+def test_budget_zero_pins_nothing_but_still_meters():
+    reg = MetricsRegistry()
+    c = TileCache(0, registry=reg, upload=lambda a: "h")
+    c.get(("k",), _arr(100), cube=True)
+    c.get(("k",), _arr(100), cube=True)
+    assert c.resident_bytes == 0 and c.stats["hits"] == 0
+    assert c.stats["h2d_bytes"] == 200  # every pass re-streams, measured
+    with pytest.raises(ValueError, match=">= 0"):
+        TileCache(-1)
+
+
+# --- pipelined sweep scheduling --------------------------------------------
+
+def _sweep_trace(n_tiles, depth):
+    events = []
+    pipelined_sweep(
+        n_tiles,
+        put=lambda i: events.append(("put", i)) or i,
+        run=lambda i, ins: events.append(("run", i)) or i,
+        drain=lambda i, out: events.append(("drain", i)),
+        depth=depth, on_sync=lambda: events.append(("sync", None)))
+    return events
+
+
+def test_sweep_depth1_is_one_tile_lookahead():
+    ev = _sweep_trace(4, depth=1)
+    # tile i+1 is staged before tile i drains (overlap), but tile i MUST
+    # drain before tile i+2 runs — the two-tile residency bound
+    for i in range(2, 4):
+        assert ev.index(("drain", i - 2)) < ev.index(("run", i))
+    assert [e for e in ev if e[0] == "drain"] == \
+        [("drain", i) for i in range(4)]
+    # every drain is a sync point (the cache's transient reclaim)
+    assert sum(1 for e in ev if e[0] == "sync") == 4
+
+
+def test_sweep_full_depth_dispatches_whole_pass_first():
+    ev = _sweep_trace(4, depth=4)
+    # all runs precede all drains; drain order still tile order, so the
+    # host-side accumulation (and the masks) cannot move with depth
+    assert max(ev.index(("run", i)) for i in range(4)) < \
+        ev.index(("drain", 0))
+    assert [e for e in ev if e[0] == "drain"] == \
+        [("drain", i) for i in range(4)]
+
+
+def test_sweep_trivial_sizes():
+    assert _sweep_trace(0, depth=1) == []
+    ev = _sweep_trace(1, depth=3)   # depth beyond n_tiles is clamped by use
+    assert [e[0] for e in ev] == ["put", "run", "drain", "sync"]
+
+
+# --- residency contract (integration, CPU jax) -----------------------------
+
+def _residency_fixture():
+    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+    ar, _ = make_synthetic_archive(nsub=32, nchan=16, nbin=32, seed=29,
+                                   n_rfi_cells=8, n_rfi_channels=2,
+                                   n_prezapped=10)
+    return ar
+
+
+def _clean_with_budget(ar, budget_mb):
+    import dataclasses
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.parallel import clean_streaming_exact
+
+    cfg = dataclasses.replace(CleanConfig(backend="jax", dtype="float64"),
+                              stream_hbm_mb=budget_mb)
+    reg = MetricsRegistry()
+    res = clean_streaming_exact(ar.clone(), 8, cfg, registry=reg)
+    return res, reg
+
+
+def test_streaming_under_budget_uploads_cube_once():
+    """The tentpole contract: with the tile set resident, the constant
+    cube crosses H2D exactly once (prep), however many iterations run —
+    and the masks still match whole-archive cleaning bit-for-bit."""
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.config import CleanConfig
+
+    ar = _residency_fixture()
+    whole = clean_archive(ar.clone(),
+                          CleanConfig(backend="jax", dtype="float64"))
+    res, reg = _clean_with_budget(ar, 64.0)
+    np.testing.assert_array_equal(whole.final_weights, res.final_weights)
+    assert res.loops >= 2, "fixture must iterate for the contract to bite"
+    cube_bytes = 32 * 16 * 32 * 8  # nsub*nchan*nbin float64: ONE cube
+    assert reg.counters["stream_h2d_cube_bytes"] == cube_bytes
+    assert reg.counters["stream_cache_hits"] > 0
+    assert reg.counters["stream_h2d_bytes"] > 0  # measured, non-zero
+
+
+def test_streaming_budget_zero_degrades_to_tile_residency():
+    """Budget 0 (config or ICLEAN_STREAM_HBM_MB=0): nothing pins, cube
+    tiles re-stream every pass, yet peak device residency stays a small
+    multiple of one tile's inputs — far under the whole cube — and masks
+    are unchanged.  This is the >HBM-observation guarantee."""
+    ar = _residency_fixture()
+    res_cached, _ = _clean_with_budget(ar, 64.0)
+    res0, reg0 = _clean_with_budget(ar, 0.0)
+    np.testing.assert_array_equal(res_cached.final_weights,
+                                  res0.final_weights)
+    assert res_cached.loops == res0.loops
+    cube_bytes = 32 * 16 * 32 * 8
+    assert reg0.counters["stream_h2d_cube_bytes"] > cube_bytes
+    assert reg0.gauges["stream_cache_resident_bytes"] == 0
+    # the classic streaming bound: peak residency well under the cube
+    # (4 tiles of 8 subints; lookahead holds ~2 tiles' inputs + planes)
+    assert reg0.gauges["stream_cache_peak_bytes"] < cube_bytes
+
+
+def test_streaming_env_budget_knob(monkeypatch):
+    """ICLEAN_STREAM_HBM_MB drives the default (config None) budget."""
+    ar = _residency_fixture()
+    monkeypatch.setenv("ICLEAN_STREAM_HBM_MB", "0")
+    res_env, reg_env = _clean_with_budget(ar, None)
+    assert reg_env.gauges["stream_cache_budget_bytes"] == 0
+    monkeypatch.delenv("ICLEAN_STREAM_HBM_MB")
+    res_def, reg_def = _clean_with_budget(ar, None)
+    assert reg_def.gauges["stream_cache_budget_bytes"] > 0
+    np.testing.assert_array_equal(res_env.final_weights,
+                                  res_def.final_weights)
