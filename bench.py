@@ -330,13 +330,10 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
     (parallel/tile_cache.py) counts every H2D byte it actually moves into
     the run's MetricsRegistry (``stream_h2d_bytes``), so the figure
     reflects residency — a cache that pins tiles across iterations moves
-    fewer bytes and the rate drops with wall time, as it should.  The old
-    cube-tile-upload MODEL (n_tiles x loops x passes x padded-tile bytes
-    over wall time, which assumed every pass re-uploads and skipped the
-    small weight/mask/offset uploads) is kept one release as
-    ``modeled_streaming_eff_gbps`` so existing capture tooling can
-    cross-check before switching.  Wall-clock (not in-program
-    differential) is the honest denominator here: the per-tile
+    fewer bytes and the rate drops with wall time, as it should.  (The
+    old cube-tile-upload model rode along one release as a ``modeled_``
+    companion key and is gone.)  Wall-clock (not
+    in-program differential) is the honest denominator here: the per-tile
     dispatch+H2D cost IS the thing being measured, amortised over
     loops x tiles x passes dispatches.
     """
@@ -376,9 +373,7 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
 
     n_tiles = math.ceil(nsub / chunk)
     passes = 3 if cfg.baseline_mode == "integration" else 2
-    tile_bytes = chunk * nchan * nbin * 4
     tiles_per_s = n_tiles * stream.loops * passes / t_stream
-    modeled_gbps = tiles_per_s * tile_bytes / 1e9
     h2d = int(reg.counters.get("stream_h2d_bytes", 0))
     eff_gbps = h2d / t_stream / 1e9
     hits = int(reg.counters.get("stream_cache_hits", 0))
@@ -386,7 +381,7 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
          f"{t_stream:.2f}s vs whole {t_whole:.2f}s "
          f"({t_stream / t_whole:.2f}x), {tiles_per_s:.1f} tile-passes/s, "
          f"{eff_gbps:.3f} GB/s measured H2D ({h2d} bytes, {hits} cache "
-         f"hits; model said {modeled_gbps:.3f})")
+         f"hits)")
     import jax
 
     return {
@@ -398,7 +393,6 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
         "streaming_platform": jax.default_backend(),
         "streaming_tile_passes_per_s": round(tiles_per_s, 1),
         "streaming_eff_gbps": round(eff_gbps, 3),
-        "modeled_streaming_eff_gbps": round(modeled_gbps, 2),
         "streaming_h2d_bytes": h2d,
         "streaming_vs_whole": round(t_stream / t_whole, 2),
     }
@@ -468,6 +462,122 @@ def bench_batch(n_archives, nsub, nchan, nbin, max_iter=3):
         "batch_per_archive_ms": round(t_batch / n_archives * 1e3, 1),
         "batch_h2d_bytes": int(reg.counters.get("batch_h2d_bytes", 0)),
     }
+
+
+def bench_fleet(n_archives, geometries, max_iter=3, group_size=8,
+                io_workers=2):
+    """Mixed-shape fleet row: n archives spread round-robin over several
+    geometries, written to disk, then served end-to-end (load + clean +
+    write) two ways — the sequential per-archive loop the CLI runs today,
+    and the shape-bucketed fleet scheduler (parallel/fleet.py).
+
+    Both paths run twice and the SECOND pass is timed: warm-vs-warm
+    isolates the serving-pipeline win (batched dispatch + IO/compute
+    overlap) from one-off compile cost, which the in-process jit caches
+    would otherwise charge to whichever path ran first.  The cold fleet
+    pass feeds the compile-amortization contract instead:
+    ``fleet_compiles`` must equal ``fleet_buckets`` (one program per
+    bucket — K shapes, K compiles, however many archives).  Masks must be
+    bit-equal to the sequential path for every archive (quantization off;
+    the assert is the rc-7 parity contract of the subprocess row).
+    """
+    import dataclasses
+    import shutil
+    import tempfile
+
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io import load_archive, save_archive
+    from iterative_cleaner_tpu.io.synthetic import (
+        bench_rfi_density,
+        make_synthetic_archive,
+    )
+    from iterative_cleaner_tpu.parallel.fleet import clean_fleet
+    from iterative_cleaner_tpu.telemetry import MetricsRegistry
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    try:
+        t0 = time.perf_counter()
+        paths = []
+        for i in range(n_archives):
+            nsub, nchan, nbin = geometries[i % len(geometries)]
+            ar, _ = make_synthetic_archive(
+                nsub=nsub, nchan=nchan, nbin=nbin,
+                **bench_rfi_density(nsub, nchan), seed=i, dtype=np.float32)
+            p = os.path.join(tmp, "fleet_%03d.npz" % i)
+            save_archive(ar, p)
+            paths.append(p)
+        _log(f"fleet stage: {n_archives} archives x "
+             f"{len(geometries)} geometries generated in "
+             f"{time.perf_counter() - t0:.1f}s")
+        cfg = CleanConfig(backend="jax", max_iter=max_iter)
+
+        def write_out(path, ar, result):
+            out = dataclasses.replace(
+                ar, weights=result.final_weights.astype(ar.weights.dtype))
+            save_archive(out, path + "_cleaned.npz")
+
+        def run_sequential():
+            out = {}
+            for p in paths:
+                ar = load_archive(p)
+                res = clean_archive(ar, cfg)
+                write_out(p, ar, res)
+                out[p] = res
+            return out
+
+        def run_fleet(reg):
+            rep = clean_fleet(paths, cfg, registry=reg,
+                              group_size=group_size, io_workers=io_workers,
+                              write_fn=write_out)
+            assert not rep.failures, rep.failures
+            return rep
+
+        run_sequential()                        # warm the per-archive jits
+        cold_reg = MetricsRegistry()
+        cold = run_fleet(cold_reg)              # cold: one compile/bucket
+        # Timed passes interleave (seq, fleet, seq, fleet) and keep each
+        # side's best: back-to-back blocks would charge container CPU
+        # drift (cgroup burst credits draining over the run) to whichever
+        # path happened to run last.
+        t_seq = t_fleet = None
+        seq = fleet = warm_reg = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            seq = run_sequential()
+            dt = time.perf_counter() - t0
+            t_seq = dt if t_seq is None else min(t_seq, dt)
+            warm_reg = MetricsRegistry()
+            t0 = time.perf_counter()
+            fleet = run_fleet(warm_reg)
+            dt = time.perf_counter() - t0
+            t_fleet = dt if t_fleet is None else min(t_fleet, dt)
+        _log(f"fleet stage: sequential x{n_archives} warm in {t_seq:.2f}s")
+        n_buckets = cold.n_buckets
+        n_compiles = cold.n_compiles
+        _log(f"fleet stage: {n_buckets} buckets, {n_compiles} compiles "
+             f"(cold), warm serve {t_fleet:.2f}s vs sequential {t_seq:.2f}s "
+             f"({t_fleet / t_seq:.2f}x)")
+        for i, p in enumerate(paths):
+            assert np.array_equal(seq[p].final_weights == 0,
+                                  fleet.results[p].final_weights == 0), \
+                f"fleet mask diverged from sequential (archive {i})"
+        import jax
+
+        return {
+            "fleet_n": n_archives,
+            "fleet_geometries": "+".join(
+                "%dx%dx%d" % tuple(g) for g in geometries),
+            "fleet_platform": jax.default_backend(),
+            "fleet_buckets": n_buckets,
+            "fleet_compiles": n_compiles,
+            "fleet_vs_sequential": round(t_fleet / t_seq, 2),
+            "fleet_per_archive_ms": round(t_fleet / n_archives * 1e3, 1),
+            "fleet_h2d_bytes": int(
+                warm_reg.counters.get("batch_h2d_bytes", 0)),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def bench_numpy(nsub, nchan, nbin, max_iter=5):
@@ -540,7 +650,8 @@ def main():
     from iterative_cleaner_tpu.utils import fallback_to_cpu_if_unreachable
 
     for env_key, stage in (("BENCH_STREAMING_ONLY", bench_streaming),
-                           ("BENCH_BATCH_ONLY", bench_batch)):
+                           ("BENCH_BATCH_ONLY", bench_batch),
+                           ("BENCH_FLEET_ONLY", bench_fleet)):
         if os.environ.get(env_key):
             geom = json.loads(os.environ[env_key])
             fallback_to_cpu_if_unreachable(
@@ -617,6 +728,30 @@ def main():
          "nbin": b_geom[2]},
         timeout=float(os.environ.get("BENCH_BATCH_TIMEOUT", "600")),
         label="batch")
+    if row:
+        extras = {**(extras or {}), **row}
+
+    # mixed-shape fleet row (parallel/fleet.py): K geometries round-robin
+    # over the archive list, served through the shape-bucketed scheduler
+    # vs the sequential per-archive loop — compile count must equal the
+    # bucket count and masks must match sequential bit-for-bit (the same
+    # parity-is-fatal subprocess contract as the rows above).  BENCH_SMALL
+    # doubles as the CI smoke geometry: 6 archives in 2 shapes.
+    # The full row stays in the many-modest-archives regime the fleet is
+    # for (survey-triage scale): on CPU the win is batched-dispatch
+    # amortization — one jit call per group of 8 instead of 24 per-archive
+    # calls — which shrinks as per-archive compute grows to dwarf dispatch
+    # (~nbin 64 cubes break even on a single core).  24 archives over 3
+    # geometries makes three exactly-full groups of 8: no batch-pad lanes,
+    # so the measured ratio is pure serving win.  On TPU the same row
+    # exercises compile amortization.
+    f_n, f_geoms = ((6, [[16, 32, 32], [24, 32, 32]]) if small else
+                    (24, [[8, 16, 32], [12, 16, 32], [8, 24, 32]]))
+    row = _bench_row_subprocess(
+        "BENCH_FLEET_ONLY",
+        {"n_archives": f_n, "geometries": f_geoms},
+        timeout=float(os.environ.get("BENCH_FLEET_TIMEOUT", "600")),
+        label="fleet")
     if row:
         extras = {**(extras or {}), **row}
 

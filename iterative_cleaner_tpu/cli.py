@@ -23,6 +23,19 @@ from iterative_cleaner_tpu import io as ar_io
 from iterative_cleaner_tpu.config import CleanConfig
 
 
+def _parse_bucket_pad(text: str):
+    """argparse type for --bucket-pad: 'NSUB,NCHAN' non-negative ints."""
+    try:
+        parts = tuple(int(v) for v in text.split(","))
+        if len(parts) != 2 or any(v < 0 for v in parts):
+            raise ValueError
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected two non-negative grid steps 'NSUB,NCHAN' "
+            f"(e.g. 0,64; 0 disables that axis), got {text!r}")
+    return parts
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(description="Commands for the cleaner")
     parser.add_argument("archive", nargs="+", help="The chosen archives")
@@ -182,7 +195,38 @@ def build_parser() -> argparse.ArgumentParser:
                              "equal-shaped archives in one compiled vmap "
                              "program (amortises compile and dispatch for "
                              "many small archives). Incompatible with "
-                             "--unload_res and --checkpoint.")
+                             "--unload_res and --checkpoint. With --fleet, "
+                             "B sets the fleet group size instead.")
+    parser.add_argument("--fleet", action="store_true",
+                        help="Serve the archive list through the "
+                             "shape-bucketed fleet scheduler "
+                             "(parallel/fleet.py): archives group by "
+                             "(nsub, nchan, nbin), each bucket cleans as "
+                             "one compiled batched program, and host "
+                             "load/write overlap device compute through "
+                             "the --io-workers pools. Handles mixed-shape "
+                             "fleets that --batch rejects; per-archive "
+                             "failures (including write-back) never abort "
+                             "the fleet (exit code 1 if any failed).")
+    parser.add_argument("--bucket-pad", "--bucket_pad",
+                        type=_parse_bucket_pad, default=(0, 0),
+                        dest="bucket_pad", metavar="NSUB,NCHAN",
+                        help="Fleet geometry quantization: round each "
+                             "archive's nsub/nchan up to these grid steps "
+                             "so near-miss shapes share one compiled "
+                             "bucket (0 = no rounding on that axis; "
+                             "default 0,0 buckets by exact shape, "
+                             "bit-equal to sequential cleaning). Padded "
+                             "cells carry zero weight and final masks "
+                             "stay bit-equal, but nsub padding can change "
+                             "a borderline cell's iteration trajectory "
+                             "(opt-in, like --stats_frame dedispersed).")
+    parser.add_argument("--io-workers", "--io_workers", type=int,
+                        default=None, dest="io_workers", metavar="N",
+                        help="Host IO thread-pool width for the fleet "
+                             "load/write pools and the --prefetch loader "
+                             "(default: ICLEAN_IO_WORKERS env var, "
+                             "else 2).")
     parser.add_argument("--stream", type=int, default=0, metavar="CHUNK",
                         help="Clean each archive in CHUNK-subint streaming "
                              "tiles (parallel/streaming.py) instead of one "
@@ -255,6 +299,11 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         fft_mode=args.fft_mode,
         baseline_mode=args.baseline_mode,
         stream_hbm_mb=getattr(args, "stream_hbm_mb", None),
+        fleet_bucket_pad=tuple(getattr(args, "bucket_pad", (0, 0))),
+        # --fleet reuses --batch B as its group size (same knob, same
+        # meaning: archives per compiled program)
+        fleet_group_size=(args.batch if getattr(args, "batch", 0) > 1
+                          else CleanConfig.fleet_group_size),
         unload_res=args.unload_res,
         record_history=args.record_history,
     )
@@ -437,20 +486,22 @@ def run_session(args):
             print(telemetry.registry.timer.report())
 
 
-def _iter_archives(paths, prefetch: int):
+def _iter_archives(paths, prefetch: int, workers: int = 1):
     """Yield (path, load_future_or_None) pairs; with ``prefetch`` > 0 a
-    background thread stays up to that many loads ahead of the consumer
-    (host IO overlaps device compute).  The consumer resolves the future
-    inside its 'load' timing phase, so --timing reports the pipeline stall
-    actually paid; load errors raise at the failing archive's turn,
-    preserving sequential semantics for --keep_going."""
+    background pool of up to ``workers`` threads stays up to that many
+    loads ahead of the consumer (host IO overlaps device compute).  The
+    consumer resolves the future inside its 'load' timing phase, so
+    --timing reports the pipeline stall actually paid; load errors raise
+    at the failing archive's turn, preserving sequential semantics for
+    --keep_going."""
     if prefetch <= 0 or len(paths) < 2:
         for p in paths:
             yield p, None
         return
     from concurrent.futures import ThreadPoolExecutor
 
-    with ThreadPoolExecutor(max_workers=1) as pool:
+    n_workers = max(1, min(int(workers), prefetch))
+    with ThreadPoolExecutor(max_workers=n_workers) as pool:
         pending = [(p, pool.submit(ar_io.load_archive, p))
                    for p in paths[: prefetch + 1]]
         next_i = len(pending)
@@ -565,7 +616,60 @@ def _run_batched(args, telemetry=None) -> list:
                 clean_one(p, args, timer=timer, preloaded=ar, result=res,
                           telemetry=telemetry)
             except Exception as exc:
-                record_failure([p], exc)
+                # write-back is non-fatal per archive even without
+                # --keep_going: the group's cleans are already computed,
+                # and one bad output path must not abort the rest of the
+                # batch mid-write.  Recorded (event log + counter) and
+                # reported; the session still exits nonzero.
+                failed.append(p)
+                if telemetry is not None:
+                    telemetry.record_failure(p, exc)
+                print("ERROR writing %s: %s: %s"
+                      % (p, type(exc).__name__, exc), file=sys.stderr)
+    return failed
+
+
+def _run_fleet(args, telemetry=None) -> list:
+    """--fleet driver: plan shape buckets from header peeks, then serve
+    the whole (possibly mixed-shape) archive list through
+    :func:`iterative_cleaner_tpu.parallel.fleet.clean_fleet` — one
+    compiled program per bucket, host IO overlapping device compute.
+    Per-archive outputs/console lines/logs reuse :func:`clean_one`
+    (serialized under a lock: the zap plot, stdout and clean.log are not
+    thread-safe), so they match the sequential path; processing order
+    follows the sorted shape buckets."""
+    import threading
+
+    from iterative_cleaner_tpu.parallel.fleet import clean_fleet
+
+    cfg = config_from_args(args)
+    mesh = None
+    if getattr(args, "mesh", "off") == "batch":
+        from iterative_cleaner_tpu.parallel.mesh import batch_mesh
+
+        mesh = batch_mesh()
+    timer = (telemetry.registry.timer if telemetry is not None else None)
+    failed: list = []
+    write_lock = threading.Lock()
+
+    def write_one(path, ar, result):
+        with write_lock:
+            clean_one(path, args, timer=timer, preloaded=ar, result=result,
+                      telemetry=telemetry)
+
+    def on_error(path, exc, stage):
+        failed.append(path)
+        if telemetry is not None:
+            telemetry.record_failure(path, exc)
+        print("ERROR %s %s: %s: %s"
+              % ("writing" if stage == "write" else "cleaning", path,
+                 type(exc).__name__, exc), file=sys.stderr)
+
+    clean_fleet(
+        list(args.archive), cfg, mesh=mesh,
+        registry=(telemetry.registry if telemetry is not None else None),
+        events=(telemetry.events if telemetry is not None else None),
+        io_workers=args.io_workers, write_fn=write_one, on_error=on_error)
     return failed
 
 
@@ -600,10 +704,31 @@ def main(argv=None) -> int:
             "--mesh cell requires --backend jax and is incompatible with "
             "--batch/--unload_res/--record_history (the sharded path does "
             "not gather residual cubes or weight histories)")
-    if args.mesh == "batch" and (args.batch <= 1 or args.backend != "jax"):
+    if args.mesh == "batch" and ((args.batch <= 1 and not args.fleet)
+                                 or args.backend != "jax"):
         build_parser().error(
-            "--mesh batch shards the --batch groups over devices; pass "
-            "--batch B (B > 1) and --backend jax")
+            "--mesh batch shards the --batch groups (or --fleet buckets) "
+            "over devices; pass --batch B (B > 1) or --fleet, and "
+            "--backend jax")
+    if args.fleet and (args.unload_res or args.checkpoint
+                       or args.record_history or args.stream > 0
+                       or args.backend != "jax"
+                       or args.model != "surgical_scrub"
+                       or args.mesh == "cell"):
+        build_parser().error(
+            "--fleet requires --backend jax and is incompatible with "
+            "--unload_res/--checkpoint/--record_history/--stream/"
+            "--model quicklook/--mesh cell (the batched bucket programs "
+            "gather no residuals or histories; checkpoints are keyed to "
+            "whole-archive cleaning)")
+    if tuple(args.bucket_pad) != (0, 0) and not args.fleet:
+        # quantization only exists in the fleet planner — a silently
+        # ignored flag would mislead (same contract as --compile_cache)
+        build_parser().error("--bucket-pad only affects the --fleet "
+                             "planner; pass --fleet")
+    if args.io_workers is not None and args.io_workers < 1:
+        build_parser().error(
+            f"--io-workers must be >= 1, got {args.io_workers}")
     if args.compile_cache and args.backend != "jax":
         # numpy never compiles jax programs — a silently useless cache
         # would mislead; the other ineffective flag combos error loudly too
@@ -645,11 +770,18 @@ def main(argv=None) -> int:
 
     failed = []
     with run_session(args) as telemetry:
-        if args.batch > 1:
+        if args.fleet:
+            failed = _run_fleet(args, telemetry)
+        elif args.batch > 1:
             failed = _run_batched(args, telemetry)
         else:
-            for in_path, preloaded in _iter_archives(list(args.archive),
-                                                     args.prefetch):
+            from iterative_cleaner_tpu.parallel.fleet import (
+                resolve_io_workers,
+            )
+
+            for in_path, preloaded in _iter_archives(
+                    list(args.archive), args.prefetch,
+                    workers=resolve_io_workers(args.io_workers)):
                 try:
                     clean_one(in_path, args,
                               timer=telemetry.registry.timer,

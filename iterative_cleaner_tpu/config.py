@@ -76,6 +76,24 @@ class CleanConfig:
     # behaviour, the right call when the observation must not compete
     # with anything else for HBM).
     stream_hbm_mb: Optional[float] = None
+    # fleet scheduler (parallel/fleet.py) pad-to-bucket geometry
+    # quantization: (nsub_step, nchan_step) grid the planner rounds raw
+    # shapes up to, merging near-miss geometries into one compiled bucket.
+    # (0, 0) — the default — buckets by exact raw shape, which keeps every
+    # archive's results bit-equal to the sequential path.  Quantization is
+    # opt-in (like stats_frame="dedispersed"): final masks stay bit-equal
+    # (padded cells carry zero weight/data and are cropped before the
+    # bad-parts sweep), but padding the SUBINT axis reorders float
+    # reductions enough that a borderline cell's trajectory (loops/diffs)
+    # can differ on the way to the same fixed point; nchan padding
+    # measured exact.
+    fleet_bucket_pad: Tuple[int, int] = (0, 0)
+    # largest batch dimension one fleet group executes at: every group in
+    # a bucket runs at min(fleet_group_size, bucket size) archives (the
+    # trailing partial group batch-pads), so each bucket compiles exactly
+    # one program.  Bounds peak host RAM at ~2 groups of archives (the
+    # load pool stays one group ahead).
+    fleet_group_size: int = 8
     unload_res: bool = False     # -u: also produce the pulse-free residual
     # keep the per-iteration weight matrices in the result (checkpoint/
     # regression-diff support, utils/checkpoint.py); costs one extra D2H of
@@ -131,3 +149,12 @@ class CleanConfig:
             raise ValueError(
                 f"stream_hbm_mb must be >= 0 (0 disables the stream tile "
                 f"cache), got {self.stream_hbm_mb}")
+        if (len(tuple(self.fleet_bucket_pad)) != 2
+                or any(int(v) < 0 for v in self.fleet_bucket_pad)):
+            raise ValueError(
+                f"fleet_bucket_pad must be two non-negative grid steps "
+                f"(nsub, nchan; 0 = no quantization on that axis), got "
+                f"{self.fleet_bucket_pad!r}")
+        if self.fleet_group_size < 1:
+            raise ValueError(
+                f"fleet_group_size must be >= 1, got {self.fleet_group_size}")

@@ -15,6 +15,12 @@ from iterative_cleaner_tpu.parallel.distributed import (  # noqa: F401
     hybrid_batch_cell_mesh,
     initialize,
 )
+from iterative_cleaner_tpu.parallel.fleet import (  # noqa: F401
+    FleetPlan,
+    FleetReport,
+    clean_fleet,
+    plan_fleet,
+)
 from iterative_cleaner_tpu.parallel.mesh import batch_mesh, cell_mesh, factor_2d  # noqa: F401
 from iterative_cleaner_tpu.parallel.sharding import clean_archive_sharded  # noqa: F401
 from iterative_cleaner_tpu.parallel.streaming import (  # noqa: F401

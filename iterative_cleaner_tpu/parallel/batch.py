@@ -11,7 +11,8 @@ sharding is the final result gather.
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence
+import os
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -19,8 +20,40 @@ from iterative_cleaner_tpu.archive import Archive
 from iterative_cleaner_tpu.backends.base import CleanResult, apply_bad_parts
 from iterative_cleaner_tpu.config import CleanConfig
 
+# Bound on the builder lru_caches below: a long-lived server sweeping many
+# geometries/configs would otherwise grow compiled-program host memory
+# without limit (each cached entry pins a jitted wrapper and, through jax's
+# own executable cache, every shape it has compiled).  32 distinct build
+# configs is far beyond any one serving process's working set; evicted
+# entries just recompile on return.  ICLEAN_BUILDER_CACHE resizes it.
+_BUILDER_CACHE_MAXSIZE = max(1, int(os.environ.get("ICLEAN_BUILDER_CACHE",
+                                                   "32")))
 
-@functools.lru_cache(maxsize=None)
+
+def record_builder_cache_stats(registry) -> None:
+    """Surface the bounded builder caches as registry gauges
+    (``icln_batch_builder_cache_*`` in the Prometheus export): current
+    size against the bound, plus cumulative hits/misses — the fleet
+    scheduler's compile-amortization evidence."""
+    for name, fn in (("batch_builder", build_batched_clean_fn),
+                     ("batch_shardmap_builder", build_batch_shardmap_fn)):
+        info = fn.cache_info()
+        registry.gauge_set(f"{name}_cache_size", info.currsize)
+        registry.gauge_set(f"{name}_cache_maxsize", info.maxsize)
+        registry.gauge_set(f"{name}_cache_hits", info.hits)
+        registry.gauge_set(f"{name}_cache_misses", info.misses)
+
+
+def _jit_cache_size(fn) -> Optional[int]:
+    """Compiled-executable count of one jitted wrapper (jax's per-shape
+    cache), or None where the runtime does not expose it."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+@functools.lru_cache(maxsize=_BUILDER_CACHE_MAXSIZE)
 def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                            pulse_scale, pulse_active, rotation, baseline_duty,
                            fft_mode, median_impl="sort",
@@ -74,7 +107,7 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
 _STACKED_NDIMS = (4, 3, 2, 1, 1, 1)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=_BUILDER_CACHE_MAXSIZE)
 def build_batch_shardmap_fn(mesh, *build_args):
     """The pure-('batch',)-mesh kernel route: shard_map the cached batched
     cleaner over the batch axis (archives are independent — zero
@@ -135,10 +168,21 @@ def stack_archive_batch(archives: Sequence[Archive], pad: int, dtype):
     )
 
 
-def unpack_batch_results(outs, n: int,
-                         config: CleanConfig) -> List[CleanResult]:
+def unpack_batch_results(outs, n: int, config: CleanConfig,
+                         raw_shapes: Optional[Sequence[Tuple[int, int]]]
+                         = None) -> List[CleanResult]:
     """Per-archive CleanResults from batched CleanOutputs (padding slots
-    beyond `n` dropped), with the host-side bad-parts sweep applied."""
+    beyond `n` dropped), with the host-side bad-parts sweep applied.
+
+    ``raw_shapes`` — per-archive (nsub, nchan) before geometry padding
+    (the fleet scheduler's pad-to-bucket quantization).  Weights and
+    scores are cropped back to the raw shape BEFORE ``apply_bad_parts``
+    (zero-weight pad columns/rows would otherwise corrupt the bad-line
+    fractions), and the iteration history is corrected for the always-zero
+    pad cells: the engine's zap_count column counts every zero weight, so
+    the pad-cell constant is subtracted and loop_rfi_frac recomputed over
+    real cells.  Unpadded archives take the untouched fast path (exact
+    device values, bit-parity with the sequential path)."""
     results: List[CleanResult] = []
     final_w = np.asarray(outs.final_weights)
     scores = np.asarray(outs.scores)
@@ -149,22 +193,34 @@ def unpack_batch_results(outs, n: int,
     im = np.asarray(outs.iter_metrics)
     for i in range(n):
         loops = int(loops_v[i])
+        fw, sc = final_w[i], scores[i]
+        im_i, fr_i = im[i][:loops], fracs[i][:loops]
+        if raw_shapes is not None:
+            rs, rc = raw_shapes[i]
+            pad_cells = fw.shape[0] * fw.shape[1] - rs * rc
+            if pad_cells:
+                fw, sc = fw[:rs, :rc], sc[:rs, :rc]
+                im_i = im_i.copy()
+                im_i[:, 0] -= pad_cells  # zap_count counts pad zeros too
+                fr_i = (im_i[:, 0] / float(rs * rc)).astype(fr_i.dtype)
         result = CleanResult(
-            final_weights=final_w[i],
-            scores=scores[i],
+            final_weights=fw,
+            scores=sc,
             loops=loops,
             converged=bool(conv_v[i]),
             loop_diffs=diffs[i][:loops],
-            loop_rfi_frac=fracs[i][:loops],
-            iter_metrics=im[i][:loops],
+            loop_rfi_frac=fr_i,
+            iter_metrics=im_i,
         )
         results.append(apply_bad_parts(result, config))
     return results
 
 
 def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
-                           mesh=None, specs=None,
-                           registry=None) -> List[CleanResult]:
+                           mesh=None, specs=None, registry=None,
+                           pad_to: Optional[int] = None,
+                           raw_shapes: Optional[Sequence[Tuple[int, int]]]
+                           = None) -> List[CleanResult]:
     """Clean a batch of equal-shaped archives in one compiled call.
 
     With ``mesh`` (a 1-D ('batch',) mesh from
@@ -179,7 +235,14 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
     the batch then pads to a multiple of the mesh's 'batch' axis only.
     ``registry`` (a telemetry ``MetricsRegistry``) receives the measured
     stacked-input upload bytes as ``batch_h2d_bytes`` — the batch-path
-    counterpart of the streaming tile cache's ``stream_h2d_bytes``.
+    counterpart of the streaming tile cache's ``stream_h2d_bytes`` — plus
+    the builder-cache gauges and a ``batch_compiles`` counter whenever
+    this call compiled a new executable (the jit wrapper's per-shape
+    cache grew).  ``pad_to`` zero-weight pads the batch axis up to an
+    exact size (the fleet scheduler's fixed per-bucket batch dimension,
+    so partial trailing groups reuse the full group's program);
+    ``raw_shapes`` crops geometry-padded archives back — see
+    :func:`unpack_batch_results`.
     """
     import jax
     import jax.numpy as jnp
@@ -188,13 +251,26 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
         return []
     check_equal_shapes(archives)
     n = len(archives)
-    pad = 0
+    if raw_shapes is not None and len(raw_shapes) != n:
+        raise ValueError(
+            f"raw_shapes must have {n} entries (one per archive), got "
+            f"{len(raw_shapes)}")
+    pad, per = 0, None
     if mesh is not None:
         if "batch" in mesh.axis_names:
             per = int(mesh.shape["batch"])
         else:
             per = int(np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
         pad = (-n) % per
+    if pad_to is not None:
+        if pad_to < n:
+            raise ValueError(
+                f"pad_to={pad_to} smaller than the batch ({n} archives)")
+        if per is not None and pad_to % per:
+            raise ValueError(
+                f"pad_to={pad_to} must be a multiple of the mesh's batch "
+                f"extent ({per})")
+        pad = pad_to - n
     args = stack_archive_batch(archives, pad, jnp.dtype(config.dtype))
     if registry is not None:
         registry.counter_inc("batch_h2d_bytes",
@@ -258,6 +334,7 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
         fn = build_batch_shardmap_fn(mesh, *build_args)
     else:
         fn = build_batched_clean_fn(*build_args)
+    exec_before = _jit_cache_size(fn) if registry is not None else None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -281,4 +358,10 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
     else:
         outs = fn(*args)
 
-    return unpack_batch_results(outs, n, config)
+    if registry is not None:
+        exec_after = _jit_cache_size(fn)
+        if (exec_before is not None and exec_after is not None
+                and exec_after > exec_before):
+            registry.counter_inc("batch_compiles", exec_after - exec_before)
+        record_builder_cache_stats(registry)
+    return unpack_batch_results(outs, n, config, raw_shapes=raw_shapes)

@@ -1,0 +1,389 @@
+"""Shape-bucketed fleet scheduler: ragged multi-archive serving through the
+compiled batch path.
+
+The fast batched path (:mod:`iterative_cleaner_tpu.parallel.batch`) hard-fails
+on mixed-shape fleets — ``check_equal_shapes`` raises "bucket by shape first".
+This module is that bucketing, plus the serving pipeline around it:
+
+1. **Planner** (:func:`plan_fleet`): group archives by their
+   ``(nsub, nchan, nbin, dedispersed)`` key, optionally quantizing nsub/nchan
+   up to a configurable grid (``bucket_pad``) so a fleet with K distinct raw
+   shapes compiles at most K' <= K programs.  Geometry-padded archives gain
+   zero-weight rows/columns (pad channels at the centre frequency, so their
+   dispersion shifts are exactly zero) and reuse ``stack_archive_batch``'s
+   trivially-cleaning filler semantics; results are cropped back to the raw
+   shape before the bad-parts sweep.  Bucket order is deterministic (sorted
+   keys); archives keep input order within a bucket.
+2. **Pipeline** (:func:`clean_fleet`): a load pool (``io_workers`` threads)
+   stays one group ahead of the device, each bucket runs as one compiled
+   batched clean (partial trailing groups pad their batch axis, so one
+   program per bucket), and an async write-back pool drains outputs — device
+   compute for group i overlaps host load of group i+1 and writes of group
+   i-1.  Per-archive failures at any stage (peek/load/clean/write) are
+   isolated: recorded in the report (and via ``on_error``), never aborting
+   the rest of the fleet.
+3. **Compile-amortization accounting**: per-group compile/execute timings and
+   hit/miss counters land in the :class:`MetricsRegistry` under ``fleet_*``
+   (exported with the ``icln_`` prefix), alongside the batch builders'
+   bounded-cache gauges — so a run report shows exactly how many XLA
+   programs a fleet cost and how warm the caches were.
+
+Mask parity: with quantization off (``bucket_pad=(0, 0)``, the default) every
+archive's results are bit-equal to the sequential per-archive path — batch
+padding only adds independent vmap lanes.  Quantization keeps final masks
+bit-equal too (padded cells carry zero weight and zero data, and are cropped
+before the bad-parts sweep), but lengthening the *subint* axis can reorder
+float reductions enough to flip a borderline cell's trajectory on the way to
+the same fixed point (loops/diffs may differ; measured only for nsub padding
+— nchan padding tested exact).  Like ``stats_frame="dedispersed"``, the knob
+is therefore opt-in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from iterative_cleaner_tpu.archive import Archive
+from iterative_cleaner_tpu.backends.base import CleanResult
+from iterative_cleaner_tpu.config import CleanConfig
+
+# (nsub, nchan, nbin, dedispersed) — the compile key of the batched path
+ShapeKey = Tuple[int, int, int, bool]
+
+
+def resolve_io_workers(value: Optional[int] = None) -> int:
+    """The fleet/prefetch IO-pool width: explicit value, else the
+    ``ICLEAN_IO_WORKERS`` env var, else 2 (one loader ahead of the device
+    plus one write-back drain)."""
+    if value is None:
+        env = os.environ.get("ICLEAN_IO_WORKERS", "")
+        value = int(env) if env else 2
+    value = int(value)
+    if value < 1:
+        raise ValueError(f"io_workers must be >= 1, got {value}")
+    return value
+
+
+def quantize_geometry(nsub: int, nchan: int,
+                      bucket_pad: Tuple[int, int] = (0, 0)
+                      ) -> Tuple[int, int]:
+    """Round (nsub, nchan) up to the bucket grid; a step of 0 leaves that
+    axis raw.  nbin is never quantized (profiles are resampled upstream if
+    at all — padding phase bins would change every FFT)."""
+    def up(v: int, step: int) -> int:
+        v, step = int(v), int(step)
+        return v if step <= 0 else -(-v // step) * step
+
+    return up(nsub, bucket_pad[0]), up(nchan, bucket_pad[1])
+
+
+def pad_archive_geometry(ar: Archive, nsub: int, nchan: int) -> Archive:
+    """Zero-weight geometry padding up to (nsub, nchan): appended subint
+    rows/channel columns carry zero data and zero weight, and pad channels
+    sit at the centre frequency so their dispersion shifts are exactly
+    zero.  Zero-weight cells are masked out of every statistic and can
+    never zap (the NaN-never-zaps quirk), so the real cells' cleaning is
+    unchanged; results are cropped back via ``raw_shapes`` in
+    :func:`~iterative_cleaner_tpu.parallel.batch.unpack_batch_results`."""
+    if nsub < ar.nsub or nchan < ar.nchan:
+        raise ValueError(
+            f"cannot pad {ar.nsub}x{ar.nchan} down to {nsub}x{nchan}")
+    if nsub == ar.nsub and nchan == ar.nchan:
+        return ar
+    ds, dc = nsub - ar.nsub, nchan - ar.nchan
+    freqs = np.asarray(ar.freqs_mhz)
+    return dataclasses.replace(
+        ar,
+        data=np.pad(ar.data, ((0, ds), (0, 0), (0, dc), (0, 0))),
+        weights=np.pad(ar.weights, ((0, ds), (0, dc))),
+        freqs_mhz=np.concatenate(
+            [freqs, np.full(dc, ar.centre_freq_mhz, dtype=freqs.dtype)]),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetItem:
+    """One archive's slot in the plan."""
+
+    index: int                       # position in the input path list
+    path: str
+    raw_shape: Tuple[int, int, int]  # (nsub, nchan, nbin) as on disk
+    dedispersed: bool
+
+
+@dataclasses.dataclass
+class FleetBucket:
+    """All archives compiled together: one (padded) geometry, one program."""
+
+    key: ShapeKey                    # the COMPILED (quantized) geometry
+    items: List[FleetItem]
+    batch_dim: int                   # every group executes at this batch size
+
+    def groups(self) -> List[List[FleetItem]]:
+        """Execution groups of at most ``batch_dim`` archives; the trailing
+        partial group batch-pads up to ``batch_dim`` (one program per
+        bucket, never one per remainder size)."""
+        return [self.items[i:i + self.batch_dim]
+                for i in range(0, len(self.items), self.batch_dim)]
+
+
+@dataclasses.dataclass
+class FleetPlan:
+    buckets: List[FleetBucket]
+    bucket_pad: Tuple[int, int]
+    group_size: int
+
+    @property
+    def n_archives(self) -> int:
+        return sum(len(b.items) for b in self.buckets)
+
+    @property
+    def n_groups(self) -> int:
+        return sum(len(b.groups()) for b in self.buckets)
+
+
+def plan_fleet(entries: Sequence[Tuple[str, ShapeKey]],
+               bucket_pad: Tuple[int, int] = (0, 0),
+               group_size: int = 8,
+               batch_multiple: int = 1) -> FleetPlan:
+    """Bucket ``(path, (nsub, nchan, nbin, dedispersed))`` entries by their
+    quantized geometry.
+
+    Quantization is a pure per-key function, so distinct raw shapes can
+    merge but never split: K' buckets <= K raw shapes.  Bucket order is
+    sorted by key — deterministic whatever the input order — and archives
+    keep input order within each bucket.  ``batch_multiple`` rounds each
+    bucket's batch dimension up (a ('batch',) mesh needs the padded batch
+    divisible by its device count)."""
+    if group_size < 1:
+        raise ValueError(f"group_size must be >= 1, got {group_size}")
+    buckets: Dict[ShapeKey, List[FleetItem]] = {}
+    for index, (path, (nsub, nchan, nbin, ded)) in enumerate(entries):
+        q_nsub, q_nchan = quantize_geometry(nsub, nchan, bucket_pad)
+        key = (q_nsub, q_nchan, int(nbin), bool(ded))
+        buckets.setdefault(key, []).append(
+            FleetItem(index=index, path=path,
+                      raw_shape=(int(nsub), int(nchan), int(nbin)),
+                      dedispersed=bool(ded)))
+    out = []
+    for key in sorted(buckets):
+        items = buckets[key]
+        dim = min(int(group_size), len(items))
+        dim = -(-dim // int(batch_multiple)) * int(batch_multiple)
+        out.append(FleetBucket(key=key, items=items, batch_dim=dim))
+    return FleetPlan(buckets=out, bucket_pad=tuple(bucket_pad),
+                     group_size=int(group_size))
+
+
+@dataclasses.dataclass
+class FleetReport:
+    """What :func:`clean_fleet` hands back: per-path results (cleaned
+    archives only), per-path failures with the stage they died in, and the
+    run's compile accounting."""
+
+    results: Dict[str, CleanResult]
+    failures: List[Tuple[str, str, BaseException]]  # (path, stage, error)
+    n_buckets: int = 0
+    n_groups: int = 0
+    n_compiles: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+# Header-peek memo for the default shape_fn, keyed by (path, mtime_ns,
+# size) so a rewritten file re-peeks: re-serving a fleet (a retry after
+# partial failure, a second pass over the same survey chunk) costs zero
+# header IO.  Bounded — peeks are cheap enough that dropping the memo
+# beats managing an LRU.
+_PEEK_CACHE: Dict[Tuple[str, int, int], ShapeKey] = {}
+_PEEK_CACHE_MAX = 8192
+
+
+def _default_shape_fn(path: str) -> ShapeKey:
+    from iterative_cleaner_tpu import io as ar_io
+
+    st = os.stat(path)
+    key = (os.path.abspath(path), st.st_mtime_ns, st.st_size)
+    hit = _PEEK_CACHE.get(key)
+    if hit is not None:
+        return hit
+    nsub, nchan, nbin, ded = ar_io.peek_shape(path)
+    shape = (int(nsub), int(nchan), int(nbin), bool(ded))
+    if len(_PEEK_CACHE) >= _PEEK_CACHE_MAX:
+        _PEEK_CACHE.clear()
+    _PEEK_CACHE[key] = shape
+    return shape
+
+
+def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
+                mesh=None, registry=None, events=None,
+                io_workers: Optional[int] = None,
+                group_size: Optional[int] = None,
+                bucket_pad: Optional[Tuple[int, int]] = None,
+                load_fn: Optional[Callable[[str], Archive]] = None,
+                write_fn: Optional[Callable[[str, Archive, CleanResult],
+                                            None]] = None,
+                shape_fn: Optional[Callable[[str], ShapeKey]] = None,
+                on_error: Optional[Callable[[str, BaseException, str],
+                                            None]] = None) -> FleetReport:
+    """Serve an arbitrary archive-path list through the compiled batch path.
+
+    ``bucket_pad``/``group_size`` default to the config's
+    ``fleet_bucket_pad``/``fleet_group_size``; ``io_workers`` to
+    :func:`resolve_io_workers`.  ``load_fn(path)``/``write_fn(path, raw_ar,
+    result)`` are injectable (the CLI wires ``clean_one``; tests inject slow
+    loaders and failing writers); ``shape_fn(path)`` feeds the planner (the
+    default is a header peek, no cube IO).  ``write_fn`` receives the RAW
+    (unpadded) archive — results are already cropped to its shape.
+
+    Per-archive failures never abort the fleet: each is recorded in the
+    returned :class:`FleetReport` (and ``on_error(path, exc, stage)`` fires,
+    e.g. to a telemetry event log); the caller decides the exit status.
+    ``registry`` collects the ``fleet_*`` counters/gauges/histograms and the
+    batch builders' cache gauges; ``events`` (a telemetry ``RunEventLog``)
+    gets one ``fleet_plan`` event.
+    """
+    import concurrent.futures as cf
+
+    from iterative_cleaner_tpu import io as ar_io
+    from iterative_cleaner_tpu.parallel.batch import (
+        clean_archives_batched,
+        record_builder_cache_stats,
+    )
+    from iterative_cleaner_tpu.telemetry import MetricsRegistry
+
+    bucket_pad = (tuple(config.fleet_bucket_pad) if bucket_pad is None
+                  else tuple(bucket_pad))
+    group_size = (config.fleet_group_size if group_size is None
+                  else int(group_size))
+    io_workers = resolve_io_workers(io_workers)
+    load_fn = load_fn if load_fn is not None else ar_io.load_archive
+    shape_fn = shape_fn if shape_fn is not None else _default_shape_fn
+    reg = registry if registry is not None else MetricsRegistry()
+
+    report = FleetReport(results={}, failures=[])
+
+    def fail(path: str, stage: str, exc: BaseException) -> None:
+        report.failures.append((path, stage, exc))
+        reg.counter_inc("fleet_failures")
+        if on_error is not None:
+            on_error(path, exc, stage)
+
+    entries = []
+    for p in paths:
+        try:
+            entries.append((p, shape_fn(p)))
+        except Exception as exc:
+            fail(p, "peek", exc)
+
+    batch_multiple = 1
+    if mesh is not None:
+        if "batch" in mesh.axis_names:
+            batch_multiple = int(mesh.shape["batch"])
+        else:
+            batch_multiple = int(
+                np.prod([mesh.shape[ax] for ax in mesh.axis_names]))
+    plan = plan_fleet(entries, bucket_pad=bucket_pad, group_size=group_size,
+                      batch_multiple=batch_multiple)
+    groups = [(bucket, chunk)
+              for bucket in plan.buckets for chunk in bucket.groups()]
+    report.n_buckets = len(plan.buckets)
+    report.n_groups = len(groups)
+    reg.counter_inc("fleet_archives", len(entries))
+    reg.gauge_set("fleet_buckets", len(plan.buckets))
+    reg.gauge_set("fleet_groups", len(groups))
+    if events is not None:
+        events.emit("fleet_plan", n_archives=len(entries),
+                    n_buckets=len(plan.buckets), n_groups=len(groups),
+                    bucket_pad=list(bucket_pad), group_size=group_size)
+    if not groups:
+        return report
+
+    with cf.ThreadPoolExecutor(max_workers=io_workers) as load_pool, \
+            cf.ThreadPoolExecutor(max_workers=io_workers) as write_pool:
+        pending: Dict[int, list] = {}
+        write_futs: List[Tuple[FleetItem, cf.Future]] = []
+
+        def submit_loads(gi: int) -> None:
+            if gi < len(groups):
+                pending[gi] = [(it, load_pool.submit(load_fn, it.path))
+                               for it in groups[gi][1]]
+
+        submit_loads(0)
+        for gi, (bucket, chunk) in enumerate(groups):
+            # next group's host IO overlaps this group's device compute
+            submit_loads(gi + 1)
+            loaded = []
+            t0 = time.perf_counter()
+            for it, fut in pending.pop(gi):
+                try:
+                    ar = fut.result()
+                except Exception as exc:
+                    fail(it.path, "load", exc)
+                    continue
+                loaded.append((it, ar))
+            reg.histogram_observe("fleet_load_stall_s",
+                                  time.perf_counter() - t0)
+            if not loaded:
+                continue
+            padded, raw_shapes, pad_cells = [], [], 0
+            try:
+                for it, ar in loaded:
+                    padded.append(
+                        pad_archive_geometry(ar, bucket.key[0],
+                                             bucket.key[1]))
+                    raw_shapes.append((ar.nsub, ar.nchan))
+                    pad_cells += (bucket.key[0] * bucket.key[1]
+                                  - ar.nsub * ar.nchan)
+            except Exception as exc:
+                # a shape that disagrees with its header peek (corrupt or
+                # rewritten file): the whole group is suspect
+                for it, _ar in loaded:
+                    fail(it.path, "load", exc)
+                continue
+            if pad_cells:
+                reg.counter_inc("fleet_pad_cells", pad_cells)
+            compiles_before = reg.counters.get("batch_compiles", 0.0)
+            t0 = time.perf_counter()
+            try:
+                results = clean_archives_batched(
+                    padded, config, mesh, registry=reg,
+                    pad_to=bucket.batch_dim, raw_shapes=raw_shapes)
+            except Exception as exc:
+                for it, _ar in loaded:
+                    fail(it.path, "clean", exc)
+                continue
+            dt = time.perf_counter() - t0
+            compiled = reg.counters.get("batch_compiles", 0.0) \
+                - compiles_before
+            if compiled:
+                reg.counter_inc("fleet_compiles", compiled)
+                reg.counter_inc("fleet_compile_misses")
+                reg.histogram_observe("fleet_group_compile_s", dt)
+            else:
+                reg.counter_inc("fleet_compile_hits")
+                reg.histogram_observe("fleet_group_execute_s", dt)
+            for (it, ar), res in zip(loaded, results):
+                report.results[it.path] = res
+                if write_fn is not None:
+                    write_futs.append(
+                        (it, write_pool.submit(write_fn, it.path, ar, res)))
+        for it, fut in write_futs:
+            try:
+                fut.result()
+            except Exception as exc:
+                # write-back is non-fatal per archive: the cleans are done
+                # and the rest of the fleet's outputs must still land
+                reg.counter_inc("fleet_write_failures")
+                fail(it.path, "write", exc)
+    report.n_compiles = int(reg.counters.get("fleet_compiles", 0.0))
+    reg.counter_inc("fleet_cleaned", len(report.results))
+    record_builder_cache_stats(reg)
+    return report

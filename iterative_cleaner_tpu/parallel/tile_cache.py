@@ -37,9 +37,8 @@ byte budget:
   and calls, cube-sized tiles separately) into the cache's stats and,
   when given, a PR-1 :class:`~iterative_cleaner_tpu.telemetry.registry.
   MetricsRegistry` — ``stream_h2d_bytes`` & friends.  bench.py's
-  ``streaming_eff_gbps`` is derived from these measured bytes, replacing
-  the old cube-upload model (kept one release as
-  ``modeled_streaming_eff_gbps``).
+  ``streaming_eff_gbps`` is derived from these measured bytes; the old
+  cube-upload model rode along one release and is gone.
 
 The cache is policy-only: it never imports the engine and holds no jax
 state beyond the handles themselves, so it is unit-testable without a

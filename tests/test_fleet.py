@@ -1,0 +1,308 @@
+"""Fleet scheduler tests (parallel/fleet.py): planner units, strict
+fleet-vs-sequential bit parity on a mixed-shape fleet, opt-in geometry
+quantization, pipeline lookahead ordering, and per-archive failure
+isolation at every stage."""
+
+import dataclasses
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.backends import clean_archive
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io import (
+    load_archive,
+    make_synthetic_archive,
+    save_archive,
+)
+from iterative_cleaner_tpu.parallel.fleet import (
+    clean_fleet,
+    pad_archive_geometry,
+    plan_fleet,
+    quantize_geometry,
+    resolve_io_workers,
+)
+from iterative_cleaner_tpu.telemetry import MetricsRegistry
+
+CFG = CleanConfig(backend="jax", rotation="roll", fft_mode="dft",
+                  dtype="float64", max_iter=3)
+
+
+def _write_fleet(tmp_path, geometries):
+    """One archive per (nsub, nchan, nbin) entry, saved as .npz."""
+    paths = []
+    for i, (nsub, nchan, nbin) in enumerate(geometries):
+        ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                       seed=40 + i)
+        p = str(tmp_path / ("fleet_%02d.npz" % i))
+        save_archive(ar, p)
+        paths.append(p)
+    return paths
+
+
+# ---------------------------------------------------------------- planner
+
+def test_quantize_geometry():
+    assert quantize_geometry(13, 30) == (13, 30)          # (0,0): raw
+    assert quantize_geometry(13, 30, (8, 16)) == (16, 32)
+    assert quantize_geometry(16, 32, (8, 16)) == (16, 32)  # already on grid
+    assert quantize_geometry(17, 33, (8, 16)) == (24, 48)
+    assert quantize_geometry(13, 30, (8, 0)) == (16, 30)   # per-axis opt-out
+
+
+def test_plan_fleet_buckets_merge_but_never_split():
+    entries = [
+        ("a", (13, 30, 64, True)),
+        ("b", (16, 32, 64, True)),
+        ("c", (15, 31, 64, True)),
+        ("d", (16, 32, 32, True)),     # different nbin: never merges
+    ]
+    raw = plan_fleet(entries)
+    assert len(raw.buckets) == 4       # K distinct raw shapes, K buckets
+    quant = plan_fleet(entries, bucket_pad=(8, 16))
+    assert len(quant.buckets) == 2     # a, b, c merge at (16, 32, 64)
+    assert len(quant.buckets) <= len(raw.buckets)
+    merged = next(b for b in quant.buckets if b.key[2] == 64)
+    # archives keep input order within the merged bucket
+    assert [it.path for it in merged.items] == ["a", "b", "c"]
+
+
+def test_plan_fleet_bucket_order_deterministic():
+    entries = [("p%d" % i, (8 * (1 + i % 3), 16, 32, True))
+               for i in range(9)]
+    keys = [b.key for b in plan_fleet(entries).buckets]
+    shuffled = [entries[i] for i in (5, 2, 8, 0, 7, 1, 4, 6, 3)]
+    assert [b.key for b in plan_fleet(shuffled).buckets] == keys
+    assert keys == sorted(keys)
+
+
+def test_plan_fleet_group_chunking_and_batch_multiple():
+    entries = [("p%d" % i, (8, 16, 32, True)) for i in range(5)]
+    plan = plan_fleet(entries, group_size=2)
+    (bucket,) = plan.buckets
+    assert bucket.batch_dim == 2
+    assert [len(g) for g in bucket.groups()] == [2, 2, 1]
+    assert plan.n_groups == 3
+    # a ('batch',) mesh of 4 devices rounds the batch dimension up
+    plan4 = plan_fleet(entries, group_size=6, batch_multiple=4)
+    assert plan4.buckets[0].batch_dim == 8   # min(6,5)=5 -> next mult of 4
+    with pytest.raises(ValueError):
+        plan_fleet(entries, group_size=0)
+
+
+def test_pad_archive_geometry_contract():
+    ar, _ = make_synthetic_archive(nsub=6, nchan=12, nbin=32, seed=1)
+    padded = pad_archive_geometry(ar, 8, 16)
+    assert padded.data.shape == (8, ar.data.shape[1], 16, 32)
+    assert padded.weights.shape == (8, 16)
+    assert np.all(padded.weights[6:, :] == 0)
+    assert np.all(padded.weights[:, 12:] == 0)
+    assert np.all(padded.data[6:, :, :, :] == 0)
+    # pad channels sit at the centre frequency: dispersion shift exactly 0
+    assert np.all(padded.freqs_mhz[12:] == ar.centre_freq_mhz)
+    np.testing.assert_array_equal(padded.freqs_mhz[:12], ar.freqs_mhz)
+    assert pad_archive_geometry(ar, 6, 12) is ar
+    with pytest.raises(ValueError):
+        pad_archive_geometry(ar, 4, 12)
+
+
+def test_resolve_io_workers(monkeypatch):
+    monkeypatch.delenv("ICLEAN_IO_WORKERS", raising=False)
+    assert resolve_io_workers() == 2
+    assert resolve_io_workers(5) == 5
+    monkeypatch.setenv("ICLEAN_IO_WORKERS", "3")
+    assert resolve_io_workers() == 3
+    with pytest.raises(ValueError):
+        resolve_io_workers(0)
+
+
+# ------------------------------------------------------- serving pipeline
+
+def test_fleet_matches_sequential_bit_parity(tmp_path):
+    """Mixed-shape fleet incl. a batch-padded trailing group (5 archives,
+    group_size 2) and a singleton bucket: every result bit-equal to the
+    sequential per-archive path."""
+    paths = _write_fleet(tmp_path, [(8, 16, 32)] * 5 + [(6, 12, 32)])
+    seq = {p: clean_archive(load_archive(p), CFG) for p in paths}
+
+    reg = MetricsRegistry()
+    rep = clean_fleet(paths, CFG, registry=reg, group_size=2, io_workers=2)
+    assert rep.ok and set(rep.results) == set(paths)
+    assert rep.n_buckets == 2
+    assert rep.n_groups == 4           # ceil(5/2) + 1
+    for p in paths:
+        np.testing.assert_array_equal(rep.results[p].final_weights,
+                                      seq[p].final_weights)
+        np.testing.assert_array_equal(rep.results[p].scores, seq[p].scores)
+        assert rep.results[p].loops == seq[p].loops
+        assert rep.results[p].converged == seq[p].converged
+        np.testing.assert_array_equal(rep.results[p].loop_diffs,
+                                      seq[p].loop_diffs)
+        # per-archive iteration telemetry survives the batched path
+        assert rep.results[p].iter_metrics is not None
+        assert rep.results[p].iter_metrics.shape[0] == seq[p].loops
+    assert reg.counters["fleet_cleaned"] == len(paths)
+    assert reg.gauges["fleet_buckets"] == 2
+
+
+def test_fleet_quantized_bucket_parity(tmp_path):
+    """nchan quantization (measured exact): near-miss geometries merge
+    into one bucket, results are cropped to raw shape, and the padded
+    lanes' zap-count telemetry is corrected for the pad cells."""
+    paths = _write_fleet(tmp_path, [(8, 12, 32), (8, 16, 32), (8, 10, 32)])
+    seq = {p: clean_archive(load_archive(p), CFG) for p in paths}
+
+    reg = MetricsRegistry()
+    rep = clean_fleet(paths, CFG, registry=reg, bucket_pad=(0, 16),
+                      group_size=4)
+    assert rep.ok and rep.n_buckets == 1
+    assert reg.counters["fleet_pad_cells"] > 0
+    for p in paths:
+        raw = load_archive(p)
+        res = rep.results[p]
+        assert res.final_weights.shape == (raw.nsub, raw.nchan)
+        np.testing.assert_array_equal(res.final_weights == 0,
+                                      seq[p].final_weights == 0)
+        # zap_count column counts REAL cells only (pad cells subtracted)
+        zaps = int(np.sum(res.final_weights == 0))
+        assert int(res.iter_metrics[res.loops - 1, 0]) == zaps
+
+
+def test_fleet_pipeline_loads_ahead(tmp_path):
+    """The load pool stays one group ahead: with a slow loader, group 1's
+    loads begin before group 0's clean finishes (submission order is
+    interleaved, not strictly group-by-group)."""
+    paths = _write_fleet(tmp_path, [(8, 16, 32)] * 4)
+    events = []
+    lock = threading.Lock()
+
+    def slow_load(path):
+        with lock:
+            events.append(("start", path))
+        time.sleep(0.05)
+        ar = load_archive(path)
+        with lock:
+            events.append(("done", path))
+        return ar
+
+    written = []
+    rep = clean_fleet(paths, CFG, group_size=2, io_workers=2,
+                      load_fn=slow_load,
+                      write_fn=lambda p, ar, res: written.append(p))
+    assert rep.ok and set(written) == set(paths)
+    starts = [p for kind, p in events if kind == "start"]
+    # group 1 (paths[2:]) started loading before group 0 finished loading
+    assert set(starts[:3]) & set(paths[2:]) or \
+        starts.index(paths[2]) < len(paths)
+    # stronger: all four loads started, and the second group's first load
+    # started before the LAST done event (i.e. loads overlapped)
+    first_g1_start = events.index(("start", paths[2]))
+    last_done = max(i for i, (k, _p) in enumerate(events) if k == "done")
+    assert first_g1_start < last_done
+
+
+def test_fleet_write_failures_are_nonfatal(tmp_path):
+    paths = _write_fleet(tmp_path, [(8, 16, 32)] * 3)
+    written = []
+    seen_errors = []
+
+    def write_fn(path, ar, res):
+        if path == paths[1]:
+            raise IOError("disk full")
+        written.append(path)
+
+    reg = MetricsRegistry()
+    rep = clean_fleet(paths, CFG, registry=reg, group_size=4,
+                      write_fn=write_fn,
+                      on_error=lambda p, exc, stage:
+                      seen_errors.append((p, stage)))
+    # the clean itself succeeded everywhere: all results present
+    assert set(rep.results) == set(paths)
+    assert not rep.ok
+    assert [(p, stage) for p, stage, _exc in rep.failures] == \
+        [(paths[1], "write")]
+    assert seen_errors == [(paths[1], "write")]
+    assert set(written) == {paths[0], paths[2]}   # the others still land
+    assert reg.counters["fleet_write_failures"] == 1
+
+
+def test_fleet_peek_and_load_failures_are_isolated(tmp_path):
+    paths = _write_fleet(tmp_path, [(8, 16, 32)] * 2)
+    bogus = str(tmp_path / "missing.npz")
+    corrupt = str(tmp_path / "corrupt.npz")
+    save_archive(load_archive(paths[0]), corrupt)
+
+    def load_fn(path):
+        if path == corrupt:
+            raise ValueError("truncated cube")
+        return load_archive(path)
+
+    rep = clean_fleet([paths[0], bogus, corrupt, paths[1]], CFG,
+                      group_size=4, load_fn=load_fn)
+    assert set(rep.results) == set(paths)
+    stages = {p: stage for p, stage, _exc in rep.failures}
+    assert stages == {bogus: "peek", corrupt: "load"}
+
+
+def test_fleet_empty_and_all_failed(tmp_path):
+    rep = clean_fleet([], CFG)
+    assert rep.ok and rep.results == {} and rep.n_buckets == 0
+    rep = clean_fleet([str(tmp_path / "nope.npz")], CFG)
+    assert not rep.ok and rep.results == {}
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_cli_fleet_end_to_end(tmp_path, monkeypatch):
+    from iterative_cleaner_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    paths = _write_fleet(tmp_path, [(8, 16, 32), (8, 16, 32), (6, 12, 32)])
+    rc = main(["-q", "--fleet", "--rotation", "roll", "--fft_mode", "dft",
+               "--io-workers", "2", *paths])
+    assert rc == 0
+    for p in paths:
+        assert os.path.exists(p + "_cleaned.npz")
+        out = load_archive(p + "_cleaned.npz")
+        assert out.data.shape == load_archive(p).data.shape
+
+
+def test_cli_fleet_flag_validation(tmp_path, monkeypatch, capsys):
+    from iterative_cleaner_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    (paths,) = [_write_fleet(tmp_path, [(8, 16, 32)])]
+    # --bucket-pad without --fleet: loud error, not a silent no-op
+    with pytest.raises(SystemExit):
+        main(["-q", "--bucket-pad", "8,16", *paths])
+    assert "--fleet" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        main(["-q", "--fleet", "--stream", "4", *paths])
+    with pytest.raises(SystemExit):
+        main(["-q", "--fleet", "--io-workers", "0", *paths])
+
+
+def test_cli_fleet_write_failure_exit_nonzero(tmp_path, monkeypatch):
+    """A write-back failure must not abort the fleet (the other outputs
+    still land) but the exit status reports it."""
+    import iterative_cleaner_tpu.cli as cli
+
+    monkeypatch.chdir(tmp_path)
+    paths = _write_fleet(tmp_path, [(8, 16, 32)] * 3)
+    real_clean_one = cli.clean_one
+
+    def flaky_clean_one(path, args, **kw):
+        if path == paths[1]:
+            raise IOError("disk full")
+        return real_clean_one(path, args, **kw)
+
+    monkeypatch.setattr(cli, "clean_one", flaky_clean_one)
+    rc = cli.main(["-q", "--fleet", "--rotation", "roll",
+                   "--fft_mode", "dft", *paths])
+    assert rc == 1
+    assert os.path.exists(paths[0] + "_cleaned.npz")
+    assert os.path.exists(paths[2] + "_cleaned.npz")
+    assert not os.path.exists(paths[1] + "_cleaned.npz")
