@@ -480,6 +480,17 @@ def bench_fleet(n_archives, geometries, max_iter=3, group_size=8,
     bucket — K shapes, K compiles, however many archives).  Masks must be
     bit-equal to the sequential path for every archive (quantization off;
     the assert is the rc-7 parity contract of the subprocess row).
+
+    The warm-restart contract rides on top: the same fleet is served
+    twice through the real CLI (two fresh processes) sharing a
+    ``--compile-cache`` directory.  The second process must reload every
+    bucket executable from the persistent cache — ``fleet_warm_compiles``
+    (new cache entries written by the warm run) must be ZERO, and its
+    serve time must beat the cold process's (``fleet_cold_vs_warm`` < 1,
+    from each run's ``fleet_serve_s`` gauge so process startup and import
+    cost don't pollute the ratio).  Warm-run output masks must stay
+    bit-equal to the in-process sequential results — config drift between
+    the CLI defaults and this stage's CleanConfig would surface here.
     """
     import dataclasses
     import shutil
@@ -562,7 +573,66 @@ def bench_fleet(n_archives, geometries, max_iter=3, group_size=8,
             assert np.array_equal(seq[p].final_weights == 0,
                                   fleet.results[p].final_weights == 0), \
                 f"fleet mask diverged from sequential (archive {i})"
+        # the warm in-process passes must be served from the background
+        # precompile pool's memo — a hit count of zero would mean the
+        # pool is dead weight and every group paid inline compilation
+        pre_hits = int(warm_reg.counters.get("fleet_precompile_hits", 0))
+        pre_misses = int(warm_reg.counters.get("fleet_precompile_misses", 0))
+        assert pre_hits >= 1, \
+            f"warm fleet pass took {pre_hits} precompile hits " \
+            f"({pre_misses} misses); background pool not serving"
+
+        import subprocess
+
         import jax
+
+        # Warm-restart contract through the real CLI: two fresh processes
+        # over the SAME explicit path list (never a glob — it would sweep
+        # up the *_cleaned outputs and silently change the fleet), sharing
+        # one persistent compile-cache directory.
+        cache_dir = os.path.join(tmp, "compile_cache")
+        os.makedirs(cache_dir)
+
+        def run_fleet_cli(tag):
+            metrics_path = os.path.join(tmp, f"metrics_{tag}.json")
+            cmd = [sys.executable, "-m", "iterative_cleaner_tpu", "-q",
+                   "--fleet", "--batch", str(group_size),
+                   "--io-workers", str(io_workers),
+                   "--max_iter", str(max_iter),
+                   "--compile-cache", cache_dir,
+                   "--metrics-json", metrics_path] + paths
+            env = {**os.environ,
+                   "ICLEAN_PLATFORM": jax.default_backend(),
+                   "ICLEAN_PROBE_TIMEOUT": "0",
+                   "PYTHONPATH": os.pathsep.join(
+                       [os.path.dirname(os.path.abspath(__file__))]
+                       + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+                   ).rstrip(os.pathsep)}
+            subprocess.run(cmd, env=env, check=True,
+                           stdout=subprocess.DEVNULL)
+            with open(metrics_path) as fh:
+                return json.load(fh)
+
+        cold_cli = run_fleet_cli("cold")
+        n_cache_entries = len(os.listdir(cache_dir))
+        warm_cli = run_fleet_cli("warm")
+        warm_compiles = len(os.listdir(cache_dir)) - n_cache_entries
+        cold_serve = float(cold_cli["gauges"]["fleet_serve_s"])
+        warm_serve = float(warm_cli["gauges"]["fleet_serve_s"])
+        _log(f"fleet stage: CLI restart serve {cold_serve:.2f}s cold -> "
+             f"{warm_serve:.2f}s warm ({warm_serve / cold_serve:.2f}x), "
+             f"{warm_compiles} cache entries written by the warm run")
+        assert warm_compiles == 0, \
+            f"warm CLI restart wrote {warm_compiles} new compile-cache " \
+            "entries; persistent-cache keys are unstable across processes"
+        assert warm_serve < cold_serve, \
+            f"warm CLI restart served in {warm_serve:.2f}s vs cold " \
+            f"{cold_serve:.2f}s; persistent cache bought nothing"
+        for i, p in enumerate(paths):
+            out = load_archive(p + "_cleaned.npz")
+            assert np.array_equal(seq[p].final_weights == 0,
+                                  out.weights == 0), \
+                f"warm CLI mask diverged from sequential (archive {i})"
 
         return {
             "fleet_n": n_archives,
@@ -575,6 +645,10 @@ def bench_fleet(n_archives, geometries, max_iter=3, group_size=8,
             "fleet_per_archive_ms": round(t_fleet / n_archives * 1e3, 1),
             "fleet_h2d_bytes": int(
                 warm_reg.counters.get("batch_h2d_bytes", 0)),
+            "fleet_precompile_hits": pre_hits,
+            "fleet_precompile_misses": pre_misses,
+            "fleet_cold_vs_warm": round(warm_serve / cold_serve, 2),
+            "fleet_warm_compiles": warm_compiles,
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -750,7 +824,7 @@ def main():
     row = _bench_row_subprocess(
         "BENCH_FLEET_ONLY",
         {"n_archives": f_n, "geometries": f_geoms},
-        timeout=float(os.environ.get("BENCH_FLEET_TIMEOUT", "600")),
+        timeout=float(os.environ.get("BENCH_FLEET_TIMEOUT", "900")),
         label="fleet")
     if row:
         extras = {**(extras or {}), **row}

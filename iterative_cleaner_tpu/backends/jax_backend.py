@@ -11,6 +11,8 @@ parallel layer.
 from __future__ import annotations
 
 import functools
+import threading
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +30,28 @@ from iterative_cleaner_tpu.ops.dsp import (
     template_residuals,
     weighted_template,
 )
+
+
+_DONATION_WARNING_LOCK = threading.Lock()
+
+
+def silence_unusable_donation_warning() -> None:
+    """Install a warnings filter for jax's lowering-time "Some donated
+    buffers were not usable" UserWarning.
+
+    Donating the cube alongside the weights is deliberate: on TPU the
+    compiler reuses the donated cube's HBM for iteration temporaries,
+    while XLA:CPU finds no same-shaped output to alias it to and jax
+    warns at every lowering.  That expected, per-backend outcome must not
+    spam a fleet run's stderr — and a per-call ``catch_warnings`` would
+    not be thread-safe under the fleet's IO/compile threads, so the
+    filter is process-wide, (re)installed at each donating entry point
+    (``filterwarnings`` de-duplicates identical filters, and reinstalling
+    survives an intervening ``catch_warnings`` context having restored an
+    older filter list)."""
+    with _DONATION_WARNING_LOCK:
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
 
 
 def resolve_median_impl(median_impl: str, dtype) -> str:
@@ -114,9 +138,18 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, baseline_duty,
                    unload_res, fft_mode="fft", median_impl="sort",
                    stats_impl="xla", stats_frame="dispersed",
-                   dedispersed=False, baseline_mode="profile"):
+                   dedispersed=False, baseline_mode="profile",
+                   donate=False):
     """Build (and cache) the jitted whole-archive cleaning program for one
-    static configuration."""
+    static configuration.
+
+    ``donate=True`` donates the cube and weights inputs
+    (``donate_argnums=(0, 1)``) so the engine iterates without
+    double-buffering its largest arrays — the weights carry aliases the
+    final-weights output in place (and with ``unload_res`` the cube can
+    alias the residual).  Only for callers uploading fresh buffers per
+    call (:func:`clean_cube` decides per invocation); direct builder users
+    replaying device arrays keep the default."""
 
     # Dispersed-frame iteration (engine/loop.py ``disp_iteration``): the
     # default configuration's fast path — template + consensus correction
@@ -167,6 +200,9 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
         resid = rotate_bins(resid, shifts, jnp, method=rotation)
         return outs, resid
 
+    if donate:
+        silence_unusable_donation_warning()
+        return jax.jit(run, donate_argnums=(0, 1))
     return jax.jit(run)
 
 
@@ -178,6 +214,17 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
     ``DEDISP=1``); see :func:`~iterative_cleaner_tpu.engine.loop.prepare_cube_jax`."""
     dtype = jnp.dtype(config.dtype)
     fft_mode = resolve_fft_mode(config.fft_mode, dtype)
+    # Donate the cube/weights uploads into the program (engine no longer
+    # double-buffers its largest arrays) — but only when this call OWNS
+    # those buffers: host inputs are converted to fresh device arrays
+    # below, while a caller-held jax.Array passes through jnp.asarray
+    # unchanged and donating it would delete the caller's buffer (e.g.
+    # bench_jax replaying one upload across repeats).
+    donate = (config.donate_buffers
+              and not isinstance(cube, jax.Array)
+              and not isinstance(orig_weights, jax.Array))
+    if donate:
+        silence_unusable_donation_warning()
     fn = build_clean_fn(
         config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
@@ -188,6 +235,7 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         resolve_stats_frame(config.stats_frame, dtype),
         bool(dedispersed),
         config.baseline_mode,
+        donate=donate,
     )
     outs, resid = fn(
         jnp.asarray(cube, dtype=dtype),

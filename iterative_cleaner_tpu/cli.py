@@ -135,13 +135,34 @@ def build_parser() -> argparse.ArgumentParser:
                              "state is saved there, and re-runs reuse "
                              "checkpoints whose input content and config "
                              "still match (batch resume).")
-    parser.add_argument("--compile_cache", type=str, default="",
-                        metavar="DIR",
+    parser.add_argument("--compile-cache", "--compile_cache", type=str,
+                        default="", dest="compile_cache", metavar="DIR",
                         help="Persistent jax compilation cache directory: "
                              "repeat invocations (sweeps, nightly batches) "
-                             "skip the 20-40s TPU compiles. Also settable "
-                             "as ICLEAN_COMPILE_CACHE for any entry point. "
-                             "jax backend only (numpy never compiles).")
+                             "skip the 20-40s TPU compiles, and a warm "
+                             "--fleet restart reports zero real compiles. "
+                             "Also settable as ICLEAN_COMPILE_CACHE for "
+                             "any entry point. jax backend only (numpy "
+                             "never compiles).")
+    parser.add_argument("--precompile", action="store_true",
+                        help="Warm the --compile-cache for the given "
+                             "archives/geometries and exit without "
+                             "cleaning anything: each argument is an "
+                             "archive path (shape read from its header) "
+                             "or a bare NSUBxNCHANxNBIN geometry string; "
+                             "every resulting fleet bucket's batched "
+                             "program is AOT-compiled into the persistent "
+                             "cache, so later serving runs start warm. "
+                             "Honours --batch (group size), --bucket-pad "
+                             "and --mesh batch.")
+    parser.add_argument("--no-donate", "--no_donate", action="store_true",
+                        dest="no_donate",
+                        help="Disable buffer donation on the jax hot "
+                             "paths (donation lets the compiled programs "
+                             "alias the cube/weights uploads instead of "
+                             "double-buffering them; masks are identical "
+                             "either way — this is a debugging escape "
+                             "hatch).")
     parser.add_argument("--record_history", action="store_true",
                         help="Keep every iteration's weight matrix in the "
                              "result/checkpoint (regression diffing).")
@@ -304,6 +325,8 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         # meaning: archives per compiled program)
         fleet_group_size=(args.batch if getattr(args, "batch", 0) > 1
                           else CleanConfig.fleet_group_size),
+        compile_cache_dir=(getattr(args, "compile_cache", "") or None),
+        donate_buffers=not getattr(args, "no_donate", False),
         unload_res=args.unload_res,
         record_history=args.record_history,
     )
@@ -673,12 +696,86 @@ def _run_fleet(args, telemetry=None) -> list:
     return failed
 
 
+def _parse_geometry_spec(spec: str):
+    """'NSUBxNCHANxNBIN' -> (nsub, nchan, nbin) for --precompile arguments
+    that are not paths; None when the string does not look like one."""
+    parts = spec.lower().split("x")
+    if len(parts) != 3:
+        return None
+    try:
+        dims = tuple(int(v) for v in parts)
+    except ValueError:
+        return None
+    return dims if all(v > 0 for v in dims) else None
+
+
+def _run_precompile(args) -> int:
+    """--precompile driver: resolve each argument to a shape (header peek
+    for paths, parsed NSUBxNCHANxNBIN otherwise), plan the fleet buckets
+    exactly as --fleet would, and AOT-compile every bucket program into
+    the persistent compilation cache — then exit.  A serving run (this
+    host or any other mounting the same cache) starts warm: zero real
+    compiles."""
+    import time
+
+    from iterative_cleaner_tpu.parallel.batch import (
+        precompile_batched_executable,
+    )
+    from iterative_cleaner_tpu.parallel.fleet import (
+        _default_shape_fn,
+        plan_fleet,
+    )
+
+    cfg = config_from_args(args)
+    mesh = None
+    batch_multiple = 1
+    if args.mesh == "batch":
+        from iterative_cleaner_tpu.parallel.mesh import batch_mesh
+
+        mesh = batch_mesh()
+        batch_multiple = int(mesh.shape["batch"])
+    entries = []
+    for spec in args.archive:
+        if os.path.exists(spec):
+            entries.append((spec, _default_shape_fn(spec)))
+            continue
+        dims = _parse_geometry_spec(spec)
+        if dims is None:
+            print("ERROR: --precompile argument %r is neither an existing "
+                  "archive nor a NSUBxNCHANxNBIN geometry" % spec,
+                  file=sys.stderr)
+            return 2
+        entries.append((spec, (*dims, False)))
+    plan = plan_fleet(entries, bucket_pad=cfg.fleet_bucket_pad,
+                      group_size=cfg.fleet_group_size,
+                      batch_multiple=batch_multiple)
+    for bucket in plan.buckets:
+        nsub, nchan, nbin, ded = bucket.key
+        t0 = time.perf_counter()
+        precompile_batched_executable(cfg, nsub, nchan, nbin, ded,
+                                      bucket.batch_dim, mesh=mesh)
+        if not args.quiet:
+            print("precompiled %dx%dx%d%s batch=%d (%d archive%s) "
+                  "in %.2fs"
+                  % (nsub, nchan, nbin, " dedispersed" if ded else "",
+                     bucket.batch_dim, len(bucket.items),
+                     "" if len(bucket.items) == 1 else "s",
+                     time.perf_counter() - t0))
+    if not args.quiet:
+        print("compile cache warmed: %d bucket program%s -> %s"
+              % (len(plan.buckets),
+                 "" if len(plan.buckets) == 1 else "s",
+                 args.compile_cache
+                 or os.environ.get("ICLEAN_COMPILE_CACHE", "")))
+    return 0
+
+
 def main(argv=None) -> int:
     args = parse_arguments(argv)
     from iterative_cleaner_tpu.utils import (
         apply_platform_override,
+        configure_compilation_cache,
         device_reachable,
-        enable_compile_cache,
     )
 
     if args.batch > 1 and (args.unload_res or args.checkpoint
@@ -704,12 +801,13 @@ def main(argv=None) -> int:
             "--mesh cell requires --backend jax and is incompatible with "
             "--batch/--unload_res/--record_history (the sharded path does "
             "not gather residual cubes or weight histories)")
-    if args.mesh == "batch" and ((args.batch <= 1 and not args.fleet)
+    if args.mesh == "batch" and ((args.batch <= 1 and not args.fleet
+                                  and not args.precompile)
                                  or args.backend != "jax"):
         build_parser().error(
             "--mesh batch shards the --batch groups (or --fleet buckets) "
-            "over devices; pass --batch B (B > 1) or --fleet, and "
-            "--backend jax")
+            "over devices; pass --batch B (B > 1), --fleet or "
+            "--precompile, and --backend jax")
     if args.fleet and (args.unload_res or args.checkpoint
                        or args.record_history or args.stream > 0
                        or args.backend != "jax"
@@ -732,7 +830,23 @@ def main(argv=None) -> int:
     if args.compile_cache and args.backend != "jax":
         # numpy never compiles jax programs — a silently useless cache
         # would mislead; the other ineffective flag combos error loudly too
-        build_parser().error("--compile_cache requires --backend jax")
+        build_parser().error("--compile-cache requires --backend jax")
+    if args.precompile:
+        if args.backend != "jax":
+            build_parser().error("--precompile requires --backend jax")
+        if not (args.compile_cache
+                or os.environ.get("ICLEAN_COMPILE_CACHE")):
+            # warming only the in-process caches of a process about to
+            # exit would be a silent no-op
+            build_parser().error(
+                "--precompile warms the persistent compilation cache; "
+                "pass --compile-cache DIR (or set ICLEAN_COMPILE_CACHE)")
+        if args.mesh == "cell" or args.stream > 0 or args.unload_res \
+                or args.checkpoint or args.model != "surgical_scrub":
+            build_parser().error(
+                "--precompile warms the --fleet bucket programs and is "
+                "incompatible with --mesh cell/--stream/--unload_res/"
+                "--checkpoint/--model quicklook")
     if args.stream < 0:
         build_parser().error(
             f"--stream must be a positive tile size (0 disables), got "
@@ -766,7 +880,9 @@ def main(argv=None) -> int:
               "(set ICLEAN_PLATFORM to override)", file=sys.stderr)
         os.environ["ICLEAN_PLATFORM"] = "cpu"
     apply_platform_override()
-    enable_compile_cache(args.compile_cache)
+    configure_compilation_cache(args.compile_cache)
+    if args.precompile:
+        return _run_precompile(args)
 
     failed = []
     with run_session(args) as telemetry:

@@ -94,6 +94,21 @@ class CleanConfig:
     # one program.  Bounds peak host RAM at ~2 groups of archives (the
     # load pool stays one group ahead).
     fleet_group_size: int = 8
+    # persistent XLA compilation-cache directory
+    # (utils.configure_compilation_cache): compiled programs are reloaded
+    # across process restarts, so a warm re-serve of the same fleet pays
+    # zero real compiles.  None defers to the ICLEAN_COMPILE_CACHE env var
+    # (applied at entry-point setup); the empty default leaves the cache
+    # off.  jax backend only (numpy never compiles).
+    compile_cache_dir: Optional[str] = None
+    # donate the cube/weights inputs into the compiled cleaning programs
+    # (jit donate_argnums): the iteration no longer double-buffers its
+    # largest arrays — on-device the weights carry aliases the
+    # final-weights output in place.  Masks are unaffected (donation is an
+    # aliasing hint, not a semantic change); library callers that re-use
+    # device arrays across calls go through entry points that only donate
+    # freshly-uploaded buffers.  Opt-out knob for debugging.
+    donate_buffers: bool = True
     unload_res: bool = False     # -u: also produce the pulse-free residual
     # keep the per-iteration weight matrices in the result (checkpoint/
     # regression-diff support, utils/checkpoint.py); costs one extra D2H of

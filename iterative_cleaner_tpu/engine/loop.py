@@ -23,6 +23,17 @@ Semantics mirror the reference engine (``/root/reference/iterative_cleaner.py:65
 
 Everything is static-shaped; the dynamic trip count lives in the while_loop
 condition.
+
+Buffer-donation contract (the jit boundaries in backends/jax_backend and
+parallel/batch donate the cube/weights inputs when
+``CleanConfig.donate_buffers`` is on): this engine is donation-safe by
+construction.  Every input is consumed functionally — the loop carry holds
+only derived arrays (weights, history, metrics), the baseline-removed cube
+is read, never written, and no input array is returned as an output — so
+XLA is free to alias the donated weights into ``final_weights`` and (on
+backends that support it) recycle the donated cube's memory for the
+iteration temporaries.  Keep it that way: returning an input unchanged
+from here would silently disable its donation at every jit boundary above.
 """
 
 from __future__ import annotations

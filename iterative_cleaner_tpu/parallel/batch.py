@@ -12,7 +12,9 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import List, Optional, Sequence, Tuple
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -58,12 +60,19 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                            pulse_scale, pulse_active, rotation, baseline_duty,
                            fft_mode, median_impl="sort",
                            stats_frame="dispersed", dedispersed=False,
-                           stats_impl="xla", baseline_mode="profile"):
+                           stats_impl="xla", baseline_mode="profile",
+                           donate=False):
     """Jitted batched cleaner: every per-archive input gains a leading batch
     axis; scalars (dm, period, ref freq) are per-archive vectors.  The
     Pallas kernels (median/fused stats) batch through their custom_vmap
     rules — the batch folds into each launch's grid instead of vmap
-    serialising the pallas_call."""
+    serialising the pallas_call.
+
+    ``donate=True`` donates the stacked cube and weights inputs
+    (``donate_argnums=(0, 1)``) so the program's largest buffers alias
+    instead of double-buffering — correct only for callers that upload a
+    fresh stack per call (``clean_archives_batched`` does; direct builder
+    users that replay device arrays must keep the default)."""
     import jax
     import jax.numpy as jnp
 
@@ -99,6 +108,15 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                 baseline_mode, stats_frame, pulse_active, dedispersed),
         )
 
+    if donate:
+        from iterative_cleaner_tpu.backends.jax_backend import (
+            silence_unusable_donation_warning,
+        )
+
+        # the cube (no same-shaped output) is expected to be unusable on
+        # CPU — jax warns per dispatch; the weights donation is the win
+        silence_unusable_donation_warning()
+        return jax.jit(jax.vmap(one), donate_argnums=(0, 1))
     return jax.jit(jax.vmap(one))
 
 
@@ -108,22 +126,222 @@ _STACKED_NDIMS = (4, 3, 2, 1, 1, 1)
 
 
 @functools.lru_cache(maxsize=_BUILDER_CACHE_MAXSIZE)
-def build_batch_shardmap_fn(mesh, *build_args):
+def build_batch_shardmap_fn(mesh, *build_args, donate=False):
     """The pure-('batch',)-mesh kernel route: shard_map the cached batched
     cleaner over the batch axis (archives are independent — zero
     collectives; each device vmap-cleans its local slice with the full
     Pallas stack).  Cached alongside :func:`build_batched_clean_fn` so
-    repeated CLI groups reuse one compiled program."""
+    repeated CLI groups reuse one compiled program.  ``donate`` as in
+    :func:`build_batched_clean_fn` (applied at this outer jit: each
+    device's freshly-sharded cube/weights slices alias)."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     inner = build_batched_clean_fn(*build_args)
     in_specs = tuple(P("batch", *([None] * (nd - 1)))
                      for nd in _STACKED_NDIMS)
+    sharded = jax.shard_map(inner, mesh=mesh, in_specs=in_specs,
+                            out_specs=P("batch"), check_vma=False)
     # every CleanOutputs leaf carries a leading batch dim, so one
     # P('batch') prefix spec covers the whole output pytree
-    return jax.jit(jax.shard_map(inner, mesh=mesh, in_specs=in_specs,
-                                 out_specs=P("batch"), check_vma=False))
+    if donate:
+        from iterative_cleaner_tpu.backends.jax_backend import (
+            silence_unusable_donation_warning,
+        )
+
+        silence_unusable_donation_warning()
+        return jax.jit(sharded, donate_argnums=(0, 1))
+    return jax.jit(sharded)
+
+
+def resolve_batch_build_args(config: CleanConfig, nbin: int,
+                             dedispersed: bool, mesh=None,
+                             has_specs: bool = False):
+    """Resolve a config into the batched builders' static argument tuple.
+
+    One shared resolution for the execute path
+    (:func:`clean_archives_batched`) and the AOT precompile path
+    (:func:`precompile_batched_executable`): the warm-start contract —
+    a background-compiled executable must be byte-identical to the one the
+    inline path would jit — only holds if both resolve ``auto`` knobs and
+    pick the kernel route from exactly the same inputs.  Returns
+    ``(build_args, use_shardmap)`` where ``use_shardmap`` selects
+    :func:`build_batch_shardmap_fn` (the pure-('batch',)-mesh kernel
+    route) over :func:`build_batched_clean_fn`.
+    """
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fft_mode,
+        resolve_median_impl,
+        resolve_stats_frame,
+        resolve_stats_impl,
+    )
+
+    # same 'auto' resolution as the single-archive path: the kernels'
+    # custom_vmap rules fold the batch into their launch grids, so the
+    # fast paths survive batching (round 3; previously forced to 'sort').
+    dtype = jnp.dtype(config.dtype)
+    fft_mode = resolve_fft_mode(config.fft_mode, dtype)
+    pure_batch = (mesh is not None
+                  and set(mesh.axis_names) == {"batch"})
+    kernel_route = pure_batch and not has_specs
+    if mesh is None or kernel_route:
+        # pure ('batch',) meshes keep the kernels too: archives are
+        # independent, so a shard_map over the batch axis needs no
+        # collectives — each device vmap-cleans its local archives with
+        # the full kernel stack (custom_vmap folds the LOCAL batch into
+        # each launch's grid)
+        median_impl = resolve_median_impl(config.median_impl, dtype)
+        stats_impl = resolve_stats_impl(config.stats_impl, dtype,
+                                        int(nbin), fft_mode)
+    else:
+        # hybrid meshes / caller-supplied specs stay GSPMD-routed, where a
+        # bare pallas_call would all-gather the folded cubes
+        if config.median_impl == "pallas" or config.stats_impl == "fused":
+            kind = ("batch mesh with custom specs" if pure_batch
+                    else "hybrid batch mesh")
+            raise ValueError(
+                f"explicit median_impl='pallas'/stats_impl='fused' cannot "
+                f"run under a {kind}: a bare pallas_call in the GSPMD "
+                "program would all-gather the folded cubes onto every "
+                "device; use 'auto' (resolves to sort/xla here) or a pure "
+                "('batch',) mesh with default specs, which "
+                "shard_map-routes the kernels")
+        median_impl = "sort" if config.median_impl == "auto" \
+            else config.median_impl
+        stats_impl = "xla" if config.stats_impl == "auto" \
+            else config.stats_impl
+    build_args = (
+        config.max_iter, config.chanthresh, config.subintthresh,
+        config.pulse_slice, config.pulse_scale, config.pulse_region_active,
+        config.rotation, config.baseline_duty,
+        fft_mode,
+        median_impl,
+        resolve_stats_frame(config.stats_frame, dtype),
+        bool(dedispersed),
+        stats_impl,
+        config.baseline_mode,
+    )
+    use_shardmap = (kernel_route
+                    and (median_impl == "pallas" or stats_impl == "fused"))
+    return build_args, use_shardmap
+
+
+def batch_abstract_inputs(batch_dim: int, nsub: int, nchan: int, nbin: int,
+                          dtype, mesh=None, specs=None):
+    """ShapeDtypeStructs mirroring :func:`stack_archive_batch`'s outputs
+    for one ``batch_dim``-deep group — what ``jit(...).lower()`` needs to
+    compile a bucket program before any archive data exists.  With
+    ``mesh``, each aval carries the NamedSharding the execute path's
+    ``device_put`` will produce (``specs`` overrides per-input, as in
+    :func:`clean_archives_batched`)."""
+    import jax
+
+    shapes = [(batch_dim, nsub, nchan, nbin), (batch_dim, nsub, nchan),
+              (batch_dim, nchan), (batch_dim,), (batch_dim,), (batch_dim,)]
+    if mesh is None:
+        return tuple(jax.ShapeDtypeStruct(s, dtype) for s in shapes)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if specs is None:
+        specs = tuple(P("batch", *([None] * (len(s) - 1))) for s in shapes)
+    return tuple(
+        jax.ShapeDtypeStruct(s, dtype, sharding=NamedSharding(mesh, spec))
+        for s, spec in zip(shapes, specs))
+
+
+# AOT executable memo: (resolved build args, geometry, batch dim, mesh,
+# donation) -> the jax Compiled object.  `jit(...).lower().compile()` does
+# NOT populate the jit wrapper's per-shape cache, so precompiled programs
+# must be held and called directly — this memo is that store, shared by
+# the fleet's background pool and the --precompile CLI verb, and the
+# reason a warm in-process re-serve recompiles nothing.  Bounded like the
+# builder caches; cleared wholesale when full (entries recompile — or
+# reload from the persistent cache — on return).
+_AOT_MEMO: Dict[tuple, object] = {}
+_AOT_MEMO_LOCK = threading.Lock()
+_AOT_MEMO_MAX = _BUILDER_CACHE_MAXSIZE
+
+
+def clear_precompile_memo() -> None:
+    """Drop every memoized AOT executable (test isolation: lets a test
+    observe cold-compile accounting in a process whose memo is warm)."""
+    with _AOT_MEMO_LOCK:
+        _AOT_MEMO.clear()
+
+
+def precompile_batched_executable(config: CleanConfig, nsub: int, nchan: int,
+                                  nbin: int, dedispersed: bool,
+                                  batch_dim: int, mesh=None, specs=None,
+                                  registry=None, stats_out=None):
+    """AOT-compile the batched cleaner for one bucket geometry and return
+    the callable ``Compiled`` executable.
+
+    Lowers on abstract :func:`batch_abstract_inputs` — no archive data
+    needed, so the fleet's background pool runs this concurrently with IO
+    lookahead, and the ``--precompile`` CLI verb warms the persistent
+    compilation cache from bare geometry strings.  Memoized per resolved
+    program; a fresh compile counts ONCE into the ``batch_compiles``
+    counter (the execute path never re-counts an executable it was handed)
+    and records the executable's memory analysis as gauges —
+    ``batch_exec_peak_bytes`` / ``batch_exec_alias_bytes`` are the
+    donation win's measured evidence (donated weights alias the
+    final-weights output, shrinking peak by the alias size).
+    ``stats_out`` (a dict) receives ``fresh``: whether this call actually
+    built/loaded the executable rather than hitting the in-process memo.
+    """
+    import jax.numpy as jnp
+
+    donate = bool(config.donate_buffers)
+    build_args, use_shardmap = resolve_batch_build_args(
+        config, nbin, dedispersed, mesh=mesh,
+        has_specs=specs is not None)
+    dtype = jnp.dtype(config.dtype)
+    key = (build_args, use_shardmap, donate, mesh,
+           None if specs is None else tuple(specs),
+           int(batch_dim), int(nsub), int(nchan), int(nbin), str(dtype))
+    with _AOT_MEMO_LOCK:
+        hit = _AOT_MEMO.get(key)
+    if hit is not None:
+        if stats_out is not None:
+            stats_out["fresh"] = False
+        return hit
+    if donate:
+        from iterative_cleaner_tpu.backends.jax_backend import (
+            silence_unusable_donation_warning,
+        )
+
+        silence_unusable_donation_warning()
+    if use_shardmap:
+        fn = build_batch_shardmap_fn(mesh, *build_args, donate=donate)
+    else:
+        fn = build_batched_clean_fn(*build_args, donate=donate)
+    avals = batch_abstract_inputs(batch_dim, nsub, nchan, nbin, dtype,
+                                  mesh=mesh, specs=specs)
+    t0 = time.perf_counter()
+    compiled = fn.lower(*avals).compile()
+    if registry is not None:
+        registry.counter_inc("batch_compiles")
+        registry.histogram_observe("batch_precompile_s",
+                                   time.perf_counter() - t0)
+        try:
+            ma = compiled.memory_analysis()
+            alias = int(ma.alias_size_in_bytes)
+            peak = (int(ma.argument_size_in_bytes)
+                    + int(ma.output_size_in_bytes)
+                    + int(ma.temp_size_in_bytes) - alias)
+            registry.gauge_set("batch_exec_peak_bytes", peak)
+            registry.gauge_set("batch_exec_alias_bytes", alias)
+        except Exception:
+            pass  # memory analysis is advisory; not every runtime has it
+    if stats_out is not None:
+        stats_out["fresh"] = True
+    with _AOT_MEMO_LOCK:
+        if len(_AOT_MEMO) >= _AOT_MEMO_MAX:
+            _AOT_MEMO.clear()
+        _AOT_MEMO[key] = compiled
+    return compiled
 
 
 def check_equal_shapes(archives: Sequence[Archive]) -> None:
@@ -220,7 +438,9 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
                            mesh=None, specs=None, registry=None,
                            pad_to: Optional[int] = None,
                            raw_shapes: Optional[Sequence[Tuple[int, int]]]
-                           = None) -> List[CleanResult]:
+                           = None, executable=None,
+                           stats_out: Optional[dict] = None
+                           ) -> List[CleanResult]:
     """Clean a batch of equal-shaped archives in one compiled call.
 
     With ``mesh`` (a 1-D ('batch',) mesh from
@@ -243,6 +463,17 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
     so partial trailing groups reuse the full group's program);
     ``raw_shapes`` crops geometry-padded archives back — see
     :func:`unpack_batch_results`.
+
+    ``executable`` — a :func:`precompile_batched_executable` product for
+    this exact geometry/config: the stacked inputs are fed straight to the
+    AOT-compiled program, skipping jit dispatch (and its re-trace) and the
+    jit-cache compile accounting — a handed-in executable was already
+    counted where it was built, never here (the no-double-count
+    contract).  ``stats_out`` (a dict) receives ``compiles``: how many
+    programs THIS call compiled inline (always 0 on the executable path) —
+    the race-free per-call signal the fleet's accounting uses instead of
+    registry counter deltas, which a concurrent background compile would
+    corrupt.
     """
     import jax
     import jax.numpy as jnp
@@ -277,64 +508,25 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
                              sum(int(x.nbytes) for x in args))
         registry.counter_inc("batch_archives", n)
 
-    from iterative_cleaner_tpu.backends.jax_backend import (
-        resolve_fft_mode,
-        resolve_median_impl,
-        resolve_stats_frame,
-        resolve_stats_impl,
-    )
+    fn = None
+    if executable is None:
+        build_args, use_shardmap = resolve_batch_build_args(
+            config, archives[0].nbin, bool(archives[0].dedispersed),
+            mesh=mesh, has_specs=specs is not None)
+        donate = bool(config.donate_buffers)
+        if donate:
+            from iterative_cleaner_tpu.backends.jax_backend import (
+                silence_unusable_donation_warning,
+            )
 
-    # same 'auto' resolution as the single-archive path: the kernels'
-    # custom_vmap rules fold the batch into their launch grids, so the
-    # fast paths survive batching (round 3; previously forced to 'sort').
-    dtype = jnp.dtype(config.dtype)
-    fft_mode = resolve_fft_mode(config.fft_mode, dtype)
-    pure_batch = (mesh is not None
-                  and set(mesh.axis_names) == {"batch"})
-    kernel_route = pure_batch and specs is None
-    if mesh is None or kernel_route:
-        # pure ('batch',) meshes keep the kernels too: archives are
-        # independent, so a shard_map over the batch axis (below) needs no
-        # collectives — each device vmap-cleans its local archives with
-        # the full kernel stack (custom_vmap folds the LOCAL batch into
-        # each launch's grid)
-        median_impl = resolve_median_impl(config.median_impl, dtype)
-        stats_impl = resolve_stats_impl(config.stats_impl, dtype,
-                                        archives[0].nbin, fft_mode)
-    else:
-        # hybrid meshes / caller-supplied specs stay GSPMD-routed, where a
-        # bare pallas_call would all-gather the folded cubes
-        if config.median_impl == "pallas" or config.stats_impl == "fused":
-            kind = ("batch mesh with custom specs" if pure_batch
-                    else "hybrid batch mesh")
-            raise ValueError(
-                f"explicit median_impl='pallas'/stats_impl='fused' cannot "
-                f"run under a {kind}: a bare pallas_call in the GSPMD "
-                "program would all-gather the folded cubes onto every "
-                "device; use 'auto' (resolves to sort/xla here) or a pure "
-                "('batch',) mesh with default specs, which "
-                "shard_map-routes the kernels")
-        median_impl = "sort" if config.median_impl == "auto" \
-            else config.median_impl
-        stats_impl = "xla" if config.stats_impl == "auto" \
-            else config.stats_impl
-    build_args = (
-        config.max_iter, config.chanthresh, config.subintthresh,
-        config.pulse_slice, config.pulse_scale, config.pulse_region_active,
-        config.rotation, config.baseline_duty,
-        fft_mode,
-        median_impl,
-        resolve_stats_frame(config.stats_frame, dtype),
-        bool(archives[0].dedispersed),
-        stats_impl,
-        config.baseline_mode,
-    )
-    if (kernel_route
-            and (median_impl == "pallas" or stats_impl == "fused")):
-        fn = build_batch_shardmap_fn(mesh, *build_args)
-    else:
-        fn = build_batched_clean_fn(*build_args)
-    exec_before = _jit_cache_size(fn) if registry is not None else None
+            silence_unusable_donation_warning()
+        if use_shardmap:
+            fn = build_batch_shardmap_fn(mesh, *build_args, donate=donate)
+        else:
+            fn = build_batched_clean_fn(*build_args, donate=donate)
+    want_compiles = registry is not None or stats_out is not None
+    exec_before = _jit_cache_size(fn) \
+        if (fn is not None and want_compiles) else None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -350,18 +542,24 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
             for x, spec in zip(args, specs)
         )
         with mesh:
-            outs = fn(*args)
+            outs = (executable if executable is not None else fn)(*args)
         # meshes spanning processes: gather outputs before host reads
         from iterative_cleaner_tpu.parallel.distributed import host_fetch
 
         outs = host_fetch(outs)
     else:
-        outs = fn(*args)
+        outs = (executable if executable is not None else fn)(*args)
 
-    if registry is not None:
+    compiled_n = 0
+    if exec_before is not None:
         exec_after = _jit_cache_size(fn)
-        if (exec_before is not None and exec_after is not None
-                and exec_after > exec_before):
-            registry.counter_inc("batch_compiles", exec_after - exec_before)
+        if exec_after is not None and exec_after > exec_before:
+            compiled_n = exec_after - exec_before
+    if stats_out is not None:
+        stats_out["compiles"] = compiled_n
+        stats_out["used_executable"] = executable is not None
+    if registry is not None:
+        if compiled_n:
+            registry.counter_inc("batch_compiles", compiled_n)
         record_builder_cache_stats(registry)
     return unpack_batch_results(outs, n, config, raw_shapes=raw_shapes)

@@ -22,11 +22,28 @@ This module is that bucketing, plus the serving pipeline around it:
    i-1.  Per-archive failures at any stage (peek/load/clean/write) are
    isolated: recorded in the report (and via ``on_error``), never aborting
    the rest of the fleet.
-3. **Compile-amortization accounting**: per-group compile/execute timings and
+3. **Background precompile pool** (:class:`BucketPrecompiler`): the planner
+   fixes every bucket's compiled geometry before any cube IO, so an AOT
+   compile thread lowers and compiles each bucket's batched program
+   (``jit(...).lower(...).compile()`` on abstract shapes, in bucket
+   execution order) concurrently with the load pool's lookahead — by the
+   time a group's data lands its executable is usually ready
+   (``fleet_precompile_hits``).  When it is not, the pipeline either waits
+   on an in-flight compile (``fleet_compile_stall_s`` — still cheaper than
+   compiling twice) or, if the compile has not started, falls back to the
+   inline jit path (``fleet_precompile_misses``).  With
+   ``CleanConfig.compile_cache_dir`` set, compiles land in jax's
+   persistent cache, so a warm process restart over the same fleet reloads
+   every program instead of rebuilding it — zero real compiles.
+4. **Compile-amortization accounting**: per-group compile/execute timings and
    hit/miss counters land in the :class:`MetricsRegistry` under ``fleet_*``
    (exported with the ``icln_`` prefix), alongside the batch builders'
    bounded-cache gauges — so a run report shows exactly how many XLA
-   programs a fleet cost and how warm the caches were.
+   programs a fleet cost and how warm the caches were.  Each executable
+   counts into ``fleet_compiles``/``batch_compiles`` exactly once, wherever
+   it was built (background pool or inline): the execute path reports its
+   own inline compiles per call (``stats_out``) instead of diffing registry
+   counters, which concurrent background compiles would corrupt.
 
 Mask parity: with quantization off (``bucket_pad=(0, 0)``, the default) every
 archive's results are bit-equal to the sequential per-archive path — batch
@@ -180,6 +197,89 @@ def plan_fleet(entries: Sequence[Tuple[str, ShapeKey]],
                      group_size=int(group_size))
 
 
+class BucketPrecompiler:
+    """Background AOT compile pool for a fleet plan.
+
+    One worker thread compiles every bucket's batched program in the
+    plan's (deterministic, sorted) execution order, overlapping the load
+    pool's IO lookahead — compile latency moves off the serve loop's
+    critical path.  One worker, not many: XLA compiles are themselves
+    multi-threaded, bucket order matches serve order (the program needed
+    first is compiled first), and a single queue makes the
+    cancel-not-started fallback race-free.
+
+    Fresh compiles (in-process memo misses in
+    :func:`~iterative_cleaner_tpu.parallel.batch.precompile_batched_executable`)
+    count once into ``fleet_compiles``/``batch_compiles`` from the worker;
+    memo hits count nothing — a warm re-serve compiles zero programs.
+    Compile failures are non-fatal: :meth:`obtain` returns no executable
+    and the serve loop's inline jit path takes over (which will surface a
+    genuinely broken program with data attached)."""
+
+    def __init__(self, plan: FleetPlan, config: CleanConfig, *,
+                 mesh=None, registry=None) -> None:
+        import concurrent.futures as cf
+
+        self._config = config
+        self._mesh = mesh
+        self._registry = registry
+        self._pool = cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="icln-precompile")
+        self._futures = {
+            bucket.key: self._pool.submit(self._compile, bucket)
+            for bucket in plan.buckets
+        }
+
+    def _compile(self, bucket: FleetBucket):
+        from iterative_cleaner_tpu.parallel.batch import (
+            precompile_batched_executable,
+        )
+
+        nsub, nchan, nbin, ded = bucket.key
+        stats: Dict[str, bool] = {}
+        exe = precompile_batched_executable(
+            self._config, nsub, nchan, nbin, ded, bucket.batch_dim,
+            mesh=self._mesh, registry=self._registry, stats_out=stats)
+        if self._registry is not None and stats.get("fresh"):
+            self._registry.counter_inc("fleet_compiles")
+        return exe
+
+    def obtain(self, bucket: FleetBucket):
+        """The serve loop's rendezvous: ``(executable | None, ready,
+        stall_s)``.
+
+        Ready (compile finished) -> a precompile hit, zero stall.  Still
+        queued -> cancel it and report a miss (the inline path compiles
+        with the data already in hand; the worker must not burn a second
+        compile on the same program).  In flight -> block until done and
+        report the measured stall (one compile is still cheaper than the
+        inline path racing it with a second).  A failed compile degrades
+        to the inline path."""
+        fut = self._futures.get(bucket.key)
+        if fut is None:
+            return None, False, 0.0
+        if fut.done():
+            try:
+                return fut.result(), True, 0.0
+            except Exception:
+                # includes CancelledError: an earlier obtain() cancelled
+                # this bucket and the inline path has been serving it since
+                return None, False, 0.0
+        if fut.cancel():
+            return None, False, 0.0
+        t0 = time.perf_counter()
+        try:
+            exe = fut.result()
+        except Exception:
+            exe = None
+        return exe, False, time.perf_counter() - t0
+
+    def shutdown(self) -> None:
+        for fut in self._futures.values():
+            fut.cancel()
+        self._pool.shutdown(wait=False)
+
+
 @dataclasses.dataclass
 class FleetReport:
     """What :func:`clean_fleet` hands back: per-path results (cleaned
@@ -232,7 +332,8 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
                                             None]] = None,
                 shape_fn: Optional[Callable[[str], ShapeKey]] = None,
                 on_error: Optional[Callable[[str, BaseException, str],
-                                            None]] = None) -> FleetReport:
+                                            None]] = None,
+                precompile: bool = True) -> FleetReport:
     """Serve an arbitrary archive-path list through the compiled batch path.
 
     ``bucket_pad``/``group_size`` default to the config's
@@ -249,6 +350,15 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
     ``registry`` collects the ``fleet_*`` counters/gauges/histograms and the
     batch builders' cache gauges; ``events`` (a telemetry ``RunEventLog``)
     gets one ``fleet_plan`` event.
+
+    ``precompile`` (default on) starts the :class:`BucketPrecompiler` as
+    soon as the plan is fixed, so bucket programs AOT-compile concurrently
+    with the IO lookahead; off, every bucket compiles inline on its first
+    group (the pre-warm-start behaviour — the accounting-isolation knob
+    for tests).  With ``config.compile_cache_dir`` set (wired here via
+    :func:`~iterative_cleaner_tpu.utils.configure_compilation_cache`),
+    compiled programs persist across processes and a warm restart serves
+    the whole fleet with zero real compiles.
     """
     import concurrent.futures as cf
 
@@ -258,6 +368,9 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
         record_builder_cache_stats,
     )
     from iterative_cleaner_tpu.telemetry import MetricsRegistry
+    from iterative_cleaner_tpu.utils import configure_compilation_cache
+
+    configure_compilation_cache(config.compile_cache_dir)
 
     bucket_pad = (tuple(config.fleet_bucket_pad) if bucket_pad is None
                   else tuple(bucket_pad))
@@ -306,6 +419,28 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
     if not groups:
         return report
 
+    serve_t0 = time.perf_counter()
+    precompiler = (BucketPrecompiler(plan, config, mesh=mesh, registry=reg)
+                   if precompile else None)
+    try:
+        _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
+                      io_workers, load_fn, write_fn, clean_archives_batched,
+                      cf)
+    finally:
+        if precompiler is not None:
+            precompiler.shutdown()
+    reg.gauge_set("fleet_serve_s", time.perf_counter() - serve_t0)
+    report.n_compiles = int(reg.counters.get("fleet_compiles", 0.0))
+    reg.counter_inc("fleet_cleaned", len(report.results))
+    record_builder_cache_stats(reg)
+    return report
+
+
+def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
+                  io_workers, load_fn, write_fn, clean_archives_batched,
+                  cf) -> None:
+    """:func:`clean_fleet`'s pipeline body: load lookahead -> rendezvous
+    with the precompiler -> batched clean -> async write-back."""
     with cf.ThreadPoolExecutor(max_workers=io_workers) as load_pool, \
             cf.ThreadPoolExecutor(max_workers=io_workers) as write_pool:
         pending: Dict[int, list] = {}
@@ -350,23 +485,51 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
                 continue
             if pad_cells:
                 reg.counter_inc("fleet_pad_cells", pad_cells)
-            compiles_before = reg.counters.get("batch_compiles", 0.0)
+            executable, ready, stall_s = None, False, 0.0
+            if precompiler is not None:
+                executable, ready, stall_s = precompiler.obtain(bucket)
+                reg.counter_inc("fleet_precompile_hits" if ready
+                                else "fleet_precompile_misses")
+                reg.histogram_observe("fleet_compile_stall_s", stall_s)
+            stats: Dict[str, object] = {}
             t0 = time.perf_counter()
             try:
                 results = clean_archives_batched(
                     padded, config, mesh, registry=reg,
-                    pad_to=bucket.batch_dim, raw_shapes=raw_shapes)
+                    pad_to=bucket.batch_dim, raw_shapes=raw_shapes,
+                    executable=executable, stats_out=stats)
             except Exception as exc:
-                for it, _ar in loaded:
-                    fail(it.path, "clean", exc)
-                continue
+                if executable is not None:
+                    # a precompiled executable that rejects its inputs
+                    # (layout/sharding drift vs the abstract lowering) must
+                    # degrade, not fail the group: retry through the
+                    # inline jit path once
+                    try:
+                        stats = {}
+                        results = clean_archives_batched(
+                            padded, config, mesh, registry=reg,
+                            pad_to=bucket.batch_dim, raw_shapes=raw_shapes,
+                            stats_out=stats)
+                    except Exception as exc2:
+                        for it, _ar in loaded:
+                            fail(it.path, "clean", exc2)
+                        continue
+                else:
+                    for it, _ar in loaded:
+                        fail(it.path, "clean", exc)
+                    continue
             dt = time.perf_counter() - t0
-            compiled = reg.counters.get("batch_compiles", 0.0) \
-                - compiles_before
-            if compiled:
-                reg.counter_inc("fleet_compiles", compiled)
+            inline_compiles = int(stats.get("compiles", 0) or 0)
+            if inline_compiles:
+                # inline compiles count here; background-pool compiles were
+                # already counted by the worker — never both for one
+                # program (the obtain() rendezvous hands the executable
+                # over or cancels the queued compile, exclusively)
+                reg.counter_inc("fleet_compiles", inline_compiles)
+            if inline_compiles or stall_s:
                 reg.counter_inc("fleet_compile_misses")
-                reg.histogram_observe("fleet_group_compile_s", dt)
+                reg.histogram_observe("fleet_group_compile_s",
+                                      dt + stall_s)
             else:
                 reg.counter_inc("fleet_compile_hits")
                 reg.histogram_observe("fleet_group_execute_s", dt)
@@ -383,7 +546,3 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
                 # and the rest of the fleet's outputs must still land
                 reg.counter_inc("fleet_write_failures")
                 fail(it.path, "write", exc)
-    report.n_compiles = int(reg.counters.get("fleet_compiles", 0.0))
-    reg.counter_inc("fleet_cleaned", len(report.results))
-    record_builder_cache_stats(reg)
-    return report

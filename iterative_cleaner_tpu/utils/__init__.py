@@ -13,24 +13,42 @@ def apply_platform_override() -> None:
         import jax
 
         jax.config.update("jax_platforms", platform)
-    enable_compile_cache(os.environ.get("ICLEAN_COMPILE_CACHE"))
+    configure_compilation_cache(os.environ.get("ICLEAN_COMPILE_CACHE"))
 
 
-def enable_compile_cache(directory) -> None:
+def configure_compilation_cache(directory) -> None:
     """Point jax's persistent compilation cache at ``directory`` (created
     if absent).  TPU compiles here go through a remote-compile helper at
     ~20-40 s per program; the cache makes repeat CLI invocations (sweeps,
-    nightly batches, checkpoint re-runs) skip them entirely.  No-op when
-    ``directory`` is falsy.  Exposed as CLI ``--compile_cache DIR`` and the
-    ``ICLEAN_COMPILE_CACHE`` env var (any entry point).
+    nightly batches, checkpoint re-runs) skip them entirely, and the fleet
+    scheduler's warm restarts (parallel/fleet.py: the background bucket
+    precompiler reloads every bucket program from here) report zero real
+    compiles.  No-op when ``directory`` is falsy.  Exposed as
+    ``CleanConfig.compile_cache_dir``, CLI ``--compile-cache DIR`` /
+    ``--precompile`` and the ``ICLEAN_COMPILE_CACHE`` env var (any entry
+    point).
 
-    Note: on XLA:CPU, reloading cached AOT executables prints verbose
+    On XLA:CPU, reloading cached executables can print verbose
     machine-feature notices ("+prefer-no-scatter is not supported...") —
     XLA-internal pseudo-features its host check does not recognise; results
-    are unaffected (cross-process reload is tested), and the TPU path (the
-    reason this knob exists) does not print them."""
+    are unaffected (cross-process reload is tested).  Those notices come
+    from XLA's C++ (TSL) logging, so this helper pins
+    ``TF_CPP_MIN_LOG_LEVEL`` (respecting an explicit setting) before the
+    backend spins up — effective whenever the cache is configured before
+    the first jax computation, i.e. every CLI/bench entry point — and
+    keeps jax's own per-entry cache-hit/miss chatter at WARNING."""
     if not directory:
         return
+    # TSL reads TF_CPP_MIN_LOG_LEVEL when the XLA extension initialises:
+    # level 1 drops INFO (the machine-feature reload notices) and keeps
+    # warnings/errors.  setdefault so an operator's explicit choice wins.
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "1")
+    import logging
+
+    for name in ("jax._src.compilation_cache", "jax._src.compiler"):
+        logger = logging.getLogger(name)
+        if logger.getEffectiveLevel() < logging.WARNING:
+            logger.setLevel(logging.WARNING)
     import jax
 
     os.makedirs(directory, exist_ok=True)
@@ -38,6 +56,11 @@ def enable_compile_cache(directory) -> None:
     # cache every program, however small/fast-to-compile
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+# Back-compat alias (pre-warm-start name); new call sites use
+# configure_compilation_cache.
+enable_compile_cache = configure_compilation_cache
 
 
 def fallback_to_cpu_if_unreachable(timeout_env: str = "ICLEAN_PROBE_TIMEOUT",

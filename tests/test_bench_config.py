@@ -79,13 +79,21 @@ def test_bench_small_end_to_end_json_schema():
     # before any JSON is printed, so reaching here means masks matched)
     for key in ("fleet_n", "fleet_geometries", "fleet_platform",
                 "fleet_buckets", "fleet_compiles", "fleet_vs_sequential",
-                "fleet_per_archive_ms", "fleet_h2d_bytes"):
+                "fleet_per_archive_ms", "fleet_h2d_bytes",
+                "fleet_precompile_hits", "fleet_precompile_misses",
+                "fleet_cold_vs_warm", "fleet_warm_compiles"):
         assert key in out, key
     assert out["fleet_n"] >= 6
     assert out["fleet_buckets"] >= 2
     assert out["fleet_compiles"] == out["fleet_buckets"]
     assert out["fleet_vs_sequential"] > 0
     assert out["fleet_h2d_bytes"] > 0
+    # warm-start contract: the in-process warm passes are served from the
+    # background precompile pool, and a CLI restart over the shared
+    # --compile-cache does zero real compiles and beats the cold process
+    assert out["fleet_precompile_hits"] >= 1
+    assert out["fleet_warm_compiles"] == 0
+    assert 0 < out["fleet_cold_vs_warm"] < 1.0
 
 
 def test_profile_stages_small_end_to_end():

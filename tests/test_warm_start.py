@@ -1,0 +1,331 @@
+"""AOT warm-start tests: background bucket precompilation and its
+exactly-once compile accounting, the persistent compile cache's
+warm-restart contract through the real CLI (a fresh process re-serving
+the same fleet must do zero real compiles), and buffer-donation safety
+(donation must never change a mask, and must never delete a buffer the
+caller still owns)."""
+
+import logging
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.backends import clean_archive
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io import (
+    load_archive,
+    make_synthetic_archive,
+    save_archive,
+)
+from iterative_cleaner_tpu.parallel.batch import (
+    clean_archives_batched,
+    clear_precompile_memo,
+    precompile_batched_executable,
+)
+from iterative_cleaner_tpu.parallel.fleet import clean_fleet
+from iterative_cleaner_tpu.telemetry import MetricsRegistry
+from tests.conftest import repo_subprocess_env
+
+CFG = CleanConfig(backend="jax", rotation="roll", fft_mode="dft",
+                  dtype="float64", max_iter=3)
+
+
+def _archives(geometries, seed0=60):
+    out = []
+    for i, (nsub, nchan, nbin) in enumerate(geometries):
+        ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                       seed=seed0 + i)
+        out.append(ar)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# precompile_batched_executable: memo + accounting + result parity
+
+
+def test_precompile_executable_matches_inline_and_counts_once():
+    clear_precompile_memo()
+    archives = _archives([(10, 16, 32)] * 3)
+
+    inline_reg = MetricsRegistry()
+    inline_stats = {}
+    inline = clean_archives_batched(archives, CFG, registry=inline_reg,
+                                    stats_out=inline_stats)
+    assert inline_stats["compiles"] >= 1
+    assert not inline_stats["used_executable"]
+
+    pre_reg = MetricsRegistry()
+    pre_stats = {}
+    exe = precompile_batched_executable(
+        CFG, 10, 16, 32, False, 3, registry=pre_reg, stats_out=pre_stats)
+    assert pre_stats["fresh"]
+    assert pre_reg.counters["batch_compiles"] == 1
+
+    # serving through the AOT executable must do ZERO further compiles and
+    # reproduce the inline path's results bit-for-bit
+    serve_reg = MetricsRegistry()
+    serve_stats = {}
+    served = clean_archives_batched(archives, CFG, registry=serve_reg,
+                                    executable=exe, stats_out=serve_stats)
+    assert serve_stats["compiles"] == 0
+    assert serve_stats["used_executable"]
+    assert serve_reg.counters.get("batch_compiles", 0) == 0
+    for a, b in zip(inline, served):
+        np.testing.assert_array_equal(a.final_weights, b.final_weights)
+        assert a.loops == b.loops
+
+    # second precompile of the same geometry is a memo hit, not a compile
+    memo_reg = MetricsRegistry()
+    memo_stats = {}
+    exe2 = precompile_batched_executable(
+        CFG, 10, 16, 32, False, 3, registry=memo_reg, stats_out=memo_stats)
+    assert exe2 is exe
+    assert not memo_stats["fresh"]
+    assert memo_reg.counters.get("batch_compiles", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet: background pool counters, warm re-serve, precompile=False fallback
+
+
+def _write_fleet(tmp_path, geometries, seed0=70):
+    paths = []
+    for i, (nsub, nchan, nbin) in enumerate(geometries):
+        ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                       seed=seed0 + i)
+        p = str(tmp_path / ("warm_%02d.npz" % i))
+        save_archive(ar, p)
+        paths.append(p)
+    return paths
+
+
+def test_fleet_precompile_counters_cold_then_warm(tmp_path):
+    clear_precompile_memo()
+    geoms = [(10, 16, 32), (10, 16, 32), (14, 16, 32)]
+    paths = _write_fleet(tmp_path, geoms)
+
+    cold_reg = MetricsRegistry()
+    cold = clean_fleet(paths, CFG, registry=cold_reg, group_size=2)
+    assert not cold.failures
+    n_groups = 2                    # bucket A: 2 archives, bucket B: 1
+    assert cold_reg.counters["fleet_compiles"] == cold.n_buckets == 2
+    assert (cold_reg.counters.get("fleet_precompile_hits", 0)
+            + cold_reg.counters.get("fleet_precompile_misses", 0)) == n_groups
+
+    # same process again: every bucket executable comes out of the AOT
+    # memo — zero compiles, and the pool serves (near-)instantly
+    warm_reg = MetricsRegistry()
+    warm = clean_fleet(paths, CFG, registry=warm_reg, group_size=2)
+    assert not warm.failures
+    assert warm_reg.counters.get("fleet_compiles", 0) == 0
+    hits = warm_reg.counters.get("fleet_precompile_hits", 0)
+    misses = warm_reg.counters.get("fleet_precompile_misses", 0)
+    assert hits + misses == n_groups
+    assert hits >= n_groups - 1     # group 0 may race the pool's startup
+
+    for p in paths:
+        np.testing.assert_array_equal(cold.results[p].final_weights,
+                                      warm.results[p].final_weights)
+
+
+def test_fleet_precompile_disabled_matches(tmp_path):
+    clear_precompile_memo()
+    geoms = [(10, 16, 32), (14, 16, 32)]
+    paths = _write_fleet(tmp_path, geoms, seed0=80)
+
+    reg_off = MetricsRegistry()
+    off = clean_fleet(paths, CFG, registry=reg_off, group_size=2,
+                      precompile=False)
+    assert not off.failures
+    assert reg_off.counters.get("fleet_precompile_hits", 0) == 0
+    assert reg_off.counters.get("fleet_precompile_misses", 0) == 0
+    assert reg_off.counters["fleet_compiles"] == 2
+
+    on = clean_fleet(paths, CFG, registry=MetricsRegistry(), group_size=2)
+    for p in paths:
+        np.testing.assert_array_equal(off.results[p].final_weights,
+                                      on.results[p].final_weights)
+
+
+# ---------------------------------------------------------------------------
+# warm restart across processes (the persistent-cache contract)
+
+
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "iterative_cleaner_tpu", "-q", *args],
+        env=repo_subprocess_env(ICLEAN_PROBE_TIMEOUT="0"), cwd=cwd,
+        capture_output=True, text=True, timeout=300)
+
+
+def test_warm_restart_cli_zero_real_compiles(tmp_path):
+    """Serve the same mixed-shape fleet twice through the real CLI, two
+    fresh processes sharing one --compile-cache directory: the second run
+    must write ZERO new cache entries (every executable reloaded) and
+    produce bit-identical output masks."""
+    geoms = [(10, 16, 32), (10, 16, 32), (14, 16, 32)]
+    paths = _write_fleet(tmp_path, geoms, seed0=90)
+    cache = str(tmp_path / "cache")
+    flags = ["--fleet", "--batch", "2", "--max_iter", "3",
+             "--rotation", "roll", "--fft_mode", "dft",
+             "--compile-cache", cache]
+
+    cold = _run_cli(flags + paths, str(tmp_path))
+    assert cold.returncode == 0, cold.stderr[-2000:]
+    entries = sorted(os.listdir(cache))
+    assert entries, "cold run wrote no persistent-cache entries"
+    cold_masks = {p: load_archive(p + "_cleaned.npz").weights == 0
+                  for p in paths}
+
+    warm = _run_cli(flags + paths, str(tmp_path))
+    assert warm.returncode == 0, warm.stderr[-2000:]
+    assert sorted(os.listdir(cache)) == entries, \
+        "warm restart wrote new compile-cache entries (real compiles)"
+    for p in paths:
+        warm_mask = load_archive(p + "_cleaned.npz").weights == 0
+        np.testing.assert_array_equal(cold_masks[p], warm_mask)
+
+
+def test_precompile_cli_warms_cache(tmp_path):
+    cache = str(tmp_path / "cache")
+    proc = _run_cli(["--precompile", "--compile-cache", cache,
+                     "--max_iter", "3", "--rotation", "roll",
+                     "--fft_mode", "dft", "16x32x32"], str(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert os.listdir(cache), "precompile wrote no cache entries"
+
+
+def test_precompile_cli_requires_cache_dir(tmp_path):
+    proc = _run_cli(["--precompile", "16x32x32"], str(tmp_path))
+    assert proc.returncode == 2
+    assert "--compile-cache" in proc.stderr
+
+
+def test_parse_geometry_spec():
+    from iterative_cleaner_tpu.cli import _parse_geometry_spec
+
+    assert _parse_geometry_spec("16x32x128") == (16, 32, 128)
+    assert _parse_geometry_spec("not-a-geometry") is None
+    assert _parse_geometry_spec("16x32") is None
+    assert _parse_geometry_spec("0x32x64") is None
+
+
+# ---------------------------------------------------------------------------
+# donation safety
+
+
+def test_donation_mask_parity_engine_and_batch():
+    ar, _ = make_synthetic_archive(seed=21)
+    oracle = clean_archive(ar.clone(),
+                           CleanConfig(backend="numpy", dtype="float64"))
+    donated = clean_archive(ar.clone(),
+                            CleanConfig(backend="jax", dtype="float64",
+                                        donate_buffers=True))
+    plain = clean_archive(ar.clone(),
+                          CleanConfig(backend="jax", dtype="float64",
+                                      donate_buffers=False))
+    np.testing.assert_array_equal(oracle.final_weights, donated.final_weights)
+    np.testing.assert_array_equal(plain.final_weights, donated.final_weights)
+
+    clear_precompile_memo()
+    archives = _archives([(10, 16, 32)] * 3, seed0=30)
+    import dataclasses
+
+    on = clean_archives_batched(archives, CFG)
+    off = clean_archives_batched(
+        archives, dataclasses.replace(CFG, donate_buffers=False))
+    for a, b in zip(on, off):
+        np.testing.assert_array_equal(a.final_weights, b.final_weights)
+
+
+def test_donation_does_not_consume_caller_arrays():
+    """The donate guard: device arrays held by the caller pass through
+    jnp.asarray unchanged, so clean_cube must NOT donate them — they stay
+    readable after the call (bench_jax replays one upload for repeats)."""
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import clean_cube
+
+    ar, _ = make_synthetic_archive(seed=22, nsub=8, nchan=16, nbin=32)
+    cfg = CleanConfig(backend="jax", dtype="float64", donate_buffers=True)
+    cube = jnp.asarray(ar.total_intensity(), dtype=jnp.float64)
+    weights = jnp.asarray(ar.weights, dtype=jnp.float64)
+    host = clean_cube(ar.total_intensity(), ar.weights, ar.freqs_mhz, ar.dm,
+                      ar.centre_freq_mhz, ar.period_s, cfg)
+    dev = clean_cube(cube, weights, ar.freqs_mhz, ar.dm,
+                     ar.centre_freq_mhz, ar.period_s, cfg)
+    # caller's buffers survived (a donated buffer raises on use)
+    assert float(cube.sum()) == pytest.approx(float(np.sum(
+        np.asarray(ar.total_intensity(), dtype=np.float64))), rel=1e-12)
+    assert float(weights.sum()) == float(ar.weights.sum())
+    np.testing.assert_array_equal(host.final_weights, dev.final_weights)
+
+
+def test_donation_retrace_after_donated_call():
+    """A second call through the SAME cached jit program (donating) with
+    fresh host inputs must not touch the first call's deleted buffers."""
+    cfg = CleanConfig(backend="jax", dtype="float64", donate_buffers=True)
+    results = []
+    for seed in (23, 23):           # identical inputs, two fresh uploads
+        ar, _ = make_synthetic_archive(seed=seed, nsub=8, nchan=16, nbin=32)
+        results.append(clean_archive(ar, cfg))
+    np.testing.assert_array_equal(results[0].final_weights,
+                                  results[1].final_weights)
+
+
+def test_donation_shrinks_peak_bytes():
+    """Donation must show up in the compiled program's memory analysis:
+    a non-zero input/output alias and no larger a peak than the
+    donate-off twin (advisory gauges — skip if the backend exposes no
+    memory analysis)."""
+    import dataclasses
+
+    clear_precompile_memo()
+    on_reg = MetricsRegistry()
+    precompile_batched_executable(
+        dataclasses.replace(CFG, dtype="float32"), 16, 32, 32, False, 3,
+        registry=on_reg)
+    off_reg = MetricsRegistry()
+    precompile_batched_executable(
+        dataclasses.replace(CFG, dtype="float32", donate_buffers=False),
+        16, 32, 32, False, 3, registry=off_reg)
+    if ("batch_exec_peak_bytes" not in on_reg.gauges
+            or "batch_exec_peak_bytes" not in off_reg.gauges):
+        pytest.skip("backend exposes no memory_analysis")
+    assert on_reg.gauges["batch_exec_alias_bytes"] > 0
+    assert off_reg.gauges["batch_exec_alias_bytes"] == 0
+    assert (on_reg.gauges["batch_exec_peak_bytes"]
+            <= off_reg.gauges["batch_exec_peak_bytes"])
+
+
+# ---------------------------------------------------------------------------
+# configure_compilation_cache plumbing
+
+
+def test_configure_compilation_cache_unit(tmp_path, monkeypatch):
+    import jax
+
+    from iterative_cleaner_tpu.utils import (
+        configure_compilation_cache,
+        enable_compile_cache,
+    )
+
+    assert enable_compile_cache is configure_compilation_cache
+    monkeypatch.delenv("TF_CPP_MIN_LOG_LEVEL", raising=False)
+    cache = tmp_path / "cc"
+    try:
+        configure_compilation_cache(str(cache))
+        assert cache.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+        assert os.environ["TF_CPP_MIN_LOG_LEVEL"] == "1"
+        for name in ("jax._src.compilation_cache", "jax._src.compiler"):
+            assert (logging.getLogger(name).getEffectiveLevel()
+                    >= logging.WARNING)
+        # no-op spelling: None leaves the cache configuration untouched
+        configure_compilation_cache(None)
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
