@@ -634,6 +634,42 @@ def bench_fleet(n_archives, geometries, max_iter=3, group_size=8,
                                   out.weights == 0), \
                 f"warm CLI mask diverged from sequential (archive {i})"
 
+        # Resilience contract: the same fleet served under injected faults
+        # (a transient load failure + a synthetic device OOM on the first
+        # batched execute) must complete with ZERO failures and bit-equal
+        # masks — the retry ladder absorbs the transient, the OOM ladder
+        # splits the batch.  Keys pin that the drills actually fired.
+        from iterative_cleaner_tpu.resilience import (
+            FaultInjector,
+            ResiliencePlan,
+            RetryPolicy,
+        )
+
+        fault_reg = MetricsRegistry()
+        fault_plan = ResiliencePlan(
+            faults=FaultInjector("load:err@2,execute:oom@1", seed=1),
+            retry=RetryPolicy(max_retries=3, backoff_base_s=0.01))
+        t0 = time.perf_counter()
+        fault_rep = clean_fleet(paths, cfg, registry=fault_reg,
+                                group_size=group_size,
+                                io_workers=io_workers,
+                                resilience=fault_plan)
+        fault_dt = time.perf_counter() - t0
+        assert not fault_rep.failures, \
+            f"faulted fleet serve leaked failures: {fault_rep.failures}"
+        for i, p in enumerate(paths):
+            assert np.array_equal(seq[p].final_weights == 0,
+                                  fault_rep.results[p].final_weights == 0), \
+                f"faulted fleet mask diverged from sequential (archive {i})"
+        _log(f"fleet stage: faulted serve recovered in {fault_dt:.2f}s "
+             f"({fault_rep.n_retries} retries, "
+             f"{fault_rep.n_oom_splits} OOM splits, "
+             f"{fault_rep.n_degraded} degraded)")
+        assert fault_rep.n_retries >= 1, \
+            "injected transient load fault never retried"
+        assert fault_rep.n_oom_splits >= 1, \
+            "injected execute OOM never split the batch"
+
         return {
             "fleet_n": n_archives,
             "fleet_geometries": "+".join(
@@ -649,6 +685,8 @@ def bench_fleet(n_archives, geometries, max_iter=3, group_size=8,
             "fleet_precompile_misses": pre_misses,
             "fleet_cold_vs_warm": round(warm_serve / cold_serve, 2),
             "fleet_warm_compiles": warm_compiles,
+            "fleet_retries": fault_rep.n_retries,
+            "fleet_oom_splits": fault_rep.n_oom_splits,
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -248,6 +248,47 @@ def build_parser() -> argparse.ArgumentParser:
                              "load/write pools and the --prefetch loader "
                              "(default: ICLEAN_IO_WORKERS env var, "
                              "else 2).")
+    parser.add_argument("--retries", type=int, default=None, metavar="N",
+                        help="Fleet resilience: retry a transiently failing "
+                             "peek/load/execute/write stage up to N times "
+                             "with bounded deterministic backoff before "
+                             "failing that archive (default: ICLEAN_RETRIES "
+                             "env var, else 2; 0 disables retries).")
+    parser.add_argument("--stage-timeout", "--stage_timeout", type=float,
+                        default=None, dest="stage_timeout", metavar="S",
+                        help="Fleet resilience: per-stage watchdog deadline "
+                             "in seconds — a hung load/compile/execute/"
+                             "write attempt fails its archive(s) after S "
+                             "seconds instead of wedging the whole run "
+                             "(default: ICLEAN_STAGE_TIMEOUT env var, else "
+                             "off; 0 disables).")
+    parser.add_argument("--faults", type=str, default="", metavar="SPEC",
+                        help="Fleet fault-injection drill: deterministic "
+                             "'site:action' spec, comma-separated — sites "
+                             "peek/load/compile/execute/write; actions a "
+                             "probability ('load:0.1'), 'once', a kind "
+                             "(err|oom|perm|hang) or 'kind@N' for the Nth "
+                             "call ('exec:oom@2'). Mirrors ICLEAN_FAULTS; "
+                             "seeded by --fault-seed, so a failing soak "
+                             "replays exactly.")
+    parser.add_argument("--fault-seed", "--fault_seed", type=int, default=0,
+                        dest="fault_seed", metavar="SEED",
+                        help="Seed for --faults probability draws (default "
+                             "0; mirrors ICLEAN_FAULT_SEED).")
+    parser.add_argument("--journal", type=str, default="", metavar="PATH",
+                        help="Fleet crash-safety: append one JSON line per "
+                             "completed archive (after its atomic output "
+                             "write) to PATH, keyed by input signature and "
+                             "config hash; a later --resume run skips "
+                             "journaled work. Default with --resume: "
+                             "clean.fleet.journal.jsonl.")
+    parser.add_argument("--resume", action="store_true",
+                        help="Skip archives the --journal records as "
+                             "complete under the same config, after "
+                             "re-verifying the input file signature and "
+                             "the recorded output — a killed fleet run "
+                             "picks up where it stopped with zero "
+                             "duplicated cleans.")
     parser.add_argument("--stream", type=int, default=0, metavar="CHUNK",
                         help="Clean each archive in CHUNK-subint streaming "
                              "tiles (parallel/streaming.py) instead of one "
@@ -325,6 +366,8 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         # meaning: archives per compiled program)
         fleet_group_size=(args.batch if getattr(args, "batch", 0) > 1
                           else CleanConfig.fleet_group_size),
+        fleet_retries=getattr(args, "retries", None),
+        stage_timeout_s=getattr(args, "stage_timeout", None),
         compile_cache_dir=(getattr(args, "compile_cache", "") or None),
         donate_buffers=not getattr(args, "no_donate", False),
         unload_res=args.unload_res,
@@ -664,6 +707,14 @@ def _run_fleet(args, telemetry=None) -> list:
     import threading
 
     from iterative_cleaner_tpu.parallel.fleet import clean_fleet
+    from iterative_cleaner_tpu.resilience import (
+        FaultInjector,
+        FleetJournal,
+        ResiliencePlan,
+        RetryPolicy,
+        resolve_retries,
+        resolve_stage_timeout,
+    )
 
     cfg = config_from_args(args)
     mesh = None
@@ -688,11 +739,34 @@ def _run_fleet(args, telemetry=None) -> list:
               % ("writing" if stage == "write" else "cleaning", path,
                  type(exc).__name__, exc), file=sys.stderr)
 
-    clean_fleet(
+    journal_path = args.journal or (
+        "clean.fleet.journal.jsonl" if args.resume else "")
+    res_plan = ResiliencePlan(
+        faults=(FaultInjector(args.faults, seed=args.fault_seed)
+                if args.faults else FaultInjector.from_env()),
+        retry=RetryPolicy(max_retries=resolve_retries(cfg.fleet_retries)),
+        stage_timeout_s=resolve_stage_timeout(cfg.stage_timeout_s),
+        journal=(FleetJournal(journal_path) if journal_path else None),
+        resume=args.resume,
+    )
+
+    def default_out_path(p):
+        return p + "_cleaned" + (os.path.splitext(p)[1] or ".npz")
+
+    report = clean_fleet(
         list(args.archive), cfg, mesh=mesh,
         registry=(telemetry.registry if telemetry is not None else None),
         events=(telemetry.events if telemetry is not None else None),
-        io_workers=args.io_workers, write_fn=write_one, on_error=on_error)
+        io_workers=args.io_workers, write_fn=write_one, on_error=on_error,
+        resilience=res_plan,
+        # journal entries record the output's path+signature so a resume
+        # can re-verify it; only the default naming rule is a pure
+        # function of the input path (--output std needs the archive)
+        out_path_fn=default_out_path if args.output == "" else None)
+    if report.skipped and not args.quiet:
+        print("resumed: %d archive%s already complete in %s"
+              % (len(report.skipped),
+                 "" if len(report.skipped) == 1 else "s", journal_path))
     return failed
 
 
@@ -827,6 +901,30 @@ def main(argv=None) -> int:
     if args.io_workers is not None and args.io_workers < 1:
         build_parser().error(
             f"--io-workers must be >= 1, got {args.io_workers}")
+    if ((args.retries is not None or args.stage_timeout is not None
+         or args.faults or args.journal or args.resume)
+            and not args.fleet):
+        # the resilience ladder lives in the fleet pipeline — a silently
+        # ignored flag would mislead (same contract as --bucket-pad)
+        build_parser().error(
+            "--retries/--stage-timeout/--faults/--journal/--resume "
+            "configure the --fleet resilience ladder; pass --fleet")
+    if args.retries is not None and args.retries < 0:
+        build_parser().error(f"--retries must be >= 0, got {args.retries}")
+    if args.stage_timeout is not None and args.stage_timeout < 0:
+        build_parser().error(
+            f"--stage-timeout must be >= 0 (0 disables the watchdog), "
+            f"got {args.stage_timeout}")
+    if args.faults:
+        from iterative_cleaner_tpu.resilience import (
+            FaultSpecError,
+            parse_fault_spec,
+        )
+
+        try:
+            parse_fault_spec(args.faults)
+        except FaultSpecError as exc:
+            build_parser().error(f"--faults: {exc}")
     if args.compile_cache and args.backend != "jax":
         # numpy never compiles jax programs — a silently useless cache
         # would mislead; the other ineffective flag combos error loudly too

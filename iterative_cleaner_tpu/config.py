@@ -94,6 +94,21 @@ class CleanConfig:
     # one program.  Bounds peak host RAM at ~2 groups of archives (the
     # load pool stays one group ahead).
     fleet_group_size: int = 8
+    # per-stage retry budget for the fleet pipeline's resilience ladder
+    # (resilience/retry.py): transient peek/load/execute/write failures
+    # retry up to this many times with bounded deterministic backoff
+    # before the archive is failed.  None defers to the ICLEAN_RETRIES
+    # env var, then 2.  Retry knobs never change a surviving archive's
+    # mask, so they are excluded from the checkpoint/journal config
+    # identity.
+    fleet_retries: Optional[int] = None
+    # per-stage watchdog deadline (seconds) for fleet stage attempts: a
+    # hung load/compile/execute/write trips StageTimeout, fails that
+    # archive/group (fleet_watchdog_trips) and the fleet moves on instead
+    # of wedging — the generalization of bench.py's one-off os._exit(3)
+    # watchdog (ROUND5_NOTES' 27-minute silent wedge).  None defers to
+    # the ICLEAN_STAGE_TIMEOUT env var, then off; 0 means off.
+    stage_timeout_s: Optional[float] = None
     # persistent XLA compilation-cache directory
     # (utils.configure_compilation_cache): compiled programs are reloaded
     # across process restarts, so a warm re-serve of the same fleet pays
@@ -173,3 +188,10 @@ class CleanConfig:
         if self.fleet_group_size < 1:
             raise ValueError(
                 f"fleet_group_size must be >= 1, got {self.fleet_group_size}")
+        if self.fleet_retries is not None and self.fleet_retries < 0:
+            raise ValueError(
+                f"fleet_retries must be >= 0, got {self.fleet_retries}")
+        if self.stage_timeout_s is not None and self.stage_timeout_s < 0:
+            raise ValueError(
+                f"stage_timeout_s must be >= 0 (0/None disables the "
+                f"watchdog), got {self.stage_timeout_s}")

@@ -1,0 +1,38 @@
+"""Atomic output writes: temp file + ``os.replace``.
+
+An interrupted run (crash, ``kill -9``, a full disk mid-write) must
+never leave a truncated archive under the final name — the resilience
+journal's resume contract is "a completed output exists iff its entry
+was journaled", and a torn file under the real name would satisfy an
+existence check while carrying garbage.  Every container writer funnels
+through :func:`atomic_output`: bytes land under a per-writer temp name
+and are renamed into place only when the writer returned; readers see
+the old file or the new one, never a mixture.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def atomic_output(path: str) -> Iterator[str]:
+    """Yield a temp path next to ``path``; on clean exit, rename it over
+    ``path`` atomically; on error, remove it and re-raise.
+
+    The temp name embeds pid AND thread ident: output directories are
+    legitimately shared between racing processes (batch fan-outs) and the
+    fleet's write pool runs several threads in one process — a fixed temp
+    name would let one writer truncate another's half-written inode
+    mid-rename (same contract as the checkpoint writer's, exercised by
+    tests/test_concurrency.py).  Last ``os.replace`` wins."""
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    try:
+        yield tmp
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):  # failed mid-write: don't litter the dir
+            os.unlink(tmp)

@@ -161,26 +161,32 @@ def _unpack_header(buf: bytes):
 
 
 def save_icar(ar: Archive, path: str) -> None:
+    from iterative_cleaner_tpu.io.atomic import atomic_output
+
     header = _pack_header(ar)
     freqs = np.ascontiguousarray(ar.freqs_mhz, dtype="<f8")
     weights = np.ascontiguousarray(ar.weights, dtype="<f4")
     data = np.ascontiguousarray(ar.data, dtype="<f4")
-    if native_available():
-        lib = _load_lib()
-        rc = lib.icar_write(
-            path.encode(), header,
-            freqs.ctypes.data_as(ctypes.c_char_p),
-            weights.ctypes.data_as(ctypes.c_char_p),
-            data.ctypes.data_as(ctypes.c_char_p),
-        )
-        if rc != 0:
-            raise OSError(f"native icar_write failed with code {rc}")
-        return
-    with open(path, "wb") as f:
-        f.write(header)
-        f.write(freqs.tobytes())
-        f.write(weights.tobytes())
-        f.write(data.tobytes())
+    # both routes write to a temp name and rename into place: an
+    # interrupted writer (crash, kill -9) never leaves a torn .icar
+    # under the final name
+    with atomic_output(path) as tmp:
+        if native_available():
+            lib = _load_lib()
+            rc = lib.icar_write(
+                tmp.encode(), header,
+                freqs.ctypes.data_as(ctypes.c_char_p),
+                weights.ctypes.data_as(ctypes.c_char_p),
+                data.ctypes.data_as(ctypes.c_char_p),
+            )
+            if rc != 0:
+                raise OSError(f"native icar_write failed with code {rc}")
+        else:
+            with open(tmp, "wb") as f:
+                f.write(header)
+                f.write(freqs.tobytes())
+                f.write(weights.tobytes())
+                f.write(data.tobytes())
 
 
 def read_icar_header(path: str) -> dict:

@@ -17,6 +17,7 @@ import os
 import numpy as np
 
 from iterative_cleaner_tpu.archive import Archive
+from iterative_cleaner_tpu.io.atomic import atomic_output
 
 _META_KEYS = ("period_s", "dm", "centre_freq_mhz", "mjd_start", "mjd_end")
 
@@ -24,6 +25,9 @@ _PSRFITS_EXTS = (".sf", ".rf", ".fits", ".psrfits")
 
 
 def save_archive(ar: Archive, path: str) -> None:
+    """Write ``ar`` to ``path``, dispatching on extension.  Every built-in
+    writer is atomic (temp file + ``os.replace``): an interrupted run
+    never leaves a truncated output under the final name."""
     ext = os.path.splitext(path)[1].lower()
     if ext == ".icar":
         from iterative_cleaner_tpu.io import native
@@ -51,15 +55,19 @@ def save_archive(ar: Archive, path: str) -> None:
                 # the bindings are importable here.
                 from iterative_cleaner_tpu.io import psrchive_bridge
 
+                # not atomic: psrchive's unload owns the file handle (the
+                # bridge cannot rename what it never opened)
                 psrchive_bridge.save_ar(ar, path)
                 return
         # modern .ar archives are PSRFITS; write the standard layout
-        psrfits.save_psrfits(ar, path)
+        with atomic_output(path) as tmp:
+            psrfits.save_psrfits(ar, tmp)
         return
     # write through a file object so numpy cannot append '.npz' to a target
     # name with a different extension (the reported path must be the real one)
-    with open(path, "wb") as f:
-        _write_npz(f, ar)
+    with atomic_output(path) as tmp:
+        with open(tmp, "wb") as f:
+            _write_npz(f, ar)
 
 
 def _write_npz(f, ar: Archive) -> None:

@@ -44,6 +44,21 @@ This module is that bucketing, plus the serving pipeline around it:
    it was built (background pool or inline): the execute path reports its
    own inline compiles per call (``stats_out``) instead of diffing registry
    counters, which concurrent background compiles would corrupt.
+5. **Resilience ladder** (:mod:`iterative_cleaner_tpu.resilience`, composed
+   via a :class:`~iterative_cleaner_tpu.resilience.ResiliencePlan`):
+   transient peek/load/write failures retry with bounded deterministic
+   backoff (``fleet_retries``); every stage attempt can run under a
+   watchdog deadline that fails a hung archive instead of wedging the run
+   (``fleet_watchdog_trips``); a ``RESOURCE_EXHAUSTED`` during a group's
+   batched execute halves the batch — re-using the same geometry padding,
+   so masks stay bit-equal — down to singletons (``fleet_oom_splits``) and
+   finally degrades a still-failing singleton to the numpy backend
+   (``fleet_degraded``); and an optional crash-safe JSON-lines journal
+   records each archive's completion after its (atomic) output write, so
+   a resumed run skips finished work with zero duplicated cleans
+   (``fleet_resumed_skips``).  The deterministic fault injector
+   (``ICLEAN_FAULTS`` / ``--faults``) drills every one of these paths at
+   the named sites peek/load/compile/execute/write without hardware.
 
 Mask parity: with quantization off (``bucket_pad=(0, 0)``, the default) every
 archive's results are bit-equal to the sequential per-archive path — batch
@@ -60,6 +75,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -217,12 +233,13 @@ class BucketPrecompiler:
     genuinely broken program with data attached)."""
 
     def __init__(self, plan: FleetPlan, config: CleanConfig, *,
-                 mesh=None, registry=None) -> None:
+                 mesh=None, registry=None, faults=None) -> None:
         import concurrent.futures as cf
 
         self._config = config
         self._mesh = mesh
         self._registry = registry
+        self._faults = faults
         self._pool = cf.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="icln-precompile")
         self._futures = {
@@ -236,6 +253,11 @@ class BucketPrecompiler:
         )
 
         nsub, nchan, nbin, ded = bucket.key
+        if self._faults is not None:
+            # the "compile" fault site: a failed background compile must
+            # degrade to the inline jit path, never fail the bucket
+            self._faults.fire("compile", detail="%dx%dx%d" % (nsub, nchan,
+                                                              nbin))
         stats: Dict[str, bool] = {}
         exe = precompile_batched_executable(
             self._config, nsub, nchan, nbin, ded, bucket.batch_dim,
@@ -283,14 +305,26 @@ class BucketPrecompiler:
 @dataclasses.dataclass
 class FleetReport:
     """What :func:`clean_fleet` hands back: per-path results (cleaned
-    archives only), per-path failures with the stage they died in, and the
-    run's compile accounting."""
+    archives only), per-path failures with the stage they died in,
+    journal-resumed skips, and the run's compile/recovery accounting.
+
+    Every input path lands in exactly one of ``results`` (cleaned this
+    run), ``skipped`` (journal-verified complete from a previous run) or
+    ``failures`` — except a clean-but-unwritable archive, which keeps its
+    result AND a ``write`` failure (the clean is real; only the output is
+    missing)."""
 
     results: Dict[str, CleanResult]
     failures: List[Tuple[str, str, BaseException]]  # (path, stage, error)
+    skipped: List[str] = dataclasses.field(default_factory=list)
     n_buckets: int = 0
     n_groups: int = 0
     n_compiles: int = 0
+    # recovery accounting (mirrors the fleet_* registry counters)
+    n_retries: int = 0
+    n_oom_splits: int = 0
+    n_degraded: int = 0
+    n_watchdog_trips: int = 0
 
     @property
     def ok(self) -> bool:
@@ -333,7 +367,10 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
                 shape_fn: Optional[Callable[[str], ShapeKey]] = None,
                 on_error: Optional[Callable[[str, BaseException, str],
                                             None]] = None,
-                precompile: bool = True) -> FleetReport:
+                precompile: bool = True,
+                resilience=None,
+                out_path_fn: Optional[Callable[[str], str]] = None
+                ) -> FleetReport:
     """Serve an arbitrary archive-path list through the compiled batch path.
 
     ``bucket_pad``/``group_size`` default to the config's
@@ -359,6 +396,16 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
     :func:`~iterative_cleaner_tpu.utils.configure_compilation_cache`),
     compiled programs persist across processes and a warm restart serves
     the whole fleet with zero real compiles.
+
+    ``resilience`` (a :class:`~iterative_cleaner_tpu.resilience
+    .ResiliencePlan`) configures the recovery ladder: fault injection,
+    retry budget, watchdog deadlines, journal and resume.  The default
+    resolves the ``ICLEAN_FAULTS``/``ICLEAN_RETRIES``/
+    ``ICLEAN_STAGE_TIMEOUT`` env mirrors and the config's
+    ``fleet_retries``/``stage_timeout_s`` knobs.  With a journal,
+    ``out_path_fn(path)`` (when provided) names the output file each
+    completion entry records, so a resume can re-verify the output's
+    signature before trusting it.
     """
     import concurrent.futures as cf
 
@@ -367,8 +414,14 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
         clean_archives_batched,
         record_builder_cache_stats,
     )
+    from iterative_cleaner_tpu.resilience import (
+        ResiliencePlan,
+        entry_is_current,
+        run_with_retries,
+    )
     from iterative_cleaner_tpu.telemetry import MetricsRegistry
     from iterative_cleaner_tpu.utils import configure_compilation_cache
+    from iterative_cleaner_tpu.utils.checkpoint import config_hash
 
     configure_compilation_cache(config.compile_cache_dir)
 
@@ -380,6 +433,10 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
     load_fn = load_fn if load_fn is not None else ar_io.load_archive
     shape_fn = shape_fn if shape_fn is not None else _default_shape_fn
     reg = registry if registry is not None else MetricsRegistry()
+    res = (resilience if resilience is not None
+           else ResiliencePlan.from_env(config))
+    if res.faults is not None:
+        res.faults.bind(reg)
 
     report = FleetReport(results={}, failures=[])
 
@@ -387,12 +444,45 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
         report.failures.append((path, stage, exc))
         reg.counter_inc("fleet_failures")
         if on_error is not None:
-            on_error(path, exc, stage)
+            try:
+                on_error(path, exc, stage)
+            except Exception as cb_exc:
+                # a broken error callback must never abort the fleet on
+                # top of the failure it was reporting: swallow, log, count
+                reg.counter_inc("fleet_callback_errors")
+                print("WARNING: fleet on_error callback raised for %s: "
+                      "%s: %s" % (path, type(cb_exc).__name__, cb_exc),
+                      file=sys.stderr)
+
+    # recovery counters may arrive on a caller-shared registry with prior
+    # runs' counts; the report's n_* fields are this run's deltas
+    _RECOVERY = ("fleet_retries", "fleet_oom_splits", "fleet_degraded",
+                 "fleet_watchdog_trips")
+    base = {k: reg.counters.get(k, 0.0) for k in _RECOVERY}
+
+    cfg_hash = config_hash(config) if res.journal is not None else ""
+    pending_paths = list(paths)
+    if res.resume and res.journal is not None:
+        done = res.journal.completed(cfg_hash)
+        keep = []
+        for p in pending_paths:
+            entry = done.get(os.path.abspath(p))
+            if entry is not None and entry_is_current(entry):
+                report.skipped.append(p)
+                reg.counter_inc("fleet_resumed_skips")
+                if events is not None:
+                    events.emit("fleet_resume_skip", path=p)
+            else:
+                keep.append(p)
+        pending_paths = keep
 
     entries = []
-    for p in paths:
+    for p in pending_paths:
         try:
-            entries.append((p, shape_fn(p)))
+            entries.append((p, run_with_retries(
+                lambda p=p: shape_fn(p), stage="peek", policy=res.retry,
+                registry=reg, faults=res.faults,
+                deadline_s=res.stage_timeout_s)))
         except Exception as exc:
             fail(p, "peek", exc)
 
@@ -420,17 +510,26 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
         return report
 
     serve_t0 = time.perf_counter()
-    precompiler = (BucketPrecompiler(plan, config, mesh=mesh, registry=reg)
+    precompiler = (BucketPrecompiler(plan, config, mesh=mesh, registry=reg,
+                                     faults=res.faults)
                    if precompile else None)
     try:
         _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                       io_workers, load_fn, write_fn, clean_archives_batched,
-                      cf)
+                      cf, res, cfg_hash, out_path_fn)
     finally:
         if precompiler is not None:
             precompiler.shutdown()
     reg.gauge_set("fleet_serve_s", time.perf_counter() - serve_t0)
     report.n_compiles = int(reg.counters.get("fleet_compiles", 0.0))
+    report.n_retries = int(reg.counters.get(_RECOVERY[0], 0.0)
+                           - base[_RECOVERY[0]])
+    report.n_oom_splits = int(reg.counters.get(_RECOVERY[1], 0.0)
+                              - base[_RECOVERY[1]])
+    report.n_degraded = int(reg.counters.get(_RECOVERY[2], 0.0)
+                            - base[_RECOVERY[2]])
+    report.n_watchdog_trips = int(reg.counters.get(_RECOVERY[3], 0.0)
+                                  - base[_RECOVERY[3]])
     reg.counter_inc("fleet_cleaned", len(report.results))
     record_builder_cache_stats(reg)
     return report
@@ -438,9 +537,37 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
 
 def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                   io_workers, load_fn, write_fn, clean_archives_batched,
-                  cf) -> None:
+                  cf, res, cfg_hash, out_path_fn) -> None:
     """:func:`clean_fleet`'s pipeline body: load lookahead -> rendezvous
-    with the precompiler -> batched clean -> async write-back."""
+    with the precompiler -> batched clean (through the OOM/retry recovery
+    ladder) -> async journaled write-back."""
+    from iterative_cleaner_tpu.resilience import (
+        OOM,
+        TRANSIENT,
+        StageTimeout,
+        call_with_deadline,
+        run_with_retries,
+    )
+    from iterative_cleaner_tpu.resilience import classify_error as classify
+
+    def load_task(path: str) -> Archive:
+        return run_with_retries(
+            lambda: load_fn(path), stage="load", policy=res.retry,
+            registry=reg, faults=res.faults, deadline_s=res.stage_timeout_s)
+
+    def write_task(path: str, ar: Archive, result: CleanResult) -> None:
+        run_with_retries(
+            lambda: write_fn(path, ar, result), stage="write",
+            policy=res.retry, registry=reg, faults=res.faults,
+            deadline_s=res.stage_timeout_s)
+        if res.journal is not None:
+            # journal strictly after the (atomic) output write succeeded:
+            # a crash between the two re-cleans the archive on resume —
+            # never the reverse (a journaled path with no output)
+            res.journal.record_done(
+                path, config_hash=cfg_hash,
+                out_path=out_path_fn(path) if out_path_fn else None)
+
     with cf.ThreadPoolExecutor(max_workers=io_workers) as load_pool, \
             cf.ThreadPoolExecutor(max_workers=io_workers) as write_pool:
         pending: Dict[int, list] = {}
@@ -448,7 +575,7 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
 
         def submit_loads(gi: int) -> None:
             if gi < len(groups):
-                pending[gi] = [(it, load_pool.submit(load_fn, it.path))
+                pending[gi] = [(it, load_pool.submit(load_task, it.path))
                                for it in groups[gi][1]]
 
         submit_loads(0)
@@ -491,35 +618,107 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                 reg.counter_inc("fleet_precompile_hits" if ready
                                 else "fleet_precompile_misses")
                 reg.histogram_observe("fleet_compile_stall_s", stall_s)
-            stats: Dict[str, object] = {}
+
+            group_stats = {"compiles": 0}
+            results: List[Optional[CleanResult]] = [None] * len(loaded)
+
+            def attempt_once(idx, exe, pad_to):
+                """One batched-clean attempt over ``loaded[idx]``, fault
+                site and watchdog applied.  ``pad_to=None`` on sub-batches
+                lets the batched path re-derive mesh padding itself."""
+                stats: Dict[str, object] = {}
+
+                def run():
+                    if res.faults is not None:
+                        res.faults.fire(
+                            "execute",
+                            detail="%dx%dx%d[%d]" % (bucket.key[0],
+                                                     bucket.key[1],
+                                                     bucket.key[2],
+                                                     len(idx)))
+                    return clean_archives_batched(
+                        [padded[i] for i in idx], config, mesh,
+                        registry=reg, pad_to=pad_to,
+                        raw_shapes=[raw_shapes[i] for i in idx],
+                        executable=exe, stats_out=stats)
+
+                try:
+                    return call_with_deadline(run, res.stage_timeout_s,
+                                              "execute", registry=reg)
+                finally:
+                    group_stats["compiles"] += int(
+                        stats.get("compiles", 0) or 0)
+
+            def degrade(i):
+                """The ladder's last rung: the singleton still exhausts
+                device memory with the smallest possible program, so clean
+                it on the host.  numpy produces the same mask (the batched
+                path's parity contract) at walking pace — one slow archive
+                beats one lost archive."""
+                from iterative_cleaner_tpu import backends
+
+                _it, raw_ar = loaded[i]
+                out = call_with_deadline(
+                    lambda: backends.clean_archive(
+                        raw_ar, dataclasses.replace(config,
+                                                    backend="numpy")),
+                    res.stage_timeout_s, "execute", registry=reg)
+                reg.counter_inc("fleet_degraded")
+                return out
+
+            def serve(idx, exe, pad_to, attempt=0):
+                """Recovery ladder over ``loaded[idx]``: precompiled-exe
+                rejection retries inline (uncharged), OOM halves the batch
+                down to singletons then degrades to numpy, transients
+                retry with backoff, watchdog trips and permanents fail the
+                archives.  Fills ``results`` holes; never raises."""
+                try:
+                    out = attempt_once(idx, exe, pad_to)
+                except StageTimeout as exc:
+                    for i in idx:
+                        fail(loaded[i][0].path, "clean", exc)
+                    return
+                except Exception as exc:
+                    kind = classify(exc)
+                    if exe is not None and kind != OOM:
+                        # a precompiled executable that rejects its inputs
+                        # (layout/sharding drift vs the abstract lowering)
+                        # must degrade, not fail the group: retry through
+                        # the inline jit path, uncharged.  OOM skips this
+                        # rung — replaying the identical program inline
+                        # would exhaust the same memory again
+                        serve(idx, None, pad_to, attempt)
+                        return
+                    if kind == OOM and len(idx) > 1:
+                        # halve the batch: geometry padding is unchanged,
+                        # so every archive's mask stays bit-equal — only
+                        # the vmap lane count shrinks
+                        reg.counter_inc("fleet_oom_splits")
+                        mid = len(idx) // 2
+                        serve(idx[:mid], None, None)
+                        serve(idx[mid:], None, None)
+                        return
+                    if kind == OOM:
+                        try:
+                            results[idx[0]] = degrade(idx[0])
+                        except Exception as exc2:
+                            fail(loaded[idx[0]][0].path, "clean", exc2)
+                        return
+                    if kind == TRANSIENT and attempt < res.retry.max_retries:
+                        reg.counter_inc("fleet_retries")
+                        time.sleep(res.retry.backoff(attempt))
+                        serve(idx, None, pad_to, attempt + 1)
+                        return
+                    for i in idx:
+                        fail(loaded[i][0].path, "clean", exc)
+                    return
+                for i, r in zip(idx, out):
+                    results[i] = r
+
             t0 = time.perf_counter()
-            try:
-                results = clean_archives_batched(
-                    padded, config, mesh, registry=reg,
-                    pad_to=bucket.batch_dim, raw_shapes=raw_shapes,
-                    executable=executable, stats_out=stats)
-            except Exception as exc:
-                if executable is not None:
-                    # a precompiled executable that rejects its inputs
-                    # (layout/sharding drift vs the abstract lowering) must
-                    # degrade, not fail the group: retry through the
-                    # inline jit path once
-                    try:
-                        stats = {}
-                        results = clean_archives_batched(
-                            padded, config, mesh, registry=reg,
-                            pad_to=bucket.batch_dim, raw_shapes=raw_shapes,
-                            stats_out=stats)
-                    except Exception as exc2:
-                        for it, _ar in loaded:
-                            fail(it.path, "clean", exc2)
-                        continue
-                else:
-                    for it, _ar in loaded:
-                        fail(it.path, "clean", exc)
-                    continue
+            serve(list(range(len(loaded))), executable, bucket.batch_dim)
             dt = time.perf_counter() - t0
-            inline_compiles = int(stats.get("compiles", 0) or 0)
+            inline_compiles = group_stats["compiles"]
             if inline_compiles:
                 # inline compiles count here; background-pool compiles were
                 # already counted by the worker — never both for one
@@ -533,11 +732,14 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
             else:
                 reg.counter_inc("fleet_compile_hits")
                 reg.histogram_observe("fleet_group_execute_s", dt)
-            for (it, ar), res in zip(loaded, results):
-                report.results[it.path] = res
+            for i, (it, ar) in enumerate(loaded):
+                r = results[i]
+                if r is None:
+                    continue
+                report.results[it.path] = r
                 if write_fn is not None:
                     write_futs.append(
-                        (it, write_pool.submit(write_fn, it.path, ar, res)))
+                        (it, write_pool.submit(write_task, it.path, ar, r)))
         for it, fut in write_futs:
             try:
                 fut.result()

@@ -1,0 +1,108 @@
+"""Crash-safe JSON-lines fleet journal: the ``--resume`` substrate.
+
+One line per completed archive, appended under
+:func:`~iterative_cleaner_tpu.utils.logging.locked_append` (flock +
+O_APPEND) AFTER its output write returned — so a ``kill -9`` at any
+instant leaves at worst one torn trailing line, which the reader skips.
+Combined with the IO layer's atomic temp-file + ``os.replace`` output
+writes, "a journal entry exists" implies "the output file is complete".
+
+Entry format (one JSON object per line, sorted keys)::
+
+    {"schema": "icln-fleet-journal/1", "event": "done",
+     "path": "/abs/in.npz", "sig": "<file_signature of the input>",
+     "config": "<config_hash>",
+     "out": "/abs/in.npz_cleaned.npz", "out_sig": "<file_signature>"}
+
+``config`` is :func:`~iterative_cleaner_tpu.utils.checkpoint.config_hash`
+— a digest of the mask-identity config JSON, so a journal written under
+different cleaning parameters never satisfies a resume.  ``sig``/
+``out_sig`` are cheap header signatures (size, mtime_ns, head hash):
+a resumed run re-verifies BOTH before skipping — a rewritten input or a
+missing/truncated output re-cleans instead of being trusted
+(:func:`entry_is_current`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+SCHEMA = "icln-fleet-journal/1"
+
+
+def entry_is_current(entry: dict) -> bool:
+    """May a resume trust this 'done' entry?  The input must still match
+    its recorded signature, and a recorded output must still exist with
+    its recorded signature — anything else re-cleans."""
+    from iterative_cleaner_tpu.utils.checkpoint import file_signature
+
+    path = entry.get("path", "")
+    sig = entry.get("sig", "")
+    if not path or not sig or file_signature(path) != sig:
+        return False
+    out = entry.get("out", "")
+    if out:
+        out_sig = entry.get("out_sig", "")
+        if not os.path.exists(out):
+            return False
+        if out_sig and file_signature(out) != out_sig:
+            return False
+    return True
+
+
+class FleetJournal:
+    """Append-only completion log for one fleet output set.
+
+    Sharing one journal between concurrent fleets over disjoint path sets
+    is safe (flock'd appends, per-path keys); the reader keeps the LAST
+    entry per path, so re-cleans of a changed input supersede."""
+
+    def __init__(self, path: str) -> None:
+        self.path = os.path.abspath(path)
+
+    def record_done(self, in_path: str, *, config_hash: str,
+                    out_path: Optional[str] = None) -> None:
+        """Append one completion entry; signatures are taken now, i.e.
+        after the (atomic) output write landed."""
+        from iterative_cleaner_tpu.utils.checkpoint import file_signature
+        from iterative_cleaner_tpu.utils.logging import locked_append
+
+        entry = {
+            "schema": SCHEMA,
+            "event": "done",
+            "path": os.path.abspath(in_path),
+            "sig": file_signature(in_path),
+            "config": config_hash,
+        }
+        if out_path:
+            entry["out"] = os.path.abspath(out_path)
+            entry["out_sig"] = file_signature(out_path)
+        locked_append(self.path, json.dumps(entry, sort_keys=True) + "\n")
+
+    def completed(self, config_hash: str) -> Dict[str, dict]:
+        """abs-path -> last 'done' entry recorded under this config hash.
+        Unparseable lines (the torn tail of a killed writer) and entries
+        from other configs/schemas are skipped, never fatal."""
+        out: Dict[str, dict] = {}
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(entry, dict):
+                    continue
+                if (entry.get("schema") != SCHEMA
+                        or entry.get("event") != "done"
+                        or entry.get("config") != config_hash
+                        or not entry.get("path")):
+                    continue
+                out[entry["path"]] = entry
+        return out

@@ -1,0 +1,164 @@
+"""Staged retries, error classification and watchdog deadlines.
+
+The fleet pipeline's stages (peek/load/compile/execute/write) fail in
+three distinct ways that want three distinct answers:
+
+- **transient** (an IO hiccup, a flaky filesystem, an injected drill
+  fault): retry with bounded deterministic backoff — no jitter, this is
+  one host draining its own queue, and determinism is what makes the
+  fault-injection soak reproducible;
+- **permanent** (a corrupt archive, a shape that contradicts its header
+  — ``ValueError``/``TypeError`` territory): fail the archive
+  immediately, retrying would only repeat the parse;
+- **resource exhaustion** (``XlaRuntimeError: RESOURCE_EXHAUSTED`` or the
+  injector's synthetic twin): raised through to the caller — the execute
+  path answers OOM structurally (batch-halving, then numpy degradation),
+  not by replaying the same oversized program.
+
+A hung stage is none of these: it never raises.  ROUND5_NOTES records a
+27-minute silent wedge that only bench.py's ad-hoc ``os._exit(3)``
+watchdog caught; :func:`call_with_deadline` generalizes that into a
+per-stage deadline that fails the archive (``StageTimeout``, counted as
+``fleet_watchdog_trips``) instead of taking the process down.  The
+abandoned attempt keeps running on a daemon thread — Python cannot kill
+a thread — but the pipeline moves on and the interpreter can still exit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+TRANSIENT = "transient"
+PERMANENT = "permanent"
+OOM = "oom"
+TIMEOUT = "timeout"
+
+# Exception types whose retry would deterministically repeat the failure:
+# bad values, bad types, broken invariants.  Everything else (OSError,
+# RuntimeError, injected transients) is worth the bounded retry budget.
+_PERMANENT_TYPES = (ValueError, TypeError, NotImplementedError,
+                    AssertionError, KeyError, AttributeError, EOFError)
+
+
+class StageTimeout(RuntimeError):
+    """A stage attempt exceeded its watchdog deadline."""
+
+
+def classify_error(exc: BaseException) -> str:
+    """``oom`` | ``timeout`` | ``permanent`` | ``transient``.
+
+    OOM is recognised by message — jaxlib raises ``XlaRuntimeError``
+    whose repr starts with the gRPC-style ``RESOURCE_EXHAUSTED:`` code
+    (and some platforms say "out of memory"); the injector's
+    :class:`~iterative_cleaner_tpu.resilience.faults.SyntheticResourceExhausted`
+    carries the same marker so drills exercise the identical route."""
+    if isinstance(exc, StageTimeout):
+        return TIMEOUT
+    msg = str(exc)
+    if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+        return OOM
+    if isinstance(exc, _PERMANENT_TYPES):
+        return PERMANENT
+    return TRANSIENT
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded deterministic backoff: attempt k sleeps
+    ``min(cap, base * factor**k)`` — 50ms, 100ms, 200ms ... by default."""
+
+    max_retries: int = 2
+    backoff_base_s: float = 0.05
+    backoff_factor: float = 2.0
+    backoff_cap_s: float = 2.0
+
+    def __post_init__(self) -> None:
+        if self.max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {self.max_retries}")
+        if self.backoff_base_s < 0 or self.backoff_cap_s < 0:
+            raise ValueError("backoff times must be >= 0")
+        if self.backoff_factor < 1.0:
+            raise ValueError(
+                f"backoff_factor must be >= 1, got {self.backoff_factor}")
+
+    def backoff(self, attempt: int) -> float:
+        """Sleep before retry ``attempt`` (0-based)."""
+        return min(self.backoff_cap_s,
+                   self.backoff_base_s * self.backoff_factor ** attempt)
+
+
+def call_with_deadline(fn: Callable[[], object],
+                       timeout_s: Optional[float],
+                       stage: str,
+                       registry=None):
+    """Run ``fn`` under a watchdog deadline.
+
+    ``timeout_s`` None/0 runs inline (zero overhead — the default).
+    Otherwise ``fn`` runs on a daemon thread and a deadline overrun
+    raises :class:`StageTimeout` (counting ``fleet_watchdog_trips``); the
+    overrunning attempt is abandoned, not interrupted — its thread is a
+    daemon so a wedged C call can never block interpreter exit the way
+    the ROUND5 streaming stall blocked the whole bench."""
+    if not timeout_s:
+        return fn()
+    done = threading.Event()
+    box: dict = {}
+
+    def run() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            box["error"] = exc
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=run, daemon=True,
+                              name=f"icln-deadline-{stage}")
+    worker.start()
+    if not done.wait(timeout_s):
+        if registry is not None:
+            registry.counter_inc("fleet_watchdog_trips")
+        raise StageTimeout(
+            f"{stage} stage exceeded its {timeout_s:g}s watchdog deadline")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def run_with_retries(fn: Callable[[], object], *, stage: str,
+                     policy: RetryPolicy, registry=None, faults=None,
+                     site: Optional[str] = None,
+                     deadline_s: Optional[float] = None,
+                     sleep: Callable[[float], None] = time.sleep):
+    """The per-stage resilience ladder for peek/load/write (execute has
+    its own OOM-splitting ladder in the fleet module).
+
+    Each attempt optionally fires the fault injector at ``site`` and runs
+    under the watchdog deadline.  Transient errors retry up to
+    ``policy.max_retries`` times (counting ``fleet_retries``); permanent
+    errors, OOM and watchdog trips propagate immediately."""
+    site = site or stage
+    attempt = 0
+    while True:
+        def guarded():
+            if faults is not None:
+                faults.fire(site)
+            return fn()
+
+        try:
+            return call_with_deadline(guarded, deadline_s, stage,
+                                      registry=registry)
+        except StageTimeout:
+            raise
+        except Exception as exc:
+            if classify_error(exc) != TRANSIENT \
+                    or attempt >= policy.max_retries:
+                raise
+            if registry is not None:
+                registry.counter_inc("fleet_retries")
+            sleep(policy.backoff(attempt))
+            attempt += 1

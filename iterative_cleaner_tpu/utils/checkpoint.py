@@ -17,7 +17,6 @@ import dataclasses
 import hashlib
 import json
 import os
-import threading
 import zipfile
 from typing import Optional, Tuple
 
@@ -32,8 +31,11 @@ FORMAT_VERSION = 1
 # config fields that affect the cleaning mask (identity of a run); knobs that
 # only change implementation (median_impl, backend dtype aside) still matter
 # for bit-parity bookkeeping, so everything is included except output-only
-# flags.
-_IDENTITY_EXCLUDE = {"unload_res", "record_history"}
+# flags and the resilience knobs (retry budgets and watchdog deadlines only
+# change whether a faulted run survives, never what a surviving archive's
+# mask is — a resume under a different --retries must still match).
+_IDENTITY_EXCLUDE = {"unload_res", "record_history",
+                     "fleet_retries", "stage_timeout_s"}
 
 
 def config_identity(config: CleanConfig) -> str:
@@ -41,6 +43,14 @@ def config_identity(config: CleanConfig) -> str:
     for k in _IDENTITY_EXCLUDE:
         d.pop(k, None)
     return json.dumps(d, sort_keys=True)
+
+
+def config_hash(config: CleanConfig) -> str:
+    """Compact (8-byte hex) digest of :func:`config_identity` — the fleet
+    journal's per-line config key (the full identity JSON would bloat
+    every journal line ~10x for no extra discrimination)."""
+    return hashlib.blake2b(config_identity(config).encode(),
+                           digest_size=8).hexdigest()
 
 
 def file_signature(path: str) -> str:
@@ -109,21 +119,15 @@ def save_clean_checkpoint(path: str, result: CleanResult,
         arrays["weight_history"] = result.weight_history
     if result.iter_metrics is not None:
         arrays["iter_metrics"] = np.asarray(result.iter_metrics)
-    # per-writer tmp name: checkpoint dirs are legitimately shared between
-    # racing processes (batch fan-out), and a FIXED tmp name would let one
-    # writer truncate/steal another's half-written inode mid-rename
-    # (exercised by tests/test_concurrency.py); the thread ident covers
-    # same-process library callers saving one path from several threads,
-    # which the PID alone would not; last os.replace wins and every rename
-    # is atomic, so readers never see a torn file
-    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
-    try:
+    # per-writer temp + os.replace (io/atomic.py): checkpoint dirs are
+    # legitimately shared between racing processes (batch fan-out) and
+    # same-process threads; last rename wins and every rename is atomic,
+    # so readers never see a torn file (tests/test_concurrency.py)
+    from iterative_cleaner_tpu.io.atomic import atomic_output
+
+    with atomic_output(path) as tmp:
         with open(tmp, "wb") as f:
             np.savez_compressed(f, **arrays)
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):  # failed mid-write: don't litter the dir
-            os.unlink(tmp)
 
 
 def load_clean_checkpoint(path: str) -> Tuple[CleanResult, str, str]:

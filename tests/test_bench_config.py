@@ -81,7 +81,8 @@ def test_bench_small_end_to_end_json_schema():
                 "fleet_buckets", "fleet_compiles", "fleet_vs_sequential",
                 "fleet_per_archive_ms", "fleet_h2d_bytes",
                 "fleet_precompile_hits", "fleet_precompile_misses",
-                "fleet_cold_vs_warm", "fleet_warm_compiles"):
+                "fleet_cold_vs_warm", "fleet_warm_compiles",
+                "fleet_retries", "fleet_oom_splits"):
         assert key in out, key
     assert out["fleet_n"] >= 6
     assert out["fleet_buckets"] >= 2
@@ -94,6 +95,11 @@ def test_bench_small_end_to_end_json_schema():
     assert out["fleet_precompile_hits"] >= 1
     assert out["fleet_warm_compiles"] == 0
     assert 0 < out["fleet_cold_vs_warm"] < 1.0
+    # resilience contract: the fault sub-run's injected transient and
+    # synthetic OOM both fired and were recovered (rc 0 + bit-equal
+    # masks were already asserted inside bench_fleet)
+    assert out["fleet_retries"] >= 1
+    assert out["fleet_oom_splits"] >= 1
 
 
 def test_profile_stages_small_end_to_end():

@@ -1,0 +1,585 @@
+"""Resilience-ladder tests (resilience/ + parallel/fleet.py wiring):
+fault-injector units, error classification, retry/backoff, watchdog
+deadlines, journal semantics, atomic output writes, a seeded multi-site
+fault soak with bit-equal masks and exactly-once accounting, OOM
+degradation to the numpy backend, journaled resume (in-process and after
+a real ``kill -9``), and the CLI flag contracts."""
+
+import dataclasses
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io import (
+    load_archive,
+    make_synthetic_archive,
+    save_archive,
+)
+from iterative_cleaner_tpu.io.atomic import atomic_output
+from iterative_cleaner_tpu.parallel.fleet import clean_fleet
+from iterative_cleaner_tpu.resilience import (
+    OOM,
+    PERMANENT,
+    TIMEOUT,
+    TRANSIENT,
+    FaultInjector,
+    FaultSpecError,
+    FleetJournal,
+    InjectedFault,
+    InjectedPermanentFault,
+    ResiliencePlan,
+    RetryPolicy,
+    StageTimeout,
+    SyntheticResourceExhausted,
+    call_with_deadline,
+    classify_error,
+    entry_is_current,
+    parse_fault_spec,
+    resolve_retries,
+    resolve_stage_timeout,
+    run_with_retries,
+)
+from iterative_cleaner_tpu.telemetry import MetricsRegistry
+from iterative_cleaner_tpu.utils.checkpoint import config_hash
+from tests.conftest import repo_subprocess_env
+
+CFG = CleanConfig(backend="jax", rotation="roll", fft_mode="dft",
+                  dtype="float64", max_iter=3)
+# a fast policy for tests that exercise retries: real backoff times would
+# dominate the suite
+FAST = RetryPolicy(max_retries=3, backoff_base_s=0.001, backoff_cap_s=0.01)
+
+
+def _write_fleet(tmp_path, geometries, ext=".npz"):
+    paths = []
+    for i, (nsub, nchan, nbin) in enumerate(geometries):
+        ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                       seed=40 + i)
+        p = str(tmp_path / ("fleet_%02d%s" % (i, ext)))
+        save_archive(ar, p)
+        paths.append(p)
+    return paths
+
+
+# ------------------------------------------------------------- fault spec
+
+def test_parse_fault_spec_grammar():
+    rules = parse_fault_spec("load:0.1,exec:oom@2,write:once,compile:err,"
+                             "peek:perm@3,execute:hang@1")
+    by = {(r.site, r.kind): r for r in rules}
+    assert by[("load", "err")].prob == pytest.approx(0.1)
+    assert by[("execute", "oom")].at == 2          # exec aliases execute
+    assert by[("write", "err")].at == 1            # once == err@1
+    assert by[("compile", "err")].at == 0          # bare kind: every call
+    assert by[("peek", "perm")].at == 3
+    assert by[("execute", "hang")].at == 1
+    assert parse_fault_spec("") == ()
+    assert parse_fault_spec(" , ") == ()
+
+
+@pytest.mark.parametrize("bad", [
+    "load", "load:", "bogus:err", "load:maybe", "load:2.0", "load:0",
+    "load:err@0", "load:err@x", "load:0.5@2",
+])
+def test_parse_fault_spec_rejects(bad):
+    with pytest.raises(FaultSpecError):
+        parse_fault_spec(bad)
+
+
+def test_injector_at_n_fires_exactly_once():
+    inj = FaultInjector("load:err@3", seed=0)
+    inj.fire("load")
+    inj.fire("load")
+    with pytest.raises(InjectedFault):
+        inj.fire("load")
+    inj.fire("load")                               # call 4: rule is spent
+    assert inj.calls["load"] == 4
+    assert inj.injected["load"] == 1
+
+
+def test_injector_kinds_and_counters():
+    reg = MetricsRegistry()
+    inj = FaultInjector("load:oom@1,write:perm@1,peek:hang@1",
+                        seed=0, hang_s=0.01, registry=reg)
+    with pytest.raises(SyntheticResourceExhausted) as ei:
+        inj.fire("load")
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    with pytest.raises(InjectedPermanentFault):
+        inj.fire("write")
+    t0 = time.perf_counter()
+    inj.fire("peek")                               # hang: sleeps, no raise
+    assert time.perf_counter() - t0 >= 0.01
+    assert reg.counters["fault_injected"] == 3
+
+
+def test_injector_probability_draws_are_functional():
+    # same (seed, site, kind, call index) -> same verdict, whatever order
+    # racing workers reach their calls in; a different seed reshuffles
+    def verdicts(seed):
+        inj = FaultInjector("load:0.5", seed=seed)
+        out = []
+        for _ in range(64):
+            try:
+                inj.fire("load")
+                out.append(False)
+            except InjectedFault:
+                out.append(True)
+        return out
+
+    a, b = verdicts(7), verdicts(7)
+    assert a == b
+    assert any(a) and not all(a)
+    assert verdicts(8) != a
+
+
+def test_injector_from_env(monkeypatch):
+    monkeypatch.delenv("ICLEAN_FAULTS", raising=False)
+    assert FaultInjector.from_env() is None
+    monkeypatch.setenv("ICLEAN_FAULTS", "load:err@1")
+    monkeypatch.setenv("ICLEAN_FAULT_SEED", "9")
+    inj = FaultInjector.from_env()
+    assert inj is not None and inj.seed == 9
+    plan = ResiliencePlan.from_env(CFG)
+    assert plan.faults is not None
+
+
+# ----------------------------------------------------- classify and retry
+
+def test_classify_error():
+    assert classify_error(SyntheticResourceExhausted(
+        "RESOURCE_EXHAUSTED: injected")) == OOM
+    assert classify_error(RuntimeError(
+        "XlaRuntimeError: RESOURCE_EXHAUSTED: Out of memory")) == OOM
+    assert classify_error(RuntimeError("device out of memory")) == OOM
+    assert classify_error(StageTimeout("t")) == TIMEOUT
+    assert classify_error(ValueError("corrupt")) == PERMANENT
+    assert classify_error(InjectedPermanentFault("x")) == PERMANENT
+    assert classify_error(OSError("flaky fs")) == TRANSIENT
+    assert classify_error(InjectedFault("x")) == TRANSIENT
+
+
+def test_retry_policy_backoff_bounded():
+    pol = RetryPolicy(max_retries=5, backoff_base_s=0.05,
+                      backoff_factor=2.0, backoff_cap_s=0.15)
+    assert [pol.backoff(k) for k in range(4)] == \
+        pytest.approx([0.05, 0.10, 0.15, 0.15])
+    with pytest.raises(ValueError):
+        RetryPolicy(max_retries=-1)
+    with pytest.raises(ValueError):
+        RetryPolicy(backoff_factor=0.5)
+
+
+def test_run_with_retries_absorbs_transients():
+    reg = MetricsRegistry()
+    slept = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = run_with_retries(flaky, stage="load", policy=FAST, registry=reg,
+                           sleep=slept.append)
+    assert out == "ok" and calls["n"] == 3
+    assert reg.counters["fleet_retries"] == 2
+    assert slept == pytest.approx([FAST.backoff(0), FAST.backoff(1)])
+
+
+def test_run_with_retries_permanent_and_oom_propagate():
+    for exc in (ValueError("corrupt"),
+                SyntheticResourceExhausted("RESOURCE_EXHAUSTED: x")):
+        calls = {"n": 0}
+
+        def once(exc=exc):
+            calls["n"] += 1
+            raise exc
+
+        with pytest.raises(type(exc)):
+            run_with_retries(once, stage="load", policy=FAST,
+                             sleep=lambda s: None)
+        assert calls["n"] == 1                    # never retried
+
+
+def test_run_with_retries_budget_exhausts():
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("transient")
+
+    with pytest.raises(OSError):
+        run_with_retries(always, stage="load",
+                         policy=RetryPolicy(max_retries=2,
+                                            backoff_base_s=0.0),
+                         sleep=lambda s: None)
+    assert calls["n"] == 3                        # 1 try + 2 retries
+
+
+def test_call_with_deadline():
+    assert call_with_deadline(lambda: 5, None, "x") == 5
+    assert call_with_deadline(lambda: 5, 0, "x") == 5   # 0 = off, inline
+    reg = MetricsRegistry()
+    with pytest.raises(StageTimeout):
+        call_with_deadline(lambda: time.sleep(2.0), 0.05, "execute",
+                           registry=reg)
+    assert reg.counters["fleet_watchdog_trips"] == 1
+    with pytest.raises(KeyError):                 # errors pass through
+        call_with_deadline(lambda: {}[1], 1.0, "x")
+
+
+def test_resolve_env_mirrors(monkeypatch):
+    monkeypatch.delenv("ICLEAN_RETRIES", raising=False)
+    monkeypatch.delenv("ICLEAN_STAGE_TIMEOUT", raising=False)
+    assert resolve_retries() == 2
+    assert resolve_retries(5) == 5
+    assert resolve_stage_timeout() is None
+    assert resolve_stage_timeout(0) is None
+    assert resolve_stage_timeout(1.5) == 1.5
+    monkeypatch.setenv("ICLEAN_RETRIES", "7")
+    monkeypatch.setenv("ICLEAN_STAGE_TIMEOUT", "2.5")
+    assert resolve_retries() == 7
+    assert resolve_stage_timeout() == 2.5
+    assert resolve_retries(1) == 1                # explicit beats env
+    with pytest.raises(ValueError):
+        resolve_retries(-1)
+    with pytest.raises(ValueError):
+        resolve_stage_timeout(-1.0)
+
+
+# ---------------------------------------------------------------- journal
+
+def test_journal_roundtrip_and_staleness(tmp_path):
+    paths = _write_fleet(tmp_path, [(6, 16, 32), (8, 16, 32)])
+    out = str(tmp_path / "out.npz")
+    save_archive(load_archive(paths[0]), out)
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    h = config_hash(CFG)
+    j.record_done(paths[0], config_hash=h, out_path=out)
+    j.record_done(paths[1], config_hash=h)
+    done = j.completed(h)
+    assert set(done) == {os.path.abspath(p) for p in paths}
+    assert all(entry_is_current(e) for e in done.values())
+    # a different config hash sees nothing
+    assert j.completed("feedbeef") == {}
+    # rewritten input -> stale
+    ar, _ = make_synthetic_archive(nsub=6, nchan=16, nbin=32, seed=99)
+    save_archive(ar, paths[0])
+    assert not entry_is_current(j.completed(h)[os.path.abspath(paths[0])])
+    # missing recorded output -> stale
+    j.record_done(paths[0], config_hash=h, out_path=out)
+    os.remove(out)
+    assert not entry_is_current(j.completed(h)[os.path.abspath(paths[0])])
+
+
+def test_journal_skips_torn_tail(tmp_path):
+    paths = _write_fleet(tmp_path, [(6, 16, 32)])
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    h = config_hash(CFG)
+    j.record_done(paths[0], config_hash=h)
+    with open(j.path, "a") as f:
+        f.write('{"schema": "icln-fleet-journal/1", "event": "done", "pa')
+    done = j.completed(h)                          # torn line: skipped
+    assert set(done) == {os.path.abspath(paths[0])}
+    # config identity excludes the resilience knobs: a resume under a
+    # different retry budget still matches
+    assert config_hash(dataclasses.replace(
+        CFG, fleet_retries=9, stage_timeout_s=1.0)) == h
+
+
+def test_atomic_output_never_leaves_partials(tmp_path):
+    path = str(tmp_path / "out.bin")
+    with open(path, "wb") as f:
+        f.write(b"old")
+    with pytest.raises(RuntimeError):
+        with atomic_output(path) as tmp:
+            with open(tmp, "wb") as f:
+                f.write(b"partial")
+            raise RuntimeError("crash mid-write")
+    assert open(path, "rb").read() == b"old"       # target untouched
+    with atomic_output(path) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(b"new")
+    assert open(path, "rb").read() == b"new"
+    assert glob.glob(str(tmp_path / "*.tmp")) == []
+    ar, _ = make_synthetic_archive(nsub=4, nchan=8, nbin=16, seed=1)
+    for ext in (".npz", ".icar"):
+        save_archive(ar, str(tmp_path / ("a" + ext)))
+    assert glob.glob(str(tmp_path / "*.tmp")) == []
+
+
+# ----------------------------------------------------------- fleet wiring
+
+def test_on_error_callback_exception_is_swallowed(tmp_path):
+    paths = _write_fleet(tmp_path, [(6, 16, 32), (8, 16, 32)])
+    reg = MetricsRegistry()
+
+    def bad_callback(path, exc, stage):
+        raise RuntimeError("broken telemetry hook")
+
+    rep = clean_fleet(paths + [str(tmp_path / "missing.npz")], CFG,
+                      registry=reg, io_workers=1, on_error=bad_callback,
+                      resilience=ResiliencePlan(retry=FAST))
+    assert len(rep.results) == 2                   # fleet survived
+    assert len(rep.failures) == 1
+    assert reg.counters["fleet_callback_errors"] == 1
+
+
+def test_fault_soak_bit_equal_and_exactly_once(tmp_path):
+    """12 mixed-geometry archives under deterministic faults at every
+    site: the run terminates well inside a global deadline, recovers
+    every archive, accounts each path exactly once, and all surviving
+    masks are bit-equal to the fault-free serve."""
+    geoms = [(6 + 2 * (i % 3), 16, 32) for i in range(12)]
+    paths = _write_fleet(tmp_path, geoms)
+    base = clean_fleet(paths, CFG, io_workers=1, group_size=2)
+    assert base.ok
+
+    inj = FaultInjector(
+        "peek:err@3,load:err@2,load:err@7,compile:err@1,"
+        "execute:err@2,execute:oom@4,write:err@3", seed=0)
+    jpath = str(tmp_path / "soak.jsonl")
+    reg = MetricsRegistry()
+    plan = ResiliencePlan(faults=inj, retry=FAST,
+                          journal=FleetJournal(jpath))
+    wrote = []
+    lock = threading.Lock()
+
+    def write_fn(path, ar, result):
+        with lock:
+            wrote.append(path)
+
+    rep = call_with_deadline(
+        lambda: clean_fleet(paths, CFG, registry=reg, io_workers=1,
+                            group_size=2, resilience=plan,
+                            write_fn=write_fn),
+        60.0, "soak")                              # the no-hang guarantee
+    assert rep.ok, rep.failures
+    # exactly-once: every path lands in exactly one bucket of the report
+    assert sorted(rep.results) == sorted(paths)
+    assert rep.skipped == [] and rep.failures == []
+    assert sorted(wrote) == sorted(paths)          # one write per archive
+    assert len(plan.journal.completed(config_hash(CFG))) == len(paths)
+    # the drills actually fired and were absorbed
+    assert reg.counters["fault_injected"] >= 6
+    assert rep.n_retries >= 4                      # peek+load+exec+write
+    assert rep.n_oom_splits >= 1
+    assert rep.n_degraded == 0                     # splits absorbed the OOM
+    for p in paths:
+        assert np.array_equal(base.results[p].final_weights,
+                              rep.results[p].final_weights), p
+
+
+def test_oom_degrades_to_numpy_bit_equal(tmp_path):
+    """Every execute OOMs: the ladder splits to singletons, the singleton
+    still OOMs, and each archive degrades to the numpy backend — same
+    masks, nothing lost."""
+    paths = _write_fleet(tmp_path, [(6, 16, 32), (8, 16, 32),
+                                    (6, 16, 32)])
+    base = clean_fleet(paths, CFG, io_workers=1, group_size=2)
+    reg = MetricsRegistry()
+    rep = clean_fleet(paths, CFG, registry=reg, io_workers=1, group_size=2,
+                      resilience=ResiliencePlan(
+                          faults=FaultInjector("execute:oom", seed=0),
+                          retry=FAST))
+    assert rep.ok, rep.failures
+    assert rep.n_degraded == len(paths)
+    assert rep.n_oom_splits >= 1
+    assert reg.counters["fleet_degraded"] == len(paths)
+    for p in paths:
+        assert np.array_equal(base.results[p].final_weights,
+                              rep.results[p].final_weights), p
+
+
+def test_watchdog_fails_hung_execute(tmp_path):
+    paths = _write_fleet(tmp_path, [(6, 16, 32), (6, 16, 32)])
+    reg = MetricsRegistry()
+    rep = clean_fleet(paths, CFG, registry=reg, io_workers=1, group_size=2,
+                      resilience=ResiliencePlan(
+                          faults=FaultInjector("execute:hang@1", seed=0,
+                                               hang_s=1.5),
+                          retry=FAST, stage_timeout_s=0.2))
+    assert rep.n_watchdog_trips >= 1
+    assert reg.counters["fleet_watchdog_trips"] >= 1
+    # the hung group failed, the fleet did not wedge: every path is
+    # accounted (hang@1 wedges the single group both archives share)
+    assert {p for p, stage, _ in rep.failures} == set(paths)
+    assert all(stage == "clean" for _, stage, _ in rep.failures)
+    assert isinstance(rep.failures[0][2], StageTimeout)
+
+
+def test_write_failure_keeps_result_and_failure(tmp_path):
+    paths = _write_fleet(tmp_path, [(6, 16, 32)])
+
+    def write_fn(path, ar, result):
+        raise InjectedPermanentFault("disk full")  # permanent: no retries
+
+    rep = clean_fleet(paths, CFG, io_workers=1,
+                      resilience=ResiliencePlan(retry=FAST),
+                      write_fn=write_fn)
+    # the clean is real, only the output is missing: both recorded
+    assert paths[0] in rep.results
+    assert [(p, s) for p, s, _ in rep.failures] == [(paths[0], "write")]
+
+
+def test_resume_skips_journaled_and_recleans_modified(tmp_path):
+    paths = _write_fleet(tmp_path, [(6, 16, 32), (8, 16, 32),
+                                    (6, 16, 32)])
+    jpath = str(tmp_path / "j.jsonl")
+
+    def out_path(p):
+        return p + "_cleaned.npz"
+
+    def write_fn(p, ar, result):
+        out = dataclasses.replace(
+            ar, weights=np.asarray(result.final_weights,
+                                   dtype=ar.weights.dtype))
+        save_archive(out, out_path(p))
+
+    plan = ResiliencePlan(retry=FAST, journal=FleetJournal(jpath))
+    rep1 = clean_fleet(paths, CFG, io_workers=1, group_size=2,
+                       resilience=plan, write_fn=write_fn,
+                       out_path_fn=out_path)
+    assert rep1.ok and len(rep1.results) == 3
+
+    # resume over an untouched fleet: everything skips, nothing re-cleans
+    reg = MetricsRegistry()
+    rep2 = clean_fleet(paths, CFG, registry=reg, io_workers=1, group_size=2,
+                       resilience=ResiliencePlan(
+                           retry=FAST, journal=FleetJournal(jpath),
+                           resume=True),
+                       write_fn=write_fn, out_path_fn=out_path)
+    assert rep2.ok and rep2.results == {}
+    assert sorted(rep2.skipped) == sorted(paths)
+    assert reg.counters["fleet_resumed_skips"] == 3
+
+    # a rewritten input invalidates only its own entry
+    ar, _ = make_synthetic_archive(nsub=6, nchan=16, nbin=32, seed=77)
+    save_archive(ar, paths[1])
+    rep3 = clean_fleet(paths, CFG, io_workers=1, group_size=2,
+                       resilience=ResiliencePlan(
+                           retry=FAST, journal=FleetJournal(jpath),
+                           resume=True),
+                       write_fn=write_fn, out_path_fn=out_path)
+    assert rep3.ok
+    assert list(rep3.results) == [paths[1]]
+    assert sorted(rep3.skipped) == sorted([paths[0], paths[2]])
+    # a resume under a different config hash trusts nothing
+    rep4 = clean_fleet(paths, dataclasses.replace(CFG, max_iter=2),
+                       io_workers=1, group_size=2,
+                       resilience=ResiliencePlan(
+                           retry=FAST, journal=FleetJournal(jpath),
+                           resume=True))
+    assert rep4.skipped == []
+
+
+# ----------------------------------------------------- CLI and kill-resume
+
+def test_cli_resilience_flags_require_fleet():
+    from iterative_cleaner_tpu.cli import main
+
+    for argv in (["--resume", "x.npz"],
+                 ["--retries", "3", "x.npz"],
+                 ["--stage-timeout", "5", "x.npz"],
+                 ["--faults", "load:once", "x.npz"],
+                 ["--journal", "j.jsonl", "x.npz"]):
+        with pytest.raises(SystemExit) as ei:
+            main(argv)
+        assert ei.value.code == 2
+    with pytest.raises(SystemExit) as ei:
+        main(["--fleet", "--faults", "bogus:xyz", "x.npz"])
+    assert ei.value.code == 2
+    with pytest.raises(SystemExit) as ei:
+        main(["--fleet", "--retries", "-1", "x.npz"])
+    assert ei.value.code == 2
+
+
+def _run_cli(args, tmp_path, **env):
+    return subprocess.run(
+        [sys.executable, "-m", "iterative_cleaner_tpu", *args],
+        env=repo_subprocess_env(ICLEAN_PROBE_TIMEOUT="0", **env),
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=240)
+
+
+def test_kill9_then_resume_no_duplicate_cleans(tmp_path):
+    """The crash-safety contract end-to-end through the real CLI: wedge a
+    fleet run mid-serve with a hang fault, ``kill -9`` it, rerun with
+    ``--resume`` — every archive cleans exactly once across the two runs
+    and the final outputs are byte-identical to an uninterrupted serve.
+    ``.icar`` outputs are raw little-endian arrays (no container
+    timestamps), so byte comparison is exact."""
+    geoms = [(6, 16, 32)] * 8
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    paths = _write_fleet(tmp_path, geoms, ext=".icar")
+    ref_paths = _write_fleet(ref_dir, geoms, ext=".icar")
+    base = ["--fleet", "--batch", "2", "--io-workers", "1",
+            "--rotation", "roll", "--fft_mode", "dft", "--max_iter", "3",
+            "-q"]
+
+    # reference: one uninterrupted run
+    r = _run_cli(base + [os.path.basename(p) for p in ref_paths], ref_dir)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    # run 1: the 5th load call hangs for 600s -> the pipeline wedges
+    # after two groups; SIGKILL once the journal shows progress
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "iterative_cleaner_tpu", *base,
+         "--journal", "j.jsonl", "--faults", "load:hang@5",
+         *[os.path.basename(p) for p in paths]],
+        env=repo_subprocess_env(ICLEAN_PROBE_TIMEOUT="0",
+                                ICLEAN_FAULT_HANG_S="600"),
+        cwd=str(tmp_path), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    # exactly 4 archives (groups 0-1) complete before load 5 wedges the
+    # single IO thread; once their 4 journal lines land the journal is
+    # quiescent, so the SIGKILL below cannot race an in-flight append
+    jpath = tmp_path / "j.jsonl"
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        text = jpath.read_text() if jpath.exists() else ""
+        if text.endswith("\n") and len(text.strip().splitlines()) >= 4:
+            break
+        if proc.poll() is not None:
+            pytest.fail("wedged CLI run exited early (rc %s)"
+                        % proc.returncode)
+        time.sleep(0.2)
+    else:
+        proc.kill()
+        pytest.fail("journal never showed progress before the deadline")
+    os.kill(proc.pid, signal.SIGKILL)
+    assert proc.wait(timeout=60) == -signal.SIGKILL
+    pre = [json.loads(ln) for ln in jpath.read_text().strip().splitlines()
+           if ln.strip()]
+    assert len(pre) == 4                           # partial, crash-safe
+
+    # run 2: --resume over the same journal, no faults
+    r2 = _run_cli(base[:-1] + ["--journal", "j.jsonl", "--resume",
+                               *[os.path.basename(p) for p in paths]],
+                  tmp_path)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert ("resumed: %d archive" % len(pre)) in r2.stdout, r2.stdout
+
+    # exactly-once: each path appears once in the final journal, and the
+    # resumed run re-cleaned only the missing archives
+    entries = [json.loads(ln)
+               for ln in jpath.read_text().strip().splitlines()
+               if ln.strip()]
+    assert len(entries) == 8
+    assert len({e["path"] for e in entries}) == 8
+    # outputs byte-identical to the uninterrupted reference serve
+    for p, rp in zip(paths, ref_paths):
+        out, ref_out = p + "_cleaned.icar", rp + "_cleaned.icar"
+        assert os.path.exists(out), out
+        with open(out, "rb") as a, open(ref_out, "rb") as b:
+            assert a.read() == b.read(), os.path.basename(out)
