@@ -692,6 +692,201 @@ def bench_fleet(n_archives, geometries, max_iter=3, group_size=8,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_serve(n_requests, geometries, max_iter=3, io_workers=2,
+                max_inflight=2, burst=10):
+    """Service-daemon row: a real ``--serve`` CLI subprocess measured on
+    its request lifecycle — submit-to-done latency warm vs cold, explicit
+    backpressure under a saturation burst, and graceful-drain time.
+
+    Phase A (latency): ``n_requests`` single-archive HTTP submissions,
+    each awaited to its journaled terminal state before the next.  The
+    first request pays the daemon's compiles (``serve_cold_ms``); the
+    median of the rest is the steady-state figure
+    (``serve_submit_to_done_ms``) — the number a pipeline scheduling
+    against the daemon actually budgets.
+
+    Phase B (saturation): ``burst`` submissions fired back-to-back with a
+    per-tenant cap of ``max_inflight``, while a plug request on a fresh
+    geometry pins the worker in its compile; the daemon must answer the
+    overflow with 429s (``serve_burst_rejected`` >= 1 — backpressure is
+    explicit, never an unbounded queue) while every ACCEPTED request
+    still completes.
+
+    Masks must stay bit-equal to an in-process `clean_archive` over the
+    same inputs (the rows' shared parity-is-fatal contract), and SIGTERM
+    must drain to exit 0 (``serve_drain_s``).
+    """
+    import dataclasses  # noqa: F401  (parity uses archives, kept symmetric)
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io import load_archive, save_archive
+    from iterative_cleaner_tpu.io.synthetic import (
+        bench_rfi_density,
+        make_synthetic_archive,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="bench_serve_")
+    proc = None
+    try:
+        cfg = CleanConfig(backend="jax", max_iter=max_iter)
+        paths, want_masks = [], {}
+        for i in range(n_requests):
+            nsub, nchan, nbin = geometries[i % len(geometries)]
+            ar, _ = make_synthetic_archive(
+                nsub=nsub, nchan=nchan, nbin=nbin,
+                **bench_rfi_density(nsub, nchan), seed=i, dtype=np.float32)
+            p = os.path.join(tmp, "serve_%03d.npz" % i)
+            save_archive(ar, p)
+            paths.append(p)
+            want_masks[p] = clean_archive(ar, cfg).final_weights == 0
+
+        env = {**os.environ,
+               "ICLEAN_PLATFORM": jax.default_backend(),
+               "ICLEAN_PROBE_TIMEOUT": "0",
+               "PYTHONPATH": os.pathsep.join(
+                   [os.path.dirname(os.path.abspath(__file__))]
+                   + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+               ).rstrip(os.pathsep)}
+        out_path = os.path.join(tmp, "daemon.out")
+        outf = open(out_path, "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "iterative_cleaner_tpu", "--serve",
+             "--spool", "spool", "--http-port", "0",
+             "--max-inflight", str(max_inflight),
+             "--max_iter", str(max_iter),
+             "--io-workers", str(io_workers), "-q"],
+            env=env, cwd=tmp, stdout=outf, stderr=subprocess.STDOUT)
+        needle = "serve: http listening on 127.0.0.1:"
+        deadline = time.time() + 120
+        port = None
+        while time.time() < deadline and port is None:
+            for line in open(out_path).read().splitlines():
+                if line.startswith(needle):
+                    port = int(line[len(needle):])
+                    break
+            if proc.poll() is not None:
+                raise RuntimeError("serve daemon exited before binding:\n"
+                                   + open(out_path).read()[-2000:])
+            time.sleep(0.05)
+        if port is None:
+            raise RuntimeError("serve daemon never printed its port")
+        url = "http://127.0.0.1:%d" % port
+
+        def post(doc):
+            req = urllib.request.Request(
+                url + "/submit", data=json.dumps(doc).encode())
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status
+            except urllib.error.HTTPError as exc:
+                return exc.code
+
+        def wait_done(rid, timeout_s=300):
+            end = time.time() + timeout_s
+            while time.time() < end:
+                try:
+                    with urllib.request.urlopen(
+                            url + "/requests/" + rid, timeout=10) as r:
+                        state = json.loads(r.read()).get("state")
+                except urllib.error.HTTPError:
+                    state = None
+                if state in ("done", "failed"):
+                    return state
+                time.sleep(0.01)
+            raise RuntimeError(f"request {rid} never finished")
+
+        # phase A: sequential submit->done latency, cold then warm
+        lat_ms = []
+        for i, p in enumerate(paths):
+            rid = "lat%03d" % i
+            t0 = time.perf_counter()
+            status = post({"paths": [p], "id": rid})
+            assert status == 200, f"submit {rid} answered {status}"
+            assert wait_done(rid) == "done", f"request {rid} failed"
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+        cold_ms = lat_ms[0]
+        warm = sorted(lat_ms[1:]) or [cold_ms]
+        warm_ms = warm[len(warm) // 2]
+        _log(f"serve stage: {n_requests} sequential requests, "
+             f"cold {cold_ms:.0f}ms -> warm median {warm_ms:.0f}ms")
+
+        # phase B: saturation burst against the per-tenant cap.  A warm
+        # worker can outrun back-to-back submits, so the burst fires
+        # while a "plug" request on a FRESH geometry holds the worker in
+        # its compile — the cap is then genuinely contended.
+        plug_ar, _ = make_synthetic_archive(
+            nsub=32, nchan=48, nbin=48, **bench_rfi_density(32, 48),
+            seed=999, dtype=np.float32)
+        plug_p = os.path.join(tmp, "serve_plug.npz")
+        save_archive(plug_ar, plug_p)
+        want_masks[plug_p] = clean_archive(plug_ar, cfg).final_weights == 0
+        paths.append(plug_p)
+        assert post({"paths": [plug_p], "id": "plug"}) == 200
+        end = time.time() + 60
+        while time.time() < end:
+            with urllib.request.urlopen(url + "/requests/plug",
+                                        timeout=10) as r:
+                if json.loads(r.read()).get("state") == "running":
+                    break
+            time.sleep(0.005)
+        accepted, rejected = [], 0
+        for i in range(burst):
+            rid = "burst%03d" % i
+            status = post({"paths": [paths[i % len(paths)]], "id": rid})
+            if status == 200:
+                accepted.append(rid)
+            else:
+                assert status == 429, f"burst overflow answered {status}"
+                rejected += 1
+        assert wait_done("plug") == "done", "plug request failed"
+        for rid in accepted:
+            assert wait_done(rid) == "done", f"burst {rid} failed"
+        assert rejected >= 1, \
+            f"burst of {burst} at cap {max_inflight} drew no 429s; " \
+            "backpressure is not engaging"
+        _log(f"serve stage: burst {burst} -> {len(accepted)} accepted, "
+             f"{rejected} rejected (cap {max_inflight})")
+
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok" and health["failed"] == 0, health
+
+        t0 = time.perf_counter()
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        drain_s = time.perf_counter() - t0
+        assert rc == 0, f"drain exited {rc}:\n{open(out_path).read()[-2000:]}"
+        _log(f"serve stage: drained in {drain_s:.2f}s (exit 0)")
+
+        for i, p in enumerate(paths):
+            got = load_archive(p + "_cleaned.npz")
+            assert np.array_equal(want_masks[p], got.weights == 0), \
+                f"serve mask diverged from in-process clean (archive {i})"
+
+        return {
+            "serve_n": n_requests,
+            "serve_platform": jax.default_backend(),
+            "serve_cold_ms": round(cold_ms, 1),
+            "serve_submit_to_done_ms": round(warm_ms, 1),
+            "serve_burst": burst,
+            "serve_burst_rejected": rejected,
+            "serve_drain_s": round(drain_s, 2),
+        }
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_numpy(nsub, nchan, nbin, max_iter=5):
     from iterative_cleaner_tpu.backends.numpy_backend import clean_cube
     from iterative_cleaner_tpu.config import CleanConfig
@@ -763,7 +958,8 @@ def main():
 
     for env_key, stage in (("BENCH_STREAMING_ONLY", bench_streaming),
                            ("BENCH_BATCH_ONLY", bench_batch),
-                           ("BENCH_FLEET_ONLY", bench_fleet)):
+                           ("BENCH_FLEET_ONLY", bench_fleet),
+                           ("BENCH_SERVE_ONLY", bench_serve)):
         if os.environ.get(env_key):
             geom = json.loads(os.environ[env_key])
             fallback_to_cpu_if_unreachable(
@@ -864,6 +1060,20 @@ def main():
         {"n_archives": f_n, "geometries": f_geoms},
         timeout=float(os.environ.get("BENCH_FLEET_TIMEOUT", "900")),
         label="fleet")
+    if row:
+        extras = {**(extras or {}), **row}
+
+    # service-daemon row (serve/): submit->done latency through a real
+    # --serve process, 429 backpressure under a saturation burst, and
+    # SIGTERM drain time — same killable-subprocess + parity-is-fatal
+    # contract as the rows above
+    sv_n, sv_geoms = ((4, [[16, 32, 32], [24, 32, 32]]) if small else
+                      (8, [[8, 16, 32], [12, 16, 32]]))
+    row = _bench_row_subprocess(
+        "BENCH_SERVE_ONLY",
+        {"n_requests": sv_n, "geometries": sv_geoms},
+        timeout=float(os.environ.get("BENCH_SERVE_TIMEOUT", "600")),
+        label="serve")
     if row:
         extras = {**(extras or {}), **row}
 

@@ -38,7 +38,10 @@ def _parse_bucket_pad(text: str):
 
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(description="Commands for the cleaner")
-    parser.add_argument("archive", nargs="+", help="The chosen archives")
+    parser.add_argument("archive", nargs="*",
+                        help="The chosen archives (required unless "
+                             "--serve, which takes requests from its "
+                             "spool/HTTP intakes instead)")
     parser.add_argument("-c", "--chanthresh", type=float, default=5,
                         metavar="channel_threshold",
                         help="Sigma threshold for a profile to stand out "
@@ -265,7 +268,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--faults", type=str, default="", metavar="SPEC",
                         help="Fleet fault-injection drill: deterministic "
                              "'site:action' spec, comma-separated — sites "
-                             "peek/load/compile/execute/write; actions a "
+                             "peek/load/compile/execute/write plus the "
+                             "--serve layer's intake/sched; actions a "
                              "probability ('load:0.1'), 'once', a kind "
                              "(err|oom|perm|hang) or 'kind@N' for the Nth "
                              "call ('exec:oom@2'). Mirrors ICLEAN_FAULTS; "
@@ -280,15 +284,49 @@ def build_parser() -> argparse.ArgumentParser:
                              "completed archive (after its atomic output "
                              "write) to PATH, keyed by input signature and "
                              "config hash; a later --resume run skips "
-                             "journaled work. Default with --resume: "
-                             "clean.fleet.journal.jsonl.")
+                             "journaled work. With --serve, overrides the "
+                             "daemon's request-lifecycle journal path "
+                             "(default serve.journal.jsonl).")
     parser.add_argument("--resume", action="store_true",
                         help="Skip archives the --journal records as "
                              "complete under the same config, after "
                              "re-verifying the input file signature and "
                              "the recorded output — a killed fleet run "
                              "picks up where it stopped with zero "
-                             "duplicated cleans.")
+                             "duplicated cleans. Requires --journal PATH "
+                             "(an implicit default journal would silently "
+                             "resume against the wrong file).")
+    parser.add_argument("--serve", action="store_true",
+                        help="Run as a long-lived cleaning service instead "
+                             "of a batch run: keep the process (and its "
+                             "AOT-compiled bucket programs) warm and take "
+                             "requests from a --spool directory and/or an "
+                             "--http-port JSON endpoint, with admission "
+                             "control, priorities, deadlines and a "
+                             "crash-safe request journal. SIGTERM drains "
+                             "gracefully (exit 0). Takes no archive "
+                             "arguments.")
+    parser.add_argument("--spool", type=str, default="", metavar="DIR",
+                        help="--serve intake: watch DIR for request .json "
+                             "files (write-then-rename into place; claimed "
+                             "files are renamed .accepted/.rejected). "
+                             "Mirrors ICLEAN_SPOOL.")
+    parser.add_argument("--http-port", "--http_port", type=int,
+                        default=None, dest="http_port", metavar="PORT",
+                        help="--serve intake: HTTP/JSON endpoint on "
+                             "127.0.0.1:PORT — POST /submit, GET /healthz, "
+                             "GET /metrics, GET /requests/<id>; 0 binds an "
+                             "ephemeral port (printed at startup). "
+                             "Mirrors ICLEAN_HTTP_PORT.")
+    parser.add_argument("--max-inflight", "--max_inflight", type=int,
+                        default=None, dest="max_inflight", metavar="N",
+                        help="--serve admission control: max requests one "
+                             "tenant may have admitted but unfinished "
+                             "(queued + running) before new submissions "
+                             "draw 429/REJECTED backpressure (default 8; "
+                             "mirrors ICLEAN_MAX_INFLIGHT; the global "
+                             "queue bound is ICLEAN_SERVE_QUEUE, default "
+                             "64).")
     parser.add_argument("--stream", type=int, default=0, metavar="CHUNK",
                         help="Clean each archive in CHUNK-subint streaming "
                              "tiles (parallel/streaming.py) instead of one "
@@ -739,8 +777,9 @@ def _run_fleet(args, telemetry=None) -> list:
               % ("writing" if stage == "write" else "cleaning", path,
                  type(exc).__name__, exc), file=sys.stderr)
 
-    journal_path = args.journal or (
-        "clean.fleet.journal.jsonl" if args.resume else "")
+    # --resume without --journal is rejected at parse time, so an empty
+    # journal_path here always means "no journal requested"
+    journal_path = args.journal
     res_plan = ResiliencePlan(
         faults=(FaultInjector(args.faults, seed=args.fault_seed)
                 if args.faults else FaultInjector.from_env()),
@@ -768,6 +807,33 @@ def _run_fleet(args, telemetry=None) -> list:
               % (len(report.skipped),
                  "" if len(report.skipped) == 1 else "s", journal_path))
     return failed
+
+
+def _run_serve(args, telemetry=None) -> int:
+    """--serve driver: build the daemon's ServeConfig (flags over the
+    ICLEAN_* env mirrors) and run it until drained.  The session's
+    registry is handed to the daemon, so --metrics-json/--prom-textfile
+    flush the daemon's lifetime counters when the drain completes."""
+    from iterative_cleaner_tpu.config import ServeConfig
+    from iterative_cleaner_tpu.resilience import FaultInjector
+    from iterative_cleaner_tpu.serve import run_serve
+
+    cfg = config_from_args(args)
+    try:
+        serve_cfg = ServeConfig.from_env(
+            spool_dir=args.spool or None,
+            http_port=args.http_port,
+            max_inflight=args.max_inflight,
+            journal_path=args.journal or None,
+        )
+    except ValueError as exc:
+        build_parser().error(f"--serve: {exc}")
+    faults = (FaultInjector(args.faults, seed=args.fault_seed)
+              if args.faults else FaultInjector.from_env())
+    return run_serve(
+        serve_cfg, cfg,
+        registry=(telemetry.registry if telemetry is not None else None),
+        faults=faults, io_workers=args.io_workers, quiet=args.quiet)
 
 
 def _parse_geometry_spec(spec: str):
@@ -852,10 +918,52 @@ def main(argv=None) -> int:
         device_reachable,
     )
 
+    # pure-argument validation first: never make a bad invocation wait
+    # out the device probe below before erroring
+    if args.serve:
+        if args.archive:
+            build_parser().error(
+                "--serve takes no archive arguments: the daemon's "
+                "requests arrive via --spool/--http-port (drop the "
+                "paths, or drop --serve for a batch run)")
+        if (args.fleet or args.precompile or args.resume or args.checkpoint
+                or args.stream > 0 or args.unload_res or args.batch > 1
+                or args.prefetch > 0 or args.output
+                or args.model != "surgical_scrub"):
+            build_parser().error(
+                "--serve is incompatible with the batch-run flags "
+                "--fleet/--precompile/--resume/--checkpoint/--stream/"
+                "--unload_res/--batch/--prefetch/-o/--model quicklook "
+                "(requests carry their own per-request overrides)")
+        if args.backend != "jax":
+            build_parser().error("--serve requires --backend jax (a "
+                                 "resident numpy daemon has nothing to "
+                                 "keep warm; requests may still override "
+                                 "backend per request)")
+        if not (args.spool or args.http_port is not None
+                or os.environ.get("ICLEAN_SPOOL")
+                or os.environ.get("ICLEAN_HTTP_PORT")):
+            build_parser().error(
+                "--serve needs at least one intake: --spool DIR and/or "
+                "--http-port PORT (or their ICLEAN_SPOOL/"
+                "ICLEAN_HTTP_PORT mirrors)")
+    elif args.spool or args.http_port is not None \
+            or args.max_inflight is not None:
+        # intake knobs only exist in the daemon — a silently ignored flag
+        # would mislead (same contract as --bucket-pad)
+        build_parser().error(
+            "--spool/--http-port/--max-inflight configure the --serve "
+            "daemon; pass --serve")
+    elif not args.archive:
+        build_parser().error(
+            "at least one archive path is required (or pass --serve)")
+    if args.resume and not args.journal:
+        build_parser().error(
+            "--resume needs an explicit --journal PATH: resuming against "
+            "an implicit default journal risks skipping work recorded by "
+            "a different run")
     if args.batch > 1 and (args.unload_res or args.checkpoint
                            or args.backend != "jax"):
-        # pure-argument validation first: never make a bad invocation wait
-        # out the device probe below before erroring
         build_parser().error(
             "--batch is incompatible with --unload_res/--checkpoint and "
             "requires --backend jax")
@@ -903,12 +1011,14 @@ def main(argv=None) -> int:
             f"--io-workers must be >= 1, got {args.io_workers}")
     if ((args.retries is not None or args.stage_timeout is not None
          or args.faults or args.journal or args.resume)
-            and not args.fleet):
-        # the resilience ladder lives in the fleet pipeline — a silently
-        # ignored flag would mislead (same contract as --bucket-pad)
+            and not args.fleet and not args.serve):
+        # the resilience ladder lives in the fleet pipeline (which --serve
+        # drives per request) — a silently ignored flag would mislead
+        # (same contract as --bucket-pad)
         build_parser().error(
             "--retries/--stage-timeout/--faults/--journal/--resume "
-            "configure the --fleet resilience ladder; pass --fleet")
+            "configure the --fleet/--serve resilience ladder; pass "
+            "--fleet or --serve")
     if args.retries is not None and args.retries < 0:
         build_parser().error(f"--retries must be >= 0, got {args.retries}")
     if args.stage_timeout is not None and args.stage_timeout < 0:
@@ -983,8 +1093,11 @@ def main(argv=None) -> int:
         return _run_precompile(args)
 
     failed = []
+    serve_rc = 0
     with run_session(args) as telemetry:
-        if args.fleet:
+        if args.serve:
+            serve_rc = _run_serve(args, telemetry)
+        elif args.fleet:
             failed = _run_fleet(args, telemetry)
         elif args.batch > 1:
             failed = _run_batched(args, telemetry)
@@ -1008,6 +1121,8 @@ def main(argv=None) -> int:
                     print("ERROR cleaning %s: %s: %s"
                           % (in_path, type(exc).__name__, exc),
                           file=sys.stderr)
+    if args.serve:
+        return serve_rc
     if failed:
         print("Failed %d/%d archives: %s"
               % (len(failed), len(args.archive), ", ".join(failed)),

@@ -10,6 +10,7 @@ and library users construct it directly.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Tuple
 
 
@@ -195,3 +196,79 @@ class CleanConfig:
             raise ValueError(
                 f"stage_timeout_s must be >= 0 (0/None disables the "
                 f"watchdog), got {self.stage_timeout_s}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """The service daemon's knobs (``--serve``; serve/ package).
+
+    Deliberately a SEPARATE record from :class:`CleanConfig`: none of
+    these change any archive's mask, so they must stay out of the
+    checkpoint/journal config identity — a request served under a
+    different queue bound still matches its journal entries.  The CLI
+    builds one from the ``--spool``/``--http-port``/``--max-inflight``
+    flags; the env mirrors (``ICLEAN_SPOOL``, ``ICLEAN_HTTP_PORT``,
+    ``ICLEAN_MAX_INFLIGHT``, ``ICLEAN_SERVE_QUEUE``) cover container
+    deployments where flags are awkward (explicit flags win).
+    """
+
+    # watched spool directory: drop `<request>.json` files here to submit
+    # (claimed files are renamed, so a submission is ingested exactly once);
+    # None disables the spool intake
+    spool_dir: Optional[str] = None
+    # HTTP/JSON intake + live /healthz + /metrics on 127.0.0.1:<port>;
+    # 0 binds an ephemeral port (printed at startup), None disables HTTP
+    http_port: Optional[int] = None
+    # admission control: max requests one tenant may have admitted but not
+    # yet finished (queued + running); the 429/REJECTED backpressure bound
+    max_inflight: int = 8
+    # global bound on the scheduler's queue across all tenants
+    queue_limit: int = 64
+    # spool scan / idle loop period (seconds)
+    poll_s: float = 0.2
+    # request lifecycle + per-archive completion journal (crash-safe
+    # restart state); relative paths resolve against the daemon's cwd
+    journal_path: str = "serve.journal.jsonl"
+    # growth bounds for a long-lived process: compact the journal when it
+    # exceeds journal_max_mb, trim clean.log beyond log_max_mb
+    journal_max_mb: float = 16.0
+    log_max_mb: float = 16.0
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        """Resolve the env mirrors, explicit ``overrides`` winning."""
+        def env(name, cast, default):
+            raw = os.environ.get(name, "")
+            return cast(raw) if raw else default
+
+        fields = {
+            "spool_dir": env("ICLEAN_SPOOL", str, None),
+            "http_port": env("ICLEAN_HTTP_PORT", int, None),
+            "max_inflight": env("ICLEAN_MAX_INFLIGHT", int, 8),
+            "queue_limit": env("ICLEAN_SERVE_QUEUE", int, 64),
+        }
+        fields.update({k: v for k, v in overrides.items() if v is not None})
+        return cls(**fields)
+
+    def __post_init__(self) -> None:
+        if self.spool_dir is None and self.http_port is None:
+            raise ValueError(
+                "serve needs at least one intake: a spool directory "
+                "and/or an HTTP port")
+        if self.http_port is not None and not 0 <= self.http_port <= 65535:
+            raise ValueError(
+                f"http_port must be in [0, 65535] (0 = ephemeral), got "
+                f"{self.http_port}")
+        if self.max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {self.max_inflight}")
+        if self.queue_limit < 1:
+            raise ValueError(
+                f"queue_limit must be >= 1, got {self.queue_limit}")
+        if self.poll_s <= 0:
+            raise ValueError(f"poll_s must be > 0, got {self.poll_s}")
+        if not self.journal_path:
+            raise ValueError("serve requires a journal path (the "
+                             "crash-safe queue state lives there)")
+        if self.journal_max_mb <= 0 or self.log_max_mb <= 0:
+            raise ValueError("journal_max_mb/log_max_mb must be > 0")

@@ -456,9 +456,7 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
 
     # recovery counters may arrive on a caller-shared registry with prior
     # runs' counts; the report's n_* fields are this run's deltas
-    _RECOVERY = ("fleet_retries", "fleet_oom_splits", "fleet_degraded",
-                 "fleet_watchdog_trips")
-    base = {k: reg.counters.get(k, 0.0) for k in _RECOVERY}
+    mark = reg.counters_mark()
 
     cfg_hash = config_hash(config) if res.journal is not None else ""
     pending_paths = list(paths)
@@ -522,14 +520,11 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
             precompiler.shutdown()
     reg.gauge_set("fleet_serve_s", time.perf_counter() - serve_t0)
     report.n_compiles = int(reg.counters.get("fleet_compiles", 0.0))
-    report.n_retries = int(reg.counters.get(_RECOVERY[0], 0.0)
-                           - base[_RECOVERY[0]])
-    report.n_oom_splits = int(reg.counters.get(_RECOVERY[1], 0.0)
-                              - base[_RECOVERY[1]])
-    report.n_degraded = int(reg.counters.get(_RECOVERY[2], 0.0)
-                            - base[_RECOVERY[2]])
-    report.n_watchdog_trips = int(reg.counters.get(_RECOVERY[3], 0.0)
-                                  - base[_RECOVERY[3]])
+    delta = reg.counters_since(mark)
+    report.n_retries = int(delta.get("fleet_retries", 0.0))
+    report.n_oom_splits = int(delta.get("fleet_oom_splits", 0.0))
+    report.n_degraded = int(delta.get("fleet_degraded", 0.0))
+    report.n_watchdog_trips = int(delta.get("fleet_watchdog_trips", 0.0))
     reg.counter_inc("fleet_cleaned", len(report.results))
     record_builder_cache_stats(reg)
     return report

@@ -17,8 +17,11 @@ Spec grammar (comma-separated ``site:action`` entries)::
     exec:hang@1       stall the 1st execute call for ``hang_s`` seconds
                       (what a watchdog deadline must catch)
 
-Sites are ``peek``, ``load``, ``compile``, ``execute`` (alias ``exec``)
-and ``write``; kinds are ``err`` (transient), ``oom`` (synthetic
+Sites are ``peek``, ``load``, ``compile``, ``execute`` (alias ``exec``),
+``write``, and the serve daemon's layer: ``intake`` (spool/HTTP request
+parsing and admission) and ``sched`` (the scheduler's dispatch path) —
+so a soak can prove the daemon survives a faulty intake or scheduler
+without wedging.  Kinds are ``err`` (transient), ``oom`` (synthetic
 ``RESOURCE_EXHAUSTED`` — classified exactly like a real device OOM),
 ``perm`` (permanent) and ``hang`` (a sleep, never an exception).
 Probability draws are keyed functionally on ``(seed, site, kind, call
@@ -35,7 +38,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-SITES = ("peek", "load", "compile", "execute", "write")
+SITES = ("peek", "load", "compile", "execute", "write", "intake", "sched")
 _SITE_ALIASES = {"exec": "execute"}
 KINDS = ("err", "oom", "perm", "hang")
 

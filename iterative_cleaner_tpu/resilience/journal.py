@@ -21,15 +21,43 @@ different cleaning parameters never satisfies a resume.  ``sig``/
 a resumed run re-verifies BOTH before skipping — a rewritten input or a
 missing/truncated output re-cleans instead of being trusted
 (:func:`entry_is_current`).
+
+**Request lifecycle events** (the serve daemon's crash-safe queue state)
+share the file under the same schema::
+
+    {"schema": "icln-fleet-journal/1", "event": "req",
+     "state": "accepted" | "running" | "done" | "failed",
+     "req": "<request id>", ...request fields on 'accepted'...}
+
+A request's 'accepted' entry carries everything needed to re-run it
+(paths, overrides, priority, deadline, tenant), so a killed daemon
+rebuilds its queue from the journal alone: any request whose LAST state
+is non-terminal re-enqueues, and the per-archive 'done' entries above
+make the re-run skip every archive that already finished — exactly-once
+cleaning across the crash.  The two event kinds never collide: archive
+readers filter ``event == "done"``, request readers ``event == "req"``.
+
+**Compaction** (:meth:`FleetJournal.compact`): a long-lived daemon's
+journal grows one line per archive forever; compaction atomically
+rewrites it keeping only the live lines — the last 'done' entry per
+archive path and the last 'req' entry per request id (terminal request
+ids keep one line apiece so accepted-entry replay stays impossible).
+The rewrite runs under the appenders' flock via
+:func:`~iterative_cleaner_tpu.utils.logging.compact_under_lock`, so
+compacting under live traffic loses no entries.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 SCHEMA = "icln-fleet-journal/1"
+
+# request lifecycle states; the daemon may only trust "done"/"failed" as
+# final — anything else re-enqueues on restart
+REQUEST_TERMINAL = ("done", "failed")
 
 
 def entry_is_current(entry: dict) -> bool:
@@ -52,6 +80,21 @@ def entry_is_current(entry: dict) -> bool:
     return True
 
 
+def _parse_lines(text: str):
+    """Yield the parseable schema-matching dict entries of a journal text;
+    torn tails and foreign lines are skipped, never fatal."""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            entry = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(entry, dict) and entry.get("schema") == SCHEMA:
+            yield entry
+
+
 class FleetJournal:
     """Append-only completion log for one fleet output set.
 
@@ -62,12 +105,16 @@ class FleetJournal:
     def __init__(self, path: str) -> None:
         self.path = os.path.abspath(path)
 
+    def _append(self, entry: dict) -> None:
+        from iterative_cleaner_tpu.utils.logging import locked_append
+
+        locked_append(self.path, json.dumps(entry, sort_keys=True) + "\n")
+
     def record_done(self, in_path: str, *, config_hash: str,
                     out_path: Optional[str] = None) -> None:
         """Append one completion entry; signatures are taken now, i.e.
         after the (atomic) output write landed."""
         from iterative_cleaner_tpu.utils.checkpoint import file_signature
-        from iterative_cleaner_tpu.utils.logging import locked_append
 
         entry = {
             "schema": SCHEMA,
@@ -79,7 +126,7 @@ class FleetJournal:
         if out_path:
             entry["out"] = os.path.abspath(out_path)
             entry["out_sig"] = file_signature(out_path)
-        locked_append(self.path, json.dumps(entry, sort_keys=True) + "\n")
+        self._append(entry)
 
     def completed(self, config_hash: str) -> Dict[str, dict]:
         """abs-path -> last 'done' entry recorded under this config hash.
@@ -89,20 +136,96 @@ class FleetJournal:
         if not os.path.exists(self.path):
             return out
         with open(self.path, "r") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    entry = json.loads(line)
-                except ValueError:
-                    continue
-                if not isinstance(entry, dict):
-                    continue
-                if (entry.get("schema") != SCHEMA
-                        or entry.get("event") != "done"
+            for entry in _parse_lines(f.read()):
+                if (entry.get("event") != "done"
                         or entry.get("config") != config_hash
                         or not entry.get("path")):
                     continue
                 out[entry["path"]] = entry
         return out
+
+    # ---------------------------------------------- request lifecycle
+
+    def record_request(self, request_id: str, state: str, **fields) -> None:
+        """Append one request lifecycle entry.  'accepted' entries should
+        carry the full request description (``fields``) so a restarted
+        daemon can re-run the request from the journal alone; state
+        transitions after that only need the id."""
+        if state not in ("accepted", "running") + REQUEST_TERMINAL:
+            raise ValueError(f"unknown request state {state!r}")
+        entry = {"schema": SCHEMA, "event": "req",
+                 "req": str(request_id), "state": state}
+        entry.update(fields)
+        self._append(entry)
+
+    def request_states(self) -> Dict[str, dict]:
+        """request-id -> merged view of its lifecycle: the 'accepted'
+        entry's fields (the request description) overlaid with the LAST
+        state seen.  The torn-tail/foreign-line tolerance of
+        :meth:`completed` applies."""
+        out: Dict[str, dict] = {}
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path, "r") as f:
+            for entry in _parse_lines(f.read()):
+                if entry.get("event") != "req" or not entry.get("req"):
+                    continue
+                rid = entry["req"]
+                prev = out.get(rid, {})
+                merged = dict(prev)
+                merged.update(entry)
+                out[rid] = merged
+        return out
+
+    # ----------------------------------------------------- compaction
+
+    def live_lines(self, text: str) -> List[str]:
+        """The keep-set of a compaction pass over ``text``: the last
+        'done' line per archive path and the last 'req' line per request
+        id, in last-seen order.  For a request the kept line is
+        re-serialized from the MERGED lifecycle view, so the accepted
+        entry's description survives even though only its final state
+        line is kept."""
+        done: Dict[str, str] = {}
+        reqs: Dict[str, dict] = {}
+        order: List[str] = []
+
+        def touch(key: str) -> None:
+            if key in order:
+                order.remove(key)
+            order.append(key)
+
+        for entry in _parse_lines(text):
+            if entry.get("event") == "done" and entry.get("path"):
+                key = "done:" + entry["path"]
+                done[entry["path"]] = json.dumps(entry, sort_keys=True)
+                touch(key)
+            elif entry.get("event") == "req" and entry.get("req"):
+                rid = entry["req"]
+                merged = dict(reqs.get(rid, {}))
+                merged.update(entry)
+                reqs[rid] = merged
+                touch("req:" + rid)
+        lines = []
+        for key in order:
+            kind, _, ident = key.partition(":")
+            if kind == "done":
+                lines.append(done[ident])
+            else:
+                lines.append(json.dumps(reqs[ident], sort_keys=True))
+        return lines
+
+    def compact(self) -> bool:
+        """Atomically rewrite the journal keeping only the live lines
+        (:meth:`live_lines`) — the long-lived daemon's growth bound.
+        Concurrent appenders lose nothing: the rewrite holds their flock
+        and they detect the inode swap
+        (:func:`~iterative_cleaner_tpu.utils.logging.compact_under_lock`).
+        Returns True when a rewrite happened."""
+        from iterative_cleaner_tpu.utils.logging import compact_under_lock
+
+        def rewrite(text: str) -> str:
+            lines = self.live_lines(text)
+            return "".join(ln + "\n" for ln in lines)
+
+        return compact_under_lock(self.path, rewrite)

@@ -1,0 +1,30 @@
+"""Long-lived cleaning service: crash-safe queue, admission control,
+deadlines, backpressure, graceful drain (``--serve``).
+
+The daemon keeps the process — and with it the AOT bucket memo, the batch
+builders' caches and the persistent compilation cache handshake — alive
+across requests, so repeat-geometry requests serve warm.  See
+:mod:`iterative_cleaner_tpu.serve.daemon` for the request lifecycle.
+"""
+
+from iterative_cleaner_tpu.serve.daemon import (  # noqa: F401
+    ServeDaemon,
+    default_out_path,
+    run_serve,
+)
+from iterative_cleaner_tpu.serve.request import (  # noqa: F401
+    OVERRIDABLE,
+    RequestError,
+    ServeRequest,
+    parse_request,
+    request_key,
+)
+from iterative_cleaner_tpu.serve.scheduler import (  # noqa: F401
+    Rejection,
+    ServeScheduler,
+)
+from iterative_cleaner_tpu.serve.spool import (  # noqa: F401
+    ACCEPTED_SUFFIX,
+    REJECTED_SUFFIX,
+    SpoolWatcher,
+)

@@ -1,0 +1,378 @@
+"""The long-lived cleaning service: ``python -m iterative_cleaner_tpu --serve``.
+
+One process, alive across requests, so everything the batch CLI pays per
+invocation is paid once: jax initialisation, the persistent compilation
+cache handshake, and — because the AOT bucket memo and the batch builders'
+caches are process-global — the compiled executables themselves.  A
+repeat-geometry request on a warm daemon is served entirely from
+``fleet_precompile_hits`` with zero new compile-cache entries.
+
+Lifecycle (one request)::
+
+    intake (spool scan / HTTP POST)          [intake fault site]
+      -> admission  (ServeScheduler.submit)  -> 429/.rejected on pressure
+      -> journal    "accepted" (+ full request description)
+      -> scheduler  priority + earliest-deadline pop  [sched fault site]
+      -> journal    "running"
+      -> clean_fleet(resume=True, shared journal)  [peek/load/compile/
+                                                    execute/write sites]
+      -> journal    "done" | "failed"
+
+Crash safety is the journal: a ``kill -9`` at ANY point restarts into
+:meth:`ServeDaemon.recover`, which re-enqueues every request whose last
+journaled state is non-terminal; the re-run goes through the fleet's
+``resume`` path, so archives whose per-path 'done' entries verify are
+skipped — zero duplicated cleans, byte-identical outputs.
+
+Drain (SIGTERM/SIGINT): intake stops (HTTP 503, spool files untouched),
+the in-flight request finishes and journals, queued requests stay
+journaled 'accepted' for the next start, telemetry flushes, exit 0.
+A second signal force-exits non-zero immediately.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import sys
+import time
+from typing import Dict, Optional
+
+from iterative_cleaner_tpu.config import CleanConfig, ServeConfig
+from iterative_cleaner_tpu.serve.request import (
+    RequestError,
+    ServeRequest,
+)
+from iterative_cleaner_tpu.serve.scheduler import Rejection, ServeScheduler
+from iterative_cleaner_tpu.serve.spool import SpoolWatcher
+
+FORCE_EXIT_CODE = 70  # second signal mid-drain: EX_SOFTWARE-ish, non-zero
+
+# journal/request fields safe to echo back over GET /requests/<id>
+_STATUS_FIELDS = ("state", "tenant", "priority", "deadline_ts",
+                  "submitted_ts", "paths", "error", "n_cleaned",
+                  "n_skipped", "n_failed", "duration_s")
+
+
+def default_out_path(p: str) -> str:
+    """The CLI's default output naming (``--output ""``): daemon outputs
+    are bit-identical to a batch-CLI run over the same archives."""
+    return p + "_cleaned" + (os.path.splitext(p)[1] or ".npz")
+
+
+class ServeDaemon:
+    """Composes ServeConfig + CleanConfig + scheduler + intakes + journal
+    around a single-worker serve loop (device compute is serialized by
+    design — one TPU, one fleet at a time; concurrency lives in the
+    fleet's own IO pools)."""
+
+    def __init__(self, serve_config: ServeConfig, base_config: CleanConfig,
+                 *, registry=None, faults=None, retry=None,
+                 stage_timeout_s: Optional[float] = None,
+                 io_workers: Optional[int] = None,
+                 quiet: bool = False) -> None:
+        from iterative_cleaner_tpu.resilience import (
+            FleetJournal,
+            RetryPolicy,
+            resolve_retries,
+            resolve_stage_timeout,
+        )
+        from iterative_cleaner_tpu.telemetry import MetricsRegistry
+
+        self.serve_config = serve_config
+        self.base_config = base_config
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.faults = faults
+        if self.faults is not None:
+            self.faults.bind(self.registry)
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_retries=resolve_retries(
+                getattr(base_config, "fleet_retries", None)))
+        self.stage_timeout_s = resolve_stage_timeout(
+            stage_timeout_s if stage_timeout_s is not None
+            else getattr(base_config, "stage_timeout_s", None))
+        self.io_workers = io_workers
+        self.quiet = quiet
+        self.journal = FleetJournal(serve_config.journal_path)
+        self.scheduler = ServeScheduler(
+            queue_limit=serve_config.queue_limit,
+            max_inflight=serve_config.max_inflight,
+            registry=self.registry, faults=self.faults)
+        self.spool = (SpoolWatcher(
+            serve_config.spool_dir,
+            on_request=lambda req, _path: self.admit(req, source="spool"),
+            base_config=base_config, registry=self.registry,
+            faults=self.faults)
+            if serve_config.spool_dir else None)
+        self._httpd = None
+        self._http_thread = None
+        self._signals = 0
+        self._started_ts = time.time()
+        self._running_id: Optional[str] = None
+
+    # ------------------------------------------------------------- intake
+    def admit(self, req: ServeRequest, source: str) -> None:
+        """Admission + journal, in that order: a rejected request never
+        reaches the journal (a restart must not resurrect it), and a
+        crash after admission but before the journal append loses only a
+        request its submitter never saw acknowledged (the HTTP 200 /
+        spool ``.accepted`` rename both happen strictly after this
+        returns) — so the submitter's retry is correct."""
+        self.scheduler.submit(req)
+        self.journal.record_request(req.request_id, "accepted",
+                                    source=source, **req.journal_fields())
+        self._say("serve: accepted %s (%s, tenant=%s, %d path%s)"
+                  % (req.request_id, source, req.tenant, len(req.paths),
+                     "" if len(req.paths) == 1 else "s"))
+
+    def recover(self) -> int:
+        """Re-enqueue every journaled request whose last state is
+        non-terminal (the crash-restart path).  Returns how many."""
+        from iterative_cleaner_tpu.resilience.journal import REQUEST_TERMINAL
+
+        n = 0
+        for rid, view in sorted(self.journal.request_states().items()):
+            if view.get("state") in REQUEST_TERMINAL:
+                continue
+            try:
+                req = ServeRequest.from_journal_entry(rid, view)
+                self.scheduler.submit(req, already_journaled=True)
+            except (RequestError, Rejection) as exc:
+                # un-replayable (compacted away, corrupt, or beyond the
+                # queue bound): fail it terminally rather than loop on it
+                self.journal.record_request(rid, "failed",
+                                            error=f"unrecoverable: {exc}")
+                self.registry.counter_inc("serve_failed")
+                continue
+            n += 1
+        if n:
+            self.registry.counter_inc("serve_recovered", n)
+            self._say("serve: recovered %d journaled request%s"
+                      % (n, "" if n == 1 else "s"))
+        return n
+
+    # ------------------------------------------------------ observability
+    def health(self) -> dict:
+        snap = self.registry.snapshot()
+        counters = snap.get("counters", {})
+        return {
+            "status": "draining" if self.scheduler.draining else "ok",
+            "pid": os.getpid(),
+            "uptime_s": round(time.time() - self._started_ts, 3),
+            "queued": self.scheduler.depth(),
+            "running": self._running_id,
+            "accepted": int(counters.get("serve_accepted", 0)),
+            "completed": int(counters.get("serve_completed", 0)),
+            "failed": int(counters.get("serve_failed", 0)),
+            "rejected": int(counters.get("serve_rejected", 0)),
+            "deadline_expired": int(
+                counters.get("serve_deadline_expired", 0)),
+        }
+
+    def request_state(self, request_id: str) -> Optional[dict]:
+        """The journaled lifecycle view of one request (GET
+        /requests/<id>) — reading the journal means the answer survives
+        restarts and never races the worker loop."""
+        view = self.journal.request_states().get(request_id)
+        if view is None:
+            return None
+        doc = {k: view[k] for k in _STATUS_FIELDS if k in view}
+        doc["id"] = request_id
+        return doc
+
+    def _say(self, msg: str) -> None:
+        if not self.quiet:
+            print(msg, flush=True)
+
+    # ------------------------------------------------------------ serving
+    def _execute(self, req: ServeRequest) -> None:
+        """Run one admitted request through the fleet.  Every archive-level
+        recovery (retry ladder, OOM splits, degradation) happens inside
+        clean_fleet; here a request only ends 'done' (all paths cleaned or
+        journal-skipped) or 'failed' (any path failed, or the overrides/
+        setup raised)."""
+        from iterative_cleaner_tpu.parallel.fleet import clean_fleet
+        from iterative_cleaner_tpu.resilience import ResiliencePlan
+
+        self._running_id = req.request_id
+        self.journal.record_request(req.request_id, "running")
+        mark = self.registry.counters_mark()
+        t0 = time.perf_counter()
+        try:
+            cfg = req.effective_config(self.base_config)
+            plan = ResiliencePlan(
+                faults=self.faults, retry=self.retry,
+                stage_timeout_s=self.stage_timeout_s,
+                journal=self.journal, resume=True)
+            report = clean_fleet(
+                req.paths, cfg, registry=self.registry,
+                io_workers=self.io_workers,
+                write_fn=self._write_one, resilience=plan,
+                out_path_fn=default_out_path)
+        except Exception as exc:  # setup/override errors, not per-archive
+            dt = time.perf_counter() - t0
+            self.journal.record_request(
+                req.request_id, "failed",
+                error=f"{type(exc).__name__}: {exc}",
+                duration_s=round(dt, 6))
+            self.registry.counter_inc("serve_failed")
+            self.registry.histogram_observe("serve_request_s", dt)
+            self._say("serve: failed %s: %s" % (req.request_id, exc))
+            return
+        finally:
+            self._running_id = None
+        dt = time.perf_counter() - t0
+        delta = self.registry.counters_since(mark)
+        fields = {
+            "n_cleaned": len(report.results),
+            "n_skipped": len(report.skipped),
+            "n_failed": len(report.failures),
+            "duration_s": round(dt, 6),
+        }
+        self.registry.histogram_observe("serve_request_s", dt)
+        if report.ok:
+            self.journal.record_request(req.request_id, "done", **fields)
+            self.registry.counter_inc("serve_completed")
+            self._say("serve: done %s (%d cleaned, %d resumed, %.2fs, "
+                      "%d precompile hits)"
+                      % (req.request_id, len(report.results),
+                         len(report.skipped), dt,
+                         int(delta.get("fleet_precompile_hits", 0))))
+        else:
+            stages = ", ".join("%s@%s" % (os.path.basename(p), stage)
+                               for p, stage, _exc in report.failures[:4])
+            self.journal.record_request(
+                req.request_id, "failed",
+                error=f"{len(report.failures)} archive(s) failed: {stages}",
+                **fields)
+            self.registry.counter_inc("serve_failed")
+            self._say("serve: failed %s (%d of %d archives)"
+                      % (req.request_id, len(report.failures),
+                         len(req.paths)))
+
+    def _write_one(self, path, ar, result) -> None:
+        from iterative_cleaner_tpu import io as ar_io
+
+        out = dataclasses.replace(
+            ar, weights=result.final_weights.astype(ar.weights.dtype))
+        ar_io.save_archive(out, default_out_path(path))
+
+    def _fail_expired(self, expired) -> None:
+        for req in expired:
+            self.journal.record_request(
+                req.request_id, "failed",
+                error="deadline expired before scheduling")
+            self.registry.counter_inc("serve_failed")
+            self.scheduler.mark_done(req)
+            self._say("serve: deadline expired for %s" % req.request_id)
+
+    # -------------------------------------------------------- maintenance
+    def _maintain(self) -> None:
+        """Idle-time growth bounds: compact the journal and trim clean.log
+        once they cross their configured sizes.  Both operations hold the
+        appenders' flock, so maintenance is safe under live traffic."""
+        from iterative_cleaner_tpu.utils.logging import trim_log
+
+        cfg = self.serve_config
+        try:
+            jsz = os.path.getsize(self.journal.path)
+        except OSError:
+            jsz = 0
+        if jsz > cfg.journal_max_mb * 1e6:
+            if self.journal.compact():
+                self.registry.counter_inc("serve_journal_compactions")
+                self._say("serve: compacted journal (%d -> %d bytes)"
+                          % (jsz, os.path.getsize(self.journal.path)))
+        if trim_log("clean.log", int(cfg.log_max_mb * 1e6)):
+            self.registry.counter_inc("serve_log_trims")
+
+    # ------------------------------------------------------------ signals
+    def _on_signal(self, signum, _frame) -> None:
+        self._signals += 1
+        if self._signals >= 2:
+            # a stuck drain must still be killable without SIGKILL
+            print("serve: second signal, forcing exit", flush=True)
+            os._exit(FORCE_EXIT_CODE)
+        print("serve: %s received, draining (queued requests stay "
+              "journaled; signal again to force exit)"
+              % signal.Signals(signum).name, flush=True)
+        self.scheduler.start_drain()
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> int:
+        """The daemon main loop; returns the process exit code (0 for a
+        clean drain)."""
+        import threading
+
+        if threading.current_thread() is threading.main_thread():
+            # in-process tests drive run() from a worker thread and
+            # deliver "signals" by calling _on_signal directly
+            signal.signal(signal.SIGTERM, self._on_signal)
+            signal.signal(signal.SIGINT, self._on_signal)
+        self.recover()
+        if self.serve_config.http_port is not None:
+            from iterative_cleaner_tpu.serve.http import (
+                make_server,
+                start_server_thread,
+            )
+
+            self._httpd = make_server(self, self.serve_config.http_port)
+            self._http_thread = start_server_thread(self._httpd)
+            # fixed grep-able format: tests and scripts parse the port
+            print("serve: http listening on 127.0.0.1:%d"
+                  % self._httpd.server_address[1], flush=True)
+        if self.spool is not None:
+            print("serve: watching spool %s" % self.spool.spool_dir,
+                  flush=True)
+        print("serve: ready (journal %s, max_inflight %d, queue %d)"
+              % (self.journal.path, self.serve_config.max_inflight,
+                 self.serve_config.queue_limit), flush=True)
+        try:
+            while True:
+                draining = self.scheduler.draining
+                if self.spool is not None:
+                    self.spool.scan_once(stop_intake=draining)
+                req, expired = self.scheduler.pop(
+                    timeout=self.serve_config.poll_s)
+                self._fail_expired(expired)
+                if self.scheduler.draining:
+                    # anything just popped stays journaled 'accepted' and
+                    # re-enqueues on the next start — drain only finishes
+                    # work that already reached 'running'
+                    break
+                if req is None:
+                    self._maintain()
+                    continue
+                try:
+                    self._execute(req)
+                finally:
+                    self.scheduler.mark_done(req)
+        finally:
+            self._shutdown()
+        return 0
+
+    def _shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        queued = self.scheduler.depth()
+        self.journal.compact()
+        snap = self.registry.snapshot()
+        print("serve: drained (%d request%s left journaled) %s"
+              % (queued, "" if queued == 1 else "s",
+                 json.dumps({k: v for k, v in
+                             sorted(snap.get("counters", {}).items())
+                             if k.startswith("serve_")},
+                            sort_keys=True)),
+              flush=True)
+
+
+def run_serve(serve_config: ServeConfig, base_config: CleanConfig, *,
+              registry=None, faults=None, io_workers=None,
+              quiet: bool = False) -> int:
+    """CLI entry: build and run a daemon; returns its exit code."""
+    daemon = ServeDaemon(serve_config, base_config, registry=registry,
+                         faults=faults, io_workers=io_workers, quiet=quiet)
+    return daemon.run()

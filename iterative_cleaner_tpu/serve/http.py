@@ -1,0 +1,154 @@
+"""Minimal HTTP/JSON intake + live observability (stdlib only).
+
+``http.server`` from the standard library — no new dependencies — bound
+to localhost: this is the pod-/host-local control surface (a fronting
+proxy owns TLS/authn, exactly like node_exporter's model).  Endpoints::
+
+    POST /submit        JSON request body -> 200 {"accepted": true, ...}
+                        429 on backpressure (queue full / tenant cap),
+                        503 while draining, 400 malformed
+    GET  /healthz       200 {"status": "ok" | "draining", ...counts}
+    GET  /requests/<id> 200 {"state": ...} from the journaled lifecycle
+    GET  /metrics       Prometheus text exposition of the LIVE registry
+                        (the PR 1 exporter, served instead of
+                        textfile-only)
+
+The server runs on daemon threads (`ThreadingHTTPServer`): submissions
+land in the scheduler under its own lock, so the single worker loop never
+blocks intake and vice versa.  The ``intake`` fault site fires per
+/submit: an injected transient returns a 503 with ``Retry-After`` — the
+client's retry is the recovery path, and the daemon never wedges.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from iterative_cleaner_tpu.serve.request import RequestError, parse_request
+from iterative_cleaner_tpu.serve.scheduler import Rejection
+
+MAX_BODY_BYTES = 1 << 20  # a request is paths + knobs, never data
+
+_REJECTION_STATUS = {
+    "queue_full": 429,
+    "tenant_limit": 429,
+    "duplicate": 409,
+    "draining": 503,
+}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """One request class per daemon (built by :func:`make_server`); the
+    daemon object rides on the server instance."""
+
+    server_version = "icln-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    # ------------------------------------------------------------ plumbing
+    def log_message(self, fmt, *args):  # stdout belongs to the daemon
+        pass
+
+    def _send(self, status: int, body: bytes, ctype: str,
+              extra_headers=()) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _send_json(self, status: int, doc: dict, extra_headers=()) -> None:
+        body = (json.dumps(doc, sort_keys=True) + "\n").encode()
+        self._send(status, body, "application/json", extra_headers)
+
+    # ------------------------------------------------------------- routes
+    def do_GET(self):  # noqa: N802 (stdlib handler naming)
+        daemon = self.server.daemon
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/healthz":
+            self._send_json(200, daemon.health())
+        elif path == "/metrics":
+            from iterative_cleaner_tpu.telemetry import (
+                metrics_to_prometheus,
+            )
+
+            text = metrics_to_prometheus(daemon.registry.snapshot())
+            self._send(200, text.encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path.startswith("/requests/"):
+            rid = path[len("/requests/"):]
+            state = daemon.request_state(rid)
+            if state is None:
+                self._send_json(404, {"error": f"unknown request {rid!r}"})
+            else:
+                self._send_json(200, state)
+        else:
+            self._send_json(404, {"error": f"no route {path!r}"})
+
+    def do_POST(self):  # noqa: N802
+        daemon = self.server.daemon
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path != "/submit":
+            self._send_json(404, {"error": f"no route {path!r}"})
+            return
+        if daemon.faults is not None:
+            try:
+                daemon.faults.fire("intake", detail="http")
+            except Exception:
+                # transient intake fault: the client retries; the daemon
+                # keeps serving
+                daemon.registry.counter_inc("serve_retries")
+                self._send_json(503, {"error": "transient intake fault; "
+                                               "retry"},
+                                extra_headers=(("Retry-After", "1"),))
+                return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            length = -1
+        if not 0 < length <= MAX_BODY_BYTES:
+            self._send_json(400, {"error": "Content-Length required and "
+                                           "<= %d" % MAX_BODY_BYTES})
+            return
+        body = self.rfile.read(length)
+        try:
+            req = parse_request(body, base_config=daemon.base_config)
+        except RequestError as exc:
+            self._send_json(400, {"error": str(exc)})
+            return
+        try:
+            daemon.admit(req, source="http")
+        except Rejection as exc:
+            status = _REJECTION_STATUS.get(exc.reason, 429)
+            headers = (("Retry-After", "1"),) if status in (429, 503) else ()
+            self._send_json(status, {"rejected": True, "reason": exc.reason,
+                                     "error": exc.detail},
+                            extra_headers=headers)
+            return
+        self._send_json(200, {"accepted": True, "id": req.request_id,
+                              "tenant": req.tenant})
+
+
+def make_server(daemon, port: int,
+                host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Bind (port 0 = ephemeral) and return the server with ``daemon``
+    attached; the caller starts ``serve_forever`` on a thread and reads
+    ``server.server_address`` for the actual port."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    server.daemon = daemon
+    return server
+
+
+def start_server_thread(server) -> threading.Thread:
+    t = threading.Thread(target=server.serve_forever,
+                         kwargs={"poll_interval": 0.1},
+                         name="icln-serve-http", daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,186 @@
+"""Admission control + priority/deadline scheduling for the serve daemon.
+
+The scheduler is the daemon's backpressure boundary.  Admission
+(:meth:`ServeScheduler.submit`) is synchronous and cheap — the HTTP
+thread and the spool watcher both call it — and can refuse: a full global
+queue or a tenant at its in-flight cap returns a
+:class:`Rejection` (HTTP 429 / spool ``.rejected``) instead of queueing
+unboundedly, and the ``serve_rejected`` counter records it.  Accepted
+requests order by ``(priority desc, deadline asc, arrival)`` —
+:func:`~iterative_cleaner_tpu.serve.request.request_key` — and a request
+whose deadline passed while it queued is failed fast at pop time
+(``serve_deadline_expired``), never cleaned late.
+
+Multi-tenancy: ``max_inflight`` bounds each tenant's ADMITTED-BUT-
+UNFINISHED requests (queued + running).  One greedy tenant saturates its
+own cap and starts drawing 429s while other tenants' requests keep
+flowing — the per-tenant fairness floor, without a full weighted-share
+scheduler.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from iterative_cleaner_tpu.serve.request import ServeRequest, request_key
+
+
+class Rejection(Exception):
+    """An admission refusal: ``reason`` is one of ``queue_full``,
+    ``tenant_limit``, ``draining``, ``duplicate``."""
+
+    def __init__(self, reason: str, detail: str) -> None:
+        super().__init__(detail)
+        self.reason = reason
+        self.detail = detail
+
+
+class ServeScheduler:
+    """Bounded priority/EDF queue with per-tenant admission control.
+
+    Thread-safe; producers (HTTP handler threads, the spool watcher) call
+    :meth:`submit`, the single worker loop calls :meth:`pop` /
+    :meth:`mark_done`.  ``registry`` (a MetricsRegistry) receives the
+    ``serve_*`` counters and queue-depth gauges."""
+
+    def __init__(self, *, queue_limit: int, max_inflight: int,
+                 registry=None, faults=None) -> None:
+        self.queue_limit = int(queue_limit)
+        self.max_inflight = int(max_inflight)
+        self.registry = registry
+        self.faults = faults
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._heap: List[Tuple[Tuple, ServeRequest]] = []
+        self._seq = 0
+        # tenant -> admitted-but-unfinished count (queued + running)
+        self._inflight: Dict[str, int] = {}
+        self._known_ids: set = set()
+        self._draining = False
+
+    # ------------------------------------------------------------ helpers
+    def _count(self, name: str, n: float = 1.0) -> None:
+        if self.registry is not None:
+            self.registry.counter_inc(name, n)
+
+    def _gauges(self) -> None:
+        if self.registry is not None:
+            self.registry.gauge_set("serve_queue_depth", len(self._heap))
+            self.registry.gauge_set(
+                "serve_requests_inflight",
+                float(sum(self._inflight.values())))
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def start_drain(self) -> None:
+        """Refuse all further admissions and wake any popper."""
+        with self._lock:
+            self._draining = True
+            self._not_empty.notify_all()
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    def queued_requests(self) -> List[ServeRequest]:
+        """The still-queued requests (drain reporting; no pop)."""
+        with self._lock:
+            return [req for _k, req in sorted(self._heap)]
+
+    # ---------------------------------------------------------- admission
+    def submit(self, req: ServeRequest,
+               already_journaled: bool = False) -> None:
+        """Admit or raise :class:`Rejection`.  ``already_journaled``
+        (restart re-enqueue) bypasses the duplicate check — the id is
+        known precisely because the journal recorded it."""
+        with self._lock:
+            if self._draining:
+                self._count("serve_rejected")
+                raise Rejection("draining",
+                                "daemon is draining; resubmit later")
+            if not already_journaled and req.request_id in self._known_ids:
+                self._count("serve_rejected")
+                raise Rejection(
+                    "duplicate",
+                    f"request id {req.request_id!r} already admitted")
+            if len(self._heap) >= self.queue_limit:
+                self._count("serve_rejected")
+                raise Rejection(
+                    "queue_full",
+                    f"queue at its bound ({self.queue_limit}); backpressure")
+            inflight = self._inflight.get(req.tenant, 0)
+            if inflight >= self.max_inflight:
+                self._count("serve_rejected")
+                raise Rejection(
+                    "tenant_limit",
+                    f"tenant {req.tenant!r} at its in-flight cap "
+                    f"({self.max_inflight})")
+            self._seq += 1
+            heapq.heappush(self._heap, (request_key(req, self._seq), req))
+            self._known_ids.add(req.request_id)
+            self._inflight[req.tenant] = inflight + 1
+            self._count("serve_accepted")
+            self._gauges()
+            self._not_empty.notify()
+
+    # ------------------------------------------------------------ serving
+    def pop(self, timeout: Optional[float] = None
+            ) -> Tuple[Optional[ServeRequest], List[ServeRequest]]:
+        """Next request to run, blocking up to ``timeout`` seconds.
+
+        Returns ``(request | None, expired)``: ``expired`` are requests
+        whose deadline passed while queued — already charged
+        (``serve_deadline_expired``) and removed; the caller journals them
+        failed.  ``None`` request means timeout or drain with an empty
+        queue.  The ``sched`` fault site fires here: an injected
+        scheduler fault surfaces as a normal empty pop plus a
+        ``serve_retries`` count — the daemon's loop simply comes back."""
+        expired: List[ServeRequest] = []
+        deadline = None if timeout is None else time.time() + timeout
+        with self._lock:
+            while True:
+                if self.faults is not None:
+                    try:
+                        self.faults.fire("sched")
+                    except Exception:
+                        # a faulty scheduler pass never wedges or kills the
+                        # daemon: charge a retry, hand back to the loop
+                        self._count("serve_retries")
+                        return None, expired
+                now = time.time()
+                while self._heap:
+                    key, req = self._heap[0]
+                    if req.expired(now):
+                        heapq.heappop(self._heap)
+                        self._count("serve_deadline_expired")
+                        expired.append(req)
+                        continue
+                    break
+                if self._heap:
+                    _key, req = heapq.heappop(self._heap)
+                    self._gauges()
+                    return req, expired
+                if self._draining:
+                    return None, expired
+                remaining = (None if deadline is None
+                             else deadline - time.time())
+                if remaining is not None and remaining <= 0:
+                    return None, expired
+                self._not_empty.wait(remaining)
+
+    def mark_done(self, req: ServeRequest) -> None:
+        """Release the tenant's in-flight slot (done, failed or expired —
+        every admitted request must be marked exactly once)."""
+        with self._lock:
+            n = self._inflight.get(req.tenant, 0)
+            if n <= 1:
+                self._inflight.pop(req.tenant, None)
+            else:
+                self._inflight[req.tenant] = n - 1
+            self._gauges()

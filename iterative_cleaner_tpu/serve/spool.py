@@ -1,0 +1,126 @@
+"""Watched-spool intake: drop a ``.json`` file, get a cleaning request.
+
+The zero-dependency submission path (LOFAR-pipeline shaped: an upstream
+stage writes archives plus a request file into a shared directory).  The
+watcher scans ``spool_dir`` every ``poll_s`` for ``*.json`` files and
+claims each by RENAMING it before parsing — rename is atomic on a POSIX
+filesystem, so a file is ingested exactly once even if a second daemon
+watches the same spool.  Outcomes are visible in the directory itself::
+
+    req1.json            pending (a mid-drain submission stays like this)
+    req1.json.accepted   admitted; lifecycle continues in the journal
+    req1.json.rejected   refused (backpressure or malformed; reason inside
+                         a trailing "#" comment-line is NOT added — the
+                         journal and daemon log carry the reason)
+
+Producers should write-then-rename into the spool themselves (write
+``.tmp``, rename to ``.json``) so the watcher never claims a
+half-written file — a file that does not parse is rejected, not
+retried (rejection is visible and debuggable; a silent retry loop on a
+truly malformed file would spin forever).  The ``intake`` fault
+site fires per scanned file: an injected transient skips the file this
+scan (``serve_retries``) and the next scan retries it — intake faults
+never wedge or kill the daemon.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+from iterative_cleaner_tpu.serve.request import (
+    RequestError,
+    ServeRequest,
+    parse_request,
+)
+
+ACCEPTED_SUFFIX = ".accepted"
+REJECTED_SUFFIX = ".rejected"
+
+
+class SpoolWatcher:
+    """One scan pass at a time (the daemon loop calls :meth:`scan_once`
+    between queue polls; no thread of its own — the daemon owns timing).
+
+    ``on_request(req, claimed_path)`` admits the parsed request and
+    returns normally, or raises
+    :class:`~iterative_cleaner_tpu.serve.scheduler.Rejection`; the
+    watcher renames the claimed file to match the outcome."""
+
+    def __init__(self, spool_dir: str, *,
+                 on_request: Callable[[ServeRequest, str], None],
+                 base_config=None, registry=None, faults=None) -> None:
+        self.spool_dir = os.path.abspath(spool_dir)
+        self.on_request = on_request
+        self.base_config = base_config
+        self.registry = registry
+        self.faults = faults
+        os.makedirs(self.spool_dir, exist_ok=True)
+
+    def _count(self, name: str) -> None:
+        if self.registry is not None:
+            self.registry.counter_inc(name)
+
+    def pending_files(self) -> List[str]:
+        try:
+            names = sorted(os.listdir(self.spool_dir))
+        except OSError:
+            return []
+        return [os.path.join(self.spool_dir, n) for n in names
+                if n.endswith(".json") and not n.startswith(".")]
+
+    def scan_once(self, stop_intake: bool = False) -> int:
+        """Claim and submit every pending spool file; returns how many
+        were admitted.  With ``stop_intake`` (draining) the scan is a
+        no-op: mid-drain submissions stay untouched ``.json`` files for
+        the next daemon start."""
+        if stop_intake:
+            return 0
+        admitted = 0
+        for path in self.pending_files():
+            admitted += self._ingest(path)
+        return admitted
+
+    def _ingest(self, path: str) -> int:
+        from iterative_cleaner_tpu.serve.scheduler import Rejection
+
+        if self.faults is not None:
+            try:
+                self.faults.fire("intake", detail=os.path.basename(path))
+            except Exception:
+                # transient intake fault: leave the file for the next
+                # scan — submissions are never lost to a flaky intake
+                self._count("serve_retries")
+                return 0
+        claimed = path + ".claimed"
+        try:
+            os.rename(path, claimed)  # atomic claim: exactly-once intake
+        except OSError:
+            return 0                  # raced another claimer / withdrawn
+        stem = os.path.basename(path)[:-len(".json")]
+        try:
+            with open(claimed, "rb") as f:
+                req = parse_request(f.read(), request_id=stem,
+                                    base_config=self.base_config)
+        except RequestError as exc:
+            self._reject(claimed, f"malformed: {exc}")
+            return 0
+        except OSError as exc:
+            self._reject(claimed, f"unreadable: {exc}")
+            return 0
+        try:
+            self.on_request(req, claimed)
+        except Rejection as exc:
+            self._reject(claimed, exc.detail)
+            return 0
+        os.replace(claimed, path + ACCEPTED_SUFFIX)
+        return 1
+
+    def _reject(self, claimed: str, detail: str) -> None:
+        self._count("serve_rejected_spool")
+        print(f"serve: rejected spool file "
+              f"{os.path.basename(claimed)}: {detail}", flush=True)
+        try:
+            os.replace(claimed, claimed[:-len(".claimed")] + REJECTED_SUFFIX)
+        except OSError:
+            pass

@@ -47,6 +47,7 @@ from iterative_cleaner_tpu.telemetry.events import RunEventLog  # noqa: E402,F40
 from iterative_cleaner_tpu.telemetry.exporters import (  # noqa: E402,F401
     metrics_to_json,
     metrics_to_prometheus,
+    parse_prometheus_text,
     write_metrics_json,
     write_prometheus_textfile,
 )

@@ -152,6 +152,27 @@ class MetricsRegistry:
         return self.timer.phase(name)
 
     # -- readers ----------------------------------------------------------
+    def counters_mark(self) -> Dict[str, float]:
+        """A point-in-time baseline of every counter, for
+        :meth:`counters_since`.  Long-lived processes (the serve daemon, a
+        library caller running many fleets through one registry) need
+        per-request numbers, and counters are monotonic process-lifetime
+        aggregates — the delta against a mark is the per-request figure.
+        The returned dict is a plain copy: keep it, don't mutate it."""
+        with self._lock:
+            return dict(self.counters)
+
+    def counters_since(self, mark: Dict[str, float]) -> Dict[str, float]:
+        """Per-counter increase since ``mark`` (a :meth:`counters_mark`
+        result).  Counters absent from the mark count from zero; counters
+        unchanged since the mark are omitted, so the result reads as
+        "what this interval did" — e.g. one serve request's ``fleet_*``
+        numbers, free of every earlier request's."""
+        with self._lock:
+            return {k: v - mark.get(k, 0.0)
+                    for k, v in self.counters.items()
+                    if v != mark.get(k, 0.0)}
+
     def snapshot(self) -> dict:
         """Deterministic (sorted-key) plain-dict view, JSON-ready."""
         with self._lock:

@@ -16,26 +16,103 @@ def locked_append(path: str, text: str) -> None:
     buffers.  ``flock`` is advisory and POSIX-only; where it is
     unavailable (non-POSIX hosts) the plain append is kept — identical
     bytes, just without cross-process exclusion.
-    """
-    with open(path, "a") as f:
-        try:
-            import fcntl
 
-            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
-            locked = True
-        except (ImportError, OSError):
+    Compaction safety: :func:`compact_under_lock` rewrites a log by
+    atomically replacing the path while holding the old inode's lock.  An
+    appender that opened the old file and then waited for that lock would
+    otherwise append to the orphaned inode — a silently lost line.  So
+    after acquiring the lock we re-stat the path: if the inode changed
+    while we waited, release and reopen the (new) file and try again.
+    """
+    while True:
+        with open(path, "a") as f:
             locked = False
-        try:
-            # seek after acquiring: another appender may have grown the
-            # file between open and lock
-            f.seek(0, os.SEEK_END)
-            f.write(text)
-            f.flush()
-        finally:
-            if locked:
+            try:
                 import fcntl
 
-                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+                fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+                locked = True
+            except (ImportError, OSError):
+                pass
+            try:
+                if locked:
+                    try:
+                        if (os.stat(path).st_ino
+                                != os.fstat(f.fileno()).st_ino):
+                            continue  # replaced while we waited: reopen
+                    except OSError:
+                        continue      # unlinked mid-compact: reopen
+                # seek after acquiring: another appender may have grown the
+                # file between open and lock
+                f.seek(0, os.SEEK_END)
+                f.write(text)
+                f.flush()
+                return
+            finally:
+                if locked:
+                    import fcntl
+
+                    fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+
+def compact_under_lock(path: str, rewrite) -> bool:
+    """Atomically rewrite ``path`` as ``rewrite(old_text) -> new_text``
+    while excluding concurrent :func:`locked_append` writers.
+
+    The flock is taken on the CURRENT inode, the replacement happens via
+    the atomic-output temp+``os.replace`` contract while that lock is
+    held, and appenders detect the inode swap and reopen (see
+    :func:`locked_append`) — so compacting a journal or ``clean.log``
+    under live traffic loses no lines: every append lands either in the
+    text ``rewrite`` saw or in the new file.  Returns False (no rewrite)
+    when the file does not exist or flock is unavailable — an unbounded
+    log beats a torn one on hosts without advisory locks."""
+    from iterative_cleaner_tpu.io.atomic import atomic_output
+
+    if not os.path.exists(path):
+        return False
+    try:
+        import fcntl
+    except ImportError:
+        return False
+    with open(path, "r+") as f:
+        try:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+        except OSError:
+            return False
+        try:
+            try:
+                if os.stat(path).st_ino != os.fstat(f.fileno()).st_ino:
+                    return False  # raced another compactor: theirs won
+            except OSError:
+                return False
+            f.seek(0)
+            new_text = rewrite(f.read())
+            with atomic_output(path) as tmp:
+                with open(tmp, "w") as out:
+                    out.write(new_text)
+            return True
+        finally:
+            fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+
+def trim_log(path: str, max_bytes: int, keep_lines: int = 10000) -> bool:
+    """Bound an append-only log for long-lived processes: when ``path``
+    exceeds ``max_bytes``, atomically rewrite it as its last
+    ``keep_lines`` lines (newest history survives, the service daemon's
+    disk footprint stays flat).  No-op below the threshold.  Uses
+    :func:`compact_under_lock`, so concurrent appenders lose nothing."""
+    try:
+        if os.path.getsize(path) <= max_bytes:
+            return False
+    except OSError:
+        return False
+
+    def rewrite(text: str) -> str:
+        lines = text.splitlines(keepends=True)
+        return "".join(lines[-keep_lines:])
+
+    return compact_under_lock(path, rewrite)
 
 
 def append_clean_log(ar_name: str, args_namespace, loops: int,

@@ -100,6 +100,17 @@ def test_bench_small_end_to_end_json_schema():
     # masks were already asserted inside bench_fleet)
     assert out["fleet_retries"] >= 1
     assert out["fleet_oom_splits"] >= 1
+    # serve row (service daemon): submit->done latency measured against a
+    # live --serve subprocess, the saturation burst drew real 429
+    # backpressure, and the SIGTERM drain was timed (mask parity vs the
+    # in-process reference is rc-7-fatal inside the stage)
+    for key in ("serve_n", "serve_platform", "serve_cold_ms",
+                "serve_submit_to_done_ms", "serve_burst",
+                "serve_burst_rejected", "serve_drain_s"):
+        assert key in out, key
+    assert out["serve_submit_to_done_ms"] > 0
+    assert out["serve_burst_rejected"] >= 1
+    assert out["serve_drain_s"] >= 0
 
 
 def test_profile_stages_small_end_to_end():

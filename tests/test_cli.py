@@ -591,3 +591,71 @@ def test_tools_borderline(tmp_path, monkeypatch, capsys):
         # regardless of score) never appear as rows
         assert r["zapped"] == bool(final_zap[r["isub"], r["ichan"]])
         assert not prezap[r["isub"], r["ichan"]]
+
+
+class TestServeValidation:
+    """--serve argument-contract checks: every conflict fails at parse
+    time with a parser error (exit 2), before any device or daemon work."""
+
+    @pytest.fixture(autouse=True)
+    def _no_serve_env(self, monkeypatch):
+        # the env mirrors would silently satisfy the intake requirement
+        for var in ("ICLEAN_SPOOL", "ICLEAN_HTTP_PORT",
+                    "ICLEAN_MAX_INFLIGHT", "ICLEAN_SERVE_QUEUE"):
+            monkeypatch.delenv(var, raising=False)
+
+    def _err(self, argv, capsys):
+        with pytest.raises(SystemExit) as ei:
+            main(argv)
+        assert ei.value.code == 2
+        return capsys.readouterr().err
+
+    def test_serve_rejects_archive_paths(self, tmp_path, capsys):
+        err = self._err(["--serve", "--http-port", "0",
+                         str(tmp_path / "x.npz")], capsys)
+        assert "--serve" in err and "archive" in err
+
+    @pytest.mark.parametrize("flags", [
+        ["--fleet"], ["--precompile"],
+        ["--resume", "--journal", "j.jsonl"],
+        ["--checkpoint", "c.json"], ["--stream", "2"], ["--unload_res"],
+        ["--batch", "2"], ["--prefetch", "1"], ["--output", "out.npz"],
+        ["--model", "selfcal"],
+    ])
+    def test_serve_rejects_batch_only_flags(self, flags, capsys):
+        err = self._err(["--serve", "--http-port", "0", *flags], capsys)
+        assert "--serve" in err
+
+    def test_serve_rejects_numpy_backend(self, capsys):
+        err = self._err(["--serve", "--http-port", "0",
+                         "--backend", "numpy"], capsys)
+        assert "backend" in err
+
+    def test_serve_requires_an_intake(self, capsys):
+        err = self._err(["--serve"], capsys)
+        assert "--spool" in err and "--http-port" in err
+
+    @pytest.mark.parametrize("flags", [
+        ["--spool", "spool", "x.npz"],
+        ["--http-port", "0", "x.npz"],
+        ["--max-inflight", "4", "x.npz"],
+    ])
+    def test_serve_flags_require_serve(self, flags, capsys):
+        err = self._err(flags, capsys)
+        assert "--serve" in err
+
+    def test_no_archives_and_no_serve(self, capsys):
+        err = self._err([], capsys)
+        assert "archive" in err and "--serve" in err
+
+    def test_resume_requires_explicit_journal(self, capsys):
+        err = self._err(["--fleet", "--resume", "x.npz"], capsys)
+        assert "--journal" in err
+
+    def test_serve_env_intake_satisfies_requirement(self, monkeypatch):
+        # an env-mirrored intake parses past validation; a bad port then
+        # fails as a --serve error, proving ServeConfig saw the env value
+        monkeypatch.setenv("ICLEAN_HTTP_PORT", "99999999")
+        with pytest.raises(SystemExit) as ei:
+            main(["--serve"])
+        assert ei.value.code == 2

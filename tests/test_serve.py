@@ -1,0 +1,769 @@
+"""Service-daemon tests (serve/ + cli --serve): request parsing and
+admission control units, journal request lifecycle + compaction (including
+the compact-while-appending flock race), spool-intake semantics, an
+in-process HTTP round trip, and the subprocess contracts — kill -9
+restart with zero duplicated cleans, graceful drain on SIGTERM (second
+signal force-exits), a serve-layer fault soak, and warm repeat-geometry
+serving with zero new compile-cache entries."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig, ServeConfig
+from iterative_cleaner_tpu.io import (
+    load_archive,
+    make_synthetic_archive,
+    save_archive,
+)
+from iterative_cleaner_tpu.resilience import FleetJournal
+from iterative_cleaner_tpu.serve import (
+    RequestError,
+    Rejection,
+    ServeDaemon,
+    ServeRequest,
+    ServeScheduler,
+    SpoolWatcher,
+    parse_request,
+    request_key,
+)
+from iterative_cleaner_tpu.telemetry import MetricsRegistry
+from iterative_cleaner_tpu.utils.logging import (
+    compact_under_lock,
+    locked_append,
+    trim_log,
+)
+from tests.conftest import repo_subprocess_env
+
+NUMPY_BASE = CleanConfig(backend="numpy", max_iter=2)
+
+
+def _write_fleet(tmp_path, geometries, ext=".npz", seed0=60):
+    paths = []
+    for i, (nsub, nchan, nbin) in enumerate(geometries):
+        ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                       seed=seed0 + i)
+        p = str(tmp_path / ("serve_%02d%s" % (i, ext)))
+        save_archive(ar, p)
+        paths.append(p)
+    return paths
+
+
+# ---------------------------------------------------------------- request
+
+def test_parse_request_full_payload():
+    req = parse_request(json.dumps({
+        "paths": ["/d/a.npz", "/d/b.npz"], "tenant": "survey",
+        "priority": 3, "deadline_s": 60.0,
+        "overrides": {"max_iter": 2, "pulse_region": [0.5, 10, 20]},
+        "id": "r-1"}).encode(), now=1000.0)
+    assert req.request_id == "r-1"
+    assert req.paths == ["/d/a.npz", "/d/b.npz"]
+    assert req.tenant == "survey" and req.priority == 3
+    assert req.deadline_ts == pytest.approx(1060.0)
+    assert req.overrides["pulse_region"] == (0.5, 10.0, 20.0)
+    assert not req.expired(now=1059.9) and req.expired(now=1060.0)
+
+
+def test_parse_request_defaults_and_single_path():
+    req = parse_request({"paths": "/d/a.npz"})
+    assert req.paths == ["/d/a.npz"]
+    assert req.tenant == "default" and req.priority == 0
+    assert req.deadline_ts is None and req.overrides == {}
+    assert req.request_id  # minted
+
+
+@pytest.mark.parametrize("payload", [
+    b"not json", b'["list"]', b'{}', b'{"paths": []}',
+    b'{"paths": [1]}', b'{"paths": ["a"], "bogus": 1}',
+    b'{"paths": ["a"], "deadline_s": 0}',
+    b'{"paths": ["a"], "deadline_s": "soon"}',
+    b'{"paths": ["a"], "priority": "high"}',
+    b'{"paths": ["a"], "tenant": ""}',
+    b'{"paths": ["a"], "overrides": {"compile_cache_dir": "/x"}}',
+    b'{"paths": ["a"], "overrides": {"pulse_region": "mid"}}',
+    b'{"paths": ["a"], "id": "x/y"}',
+])
+def test_parse_request_rejects(payload):
+    with pytest.raises(RequestError):
+        parse_request(payload)
+
+
+def test_parse_request_validates_overrides_against_config():
+    # the whitelist passes 'backend' through, but CleanConfig's own
+    # validators still reject a bogus value at parse time
+    with pytest.raises(RequestError):
+        parse_request({"paths": ["a"], "overrides": {"backend": "cuda"}},
+                      base_config=NUMPY_BASE)
+    req = parse_request({"paths": ["a"], "overrides": {"max_iter": 7}},
+                        base_config=NUMPY_BASE)
+    assert req.effective_config(NUMPY_BASE).max_iter == 7
+    assert NUMPY_BASE.max_iter == 2  # base untouched
+
+
+def test_request_key_orders_priority_then_deadline_then_arrival():
+    hi = ServeRequest("hi", ["a"], priority=5)
+    soon = ServeRequest("soon", ["a"], deadline_ts=100.0)
+    late = ServeRequest("late", ["a"], deadline_ts=200.0)
+    fifo = ServeRequest("fifo", ["a"])
+    order = sorted([(request_key(r, i), r.request_id)
+                    for i, r in enumerate([fifo, late, soon, hi])])
+    assert [rid for _k, rid in order] == ["hi", "soon", "late", "fifo"]
+
+
+def test_request_journal_round_trip():
+    req = ServeRequest("r1", ["/d/a.npz"], tenant="t", priority=2,
+                       deadline_ts=123.0, overrides={"max_iter": 4})
+    back = ServeRequest.from_journal_entry("r1", req.journal_fields())
+    assert back == req
+    with pytest.raises(RequestError):
+        ServeRequest.from_journal_entry("r2", {"state": "accepted"})
+
+
+# -------------------------------------------------------------- scheduler
+
+def _sched(**kw):
+    kw.setdefault("queue_limit", 8)
+    kw.setdefault("max_inflight", 4)
+    kw.setdefault("registry", MetricsRegistry())
+    return ServeScheduler(**kw)
+
+
+def test_scheduler_pops_by_priority_and_deadline():
+    s = _sched()
+    for req in [ServeRequest("fifo", ["a"]),
+                ServeRequest("late", ["a"], deadline_ts=time.time() + 500),
+                ServeRequest("soon", ["a"], deadline_ts=time.time() + 400),
+                ServeRequest("hi", ["a"], priority=9)]:
+        s.submit(req)
+    got = [s.pop(timeout=0)[0].request_id for _ in range(4)]
+    assert got == ["hi", "soon", "late", "fifo"]
+
+
+def test_scheduler_tenant_cap_and_release():
+    s = _sched(max_inflight=2)
+    s.submit(ServeRequest("a1", ["a"], tenant="A"))
+    s.submit(ServeRequest("a2", ["a"], tenant="A"))
+    with pytest.raises(Rejection) as ei:
+        s.submit(ServeRequest("a3", ["a"], tenant="A"))
+    assert ei.value.reason == "tenant_limit"
+    # other tenants keep flowing past A's cap
+    s.submit(ServeRequest("b1", ["a"], tenant="B"))
+    # a slot frees only when an admitted request is marked done
+    req, _ = s.pop(timeout=0)
+    s.mark_done(req)
+    s.submit(ServeRequest("a3", ["a"], tenant="A"))
+    reg = s.registry
+    assert reg.counters["serve_accepted"] == 4
+    assert reg.counters["serve_rejected"] == 1
+
+
+def test_scheduler_queue_bound_and_duplicate():
+    s = _sched(queue_limit=2, max_inflight=99)
+    s.submit(ServeRequest("r1", ["a"]))
+    s.submit(ServeRequest("r2", ["a"]))
+    with pytest.raises(Rejection) as ei:
+        s.submit(ServeRequest("r3", ["a"]))
+    assert ei.value.reason == "queue_full"
+    with pytest.raises(Rejection) as ei:
+        s.submit(ServeRequest("r1", ["a"], tenant="other"))
+    assert ei.value.reason == "duplicate"
+    # restart re-enqueue bypasses the duplicate check once dequeued
+    req, _ = s.pop(timeout=0)
+    s.mark_done(req)
+    s.submit(ServeRequest("r1", ["a"]), already_journaled=True)
+
+
+def test_scheduler_drain_refuses_and_wakes_popper():
+    s = _sched()
+    s.submit(ServeRequest("r1", ["a"]))
+    s.start_drain()
+    with pytest.raises(Rejection) as ei:
+        s.submit(ServeRequest("r2", ["a"]))
+    assert ei.value.reason == "draining"
+    # a drained pop still surfaces what was queued, then returns None
+    assert s.pop(timeout=0)[0].request_id == "r1"
+    t0 = time.perf_counter()
+    assert s.pop(timeout=30)[0] is None  # returns immediately: draining
+    assert time.perf_counter() - t0 < 5
+
+
+def test_scheduler_fails_expired_deadlines_fast():
+    s = _sched()
+    past = ServeRequest("old", ["a"], deadline_ts=time.time() - 1)
+    live = ServeRequest("new", ["a"])
+    s.submit(past)
+    s.submit(live)
+    req, expired = s.pop(timeout=0)
+    assert req.request_id == "new"
+    assert [r.request_id for r in expired] == ["old"]
+    assert s.registry.counters["serve_deadline_expired"] == 1
+
+
+# ------------------------------------------------- journal request events
+
+def test_journal_request_lifecycle_merged_view(tmp_path):
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    j.record_request("r1", "accepted", paths=["/d/a.npz"], tenant="t",
+                     priority=1, deadline_ts=None, overrides={},
+                     submitted_ts=5.0)
+    j.record_request("r1", "running")
+    j.record_request("r2", "accepted", paths=["/d/b.npz"])
+    j.record_request("r1", "done", n_cleaned=1)
+    states = j.request_states()
+    assert states["r1"]["state"] == "done"
+    assert states["r1"]["paths"] == ["/d/a.npz"]  # accepted fields survive
+    assert states["r1"]["n_cleaned"] == 1
+    assert states["r2"]["state"] == "accepted"
+    with pytest.raises(ValueError):
+        j.record_request("r3", "exploded")
+
+
+def test_journal_compaction_keeps_live_lines(tmp_path):
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    for i in range(3):  # three generations of the same request + path
+        j._append({"schema": "icln-fleet-journal/1", "event": "done",
+                   "path": "/d/a.npz", "sig": "s%d" % i, "config": "c"})
+        j.record_request("r1", "accepted", paths=["/d/a.npz"], gen=i)
+        j.record_request("r1", "running")
+    j.record_request("r1", "done")
+    j._append({"not": "ours"})  # foreign line: dropped by compaction
+    n_before = len(open(j.path).read().splitlines())
+    assert j.compact()
+    lines = open(j.path).read().splitlines()
+    assert len(lines) == 2 < n_before
+    entries = [json.loads(ln) for ln in lines]
+    done = next(e for e in entries if e["event"] == "done")
+    assert done["sig"] == "s2"  # last generation won
+    req = next(e for e in entries if e["event"] == "req")
+    # merged: terminal state AND the accepted entry's description
+    assert req["state"] == "done" and req["paths"] == ["/d/a.npz"]
+    assert req["gen"] == 2
+    # restart view identical across the compaction
+    assert j.request_states()["r1"]["state"] == "done"
+
+
+def test_journal_compact_while_appending_loses_nothing(tmp_path):
+    """The flock race drill: writer threads locked_append unique 'done'
+    lines while the main thread compacts repeatedly.  Every line is live
+    (unique paths), so none may be lost to the inode swap."""
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    N_THREADS, N_EACH = 4, 40
+    errors = []
+
+    def writer(t):
+        try:
+            for i in range(N_EACH):
+                j._append({"schema": "icln-fleet-journal/1",
+                           "event": "done", "path": "/d/t%d_%d" % (t, i),
+                           "sig": "s", "config": "c"})
+        except Exception as exc:  # pragma: no cover - failure reporting
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(N_THREADS)]
+    for th in threads:
+        th.start()
+    for _ in range(25):
+        j.compact()
+        time.sleep(0.002)
+    for th in threads:
+        th.join()
+    assert not errors
+    j.compact()
+    paths = {json.loads(ln)["path"]
+             for ln in open(j.path).read().splitlines()}
+    assert len(paths) == N_THREADS * N_EACH
+
+
+def test_compact_under_lock_missing_file(tmp_path):
+    assert not compact_under_lock(str(tmp_path / "absent"), lambda t: t)
+
+
+def test_trim_log_keeps_tail(tmp_path):
+    p = str(tmp_path / "clean.log")
+    for i in range(500):
+        locked_append(p, "line %04d\n" % i)
+    size = os.path.getsize(p)
+    assert not trim_log(p, max_bytes=size + 1)  # under bound: no-op
+    assert trim_log(p, max_bytes=100, keep_lines=10)
+    kept = open(p).read().splitlines()
+    assert kept == ["line %04d" % i for i in range(490, 500)]
+
+
+# ------------------------------------------------------------------ spool
+
+def _spool_submit(spool_dir, name, payload):
+    tmp = os.path.join(spool_dir, ".%s.tmp" % name)
+    with open(tmp, "w") as f:
+        f.write(json.dumps(payload))
+    os.replace(tmp, os.path.join(spool_dir, name + ".json"))
+
+
+def test_spool_watcher_accept_reject_and_drain(tmp_path):
+    spool = str(tmp_path / "spool")
+    reg = MetricsRegistry()
+    seen = []
+
+    def on_request(req, _path):
+        if req.tenant == "full":
+            raise Rejection("queue_full", "full up")
+        seen.append(req.request_id)
+
+    w = SpoolWatcher(spool, on_request=on_request, registry=reg)
+    _spool_submit(spool, "good", {"paths": ["/d/a.npz"]})
+    _spool_submit(spool, "pressed", {"paths": ["/d/a.npz"],
+                                     "tenant": "full"})
+    with open(os.path.join(spool, "broken.json"), "w") as f:
+        f.write("{half a json")
+    assert w.scan_once() == 1
+    assert seen == ["good"]  # file stem becomes the request id
+    names = sorted(os.listdir(spool))
+    assert "good.json.accepted" in names
+    assert "pressed.json.rejected" in names
+    assert "broken.json.rejected" in names
+    assert reg.counters["serve_rejected_spool"] == 2
+    # draining: new submissions stay untouched for the next daemon start
+    _spool_submit(spool, "later", {"paths": ["/d/a.npz"]})
+    assert w.scan_once(stop_intake=True) == 0
+    assert "later.json" in os.listdir(spool)
+    # dot-prefixed temp files are never claimed
+    with open(os.path.join(spool, ".partial.json"), "w") as f:
+        f.write("{}")
+    assert w.pending_files() == [os.path.join(spool, "later.json")]
+
+
+def test_spool_intake_fault_leaves_file_for_next_scan(tmp_path):
+    from iterative_cleaner_tpu.resilience import FaultInjector
+
+    spool = str(tmp_path / "spool")
+    reg = MetricsRegistry()
+    seen = []
+    w = SpoolWatcher(spool, on_request=lambda r, _p: seen.append(r),
+                     registry=reg,
+                     faults=FaultInjector("intake:err@1", seed=0,
+                                          registry=reg))
+    _spool_submit(spool, "r1", {"paths": ["/d/a.npz"]})
+    assert w.scan_once() == 0                  # injected: file untouched
+    assert "r1.json" in os.listdir(spool)
+    assert reg.counters["serve_retries"] == 1
+    assert w.scan_once() == 1                  # next scan succeeds
+    assert [r.request_id for r in seen] == ["r1"]
+
+
+# ----------------------------------------------- in-process daemon pieces
+
+def _daemon(tmp_path, **serve_kw):
+    serve_kw.setdefault("http_port", 0)
+    serve_kw.setdefault("poll_s", 0.02)
+    serve_kw.setdefault("journal_path", str(tmp_path / "serve.jsonl"))
+    cfg = ServeConfig(**serve_kw)
+    return ServeDaemon(cfg, NUMPY_BASE, quiet=True)
+
+
+def _start(daemon):
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+    deadline = time.time() + 30
+    while daemon._httpd is None:
+        assert time.time() < deadline, "daemon never bound its port"
+        time.sleep(0.01)
+    return t, "http://127.0.0.1:%d" % daemon._httpd.server_address[1]
+
+
+def _get(url, expect=200):
+    try:
+        r = urllib.request.urlopen(url, timeout=10)
+        assert r.status == expect
+        return json.loads(r.read()) if expect == 200 else None
+    except urllib.error.HTTPError as exc:
+        assert exc.code == expect, (exc.code, exc.read())
+        return json.loads(exc.read())
+
+
+def _post(url, doc, expect=200):
+    req = urllib.request.Request(url, data=json.dumps(doc).encode())
+    try:
+        r = urllib.request.urlopen(req, timeout=10)
+        assert r.status == expect
+        return json.loads(r.read())
+    except urllib.error.HTTPError as exc:
+        assert exc.code == expect, (exc.code, exc.read())
+        return json.loads(exc.read())
+
+
+def test_daemon_http_round_trip_in_process(tmp_path):
+    ar, _ = make_synthetic_archive(nsub=4, nchan=8, nbin=16, seed=7)
+    a = str(tmp_path / "a.npz")
+    save_archive(ar, a)
+    d = _daemon(tmp_path, spool_dir=str(tmp_path / "spool"))
+    t, url = _start(d)
+    try:
+        got = _post(url + "/submit", {"paths": [a], "id": "r1"})
+        assert got == {"accepted": True, "id": "r1", "tenant": "default"}
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            state = _get(url + "/requests/r1")
+            if state["state"] in ("done", "failed"):
+                break
+            time.sleep(0.05)
+        assert state["state"] == "done", state
+        assert state["n_cleaned"] == 1
+        assert os.path.exists(a + "_cleaned.npz")
+        h = _get(url + "/healthz")
+        assert h["status"] == "ok" and h["completed"] == 1
+        assert _get(url + "/requests/ghost", expect=404)["error"]
+        # /metrics is the live registry in Prometheus exposition format
+        text = urllib.request.urlopen(url + "/metrics").read().decode()
+        from iterative_cleaner_tpu.telemetry import parse_prometheus_text
+
+        parsed = parse_prometheus_text(text)
+        assert parsed["icln_serve_completed_total"] == 1.0
+        # malformed submissions answer 400 without touching the daemon
+        assert _post(url + "/submit", {"paths": []}, expect=400)["error"]
+    finally:
+        d._on_signal(signal.SIGTERM, None)
+        t.join(30)
+    assert not t.is_alive()
+    # duplicate of a journaled id stays refused after the fact
+    states = d.journal.request_states()
+    assert states["r1"]["state"] == "done"
+
+
+def test_daemon_http_backpressure_429_and_503(tmp_path):
+    # no worker loop running: admissions stay queued, so the caps are
+    # exercised deterministically
+    from iterative_cleaner_tpu.serve.http import make_server
+
+    d = _daemon(tmp_path, max_inflight=1, queue_limit=8)
+    server = make_server(d, 0)
+    thr = threading.Thread(target=server.serve_forever,
+                           kwargs={"poll_interval": 0.05}, daemon=True)
+    thr.start()
+    url = "http://127.0.0.1:%d" % server.server_address[1]
+    try:
+        _post(url + "/submit", {"paths": ["/d/a.npz"], "id": "r1"})
+        got = _post(url + "/submit", {"paths": ["/d/b.npz"], "id": "r2"},
+                    expect=429)
+        assert got["reason"] == "tenant_limit"
+        got = _post(url + "/submit", {"paths": ["/d/a.npz"], "id": "r1"},
+                    expect=409)
+        assert got["reason"] == "duplicate"
+        d.scheduler.start_drain()
+        got = _post(url + "/submit", {"paths": ["/d/c.npz"], "id": "r3",
+                                      "tenant": "other"}, expect=503)
+        assert got["reason"] == "draining"
+        assert d.registry.counters["serve_rejected"] == 3
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_daemon_recover_reenqueues_nonterminal(tmp_path):
+    j = FleetJournal(str(tmp_path / "serve.jsonl"))
+    j.record_request("gone", "accepted", paths=["/d/a.npz"])
+    j.record_request("gone", "done")
+    j.record_request("mid", "accepted", paths=["/d/b.npz"], priority=1)
+    j.record_request("mid", "running")
+    j.record_request("fresh", "accepted", paths=["/d/c.npz"])
+    j.record_request("broken", "accepted")  # no paths: unrecoverable
+    d = _daemon(tmp_path)
+    assert d.recover() == 2
+    popped = {d.scheduler.pop(timeout=0)[0].request_id for _ in range(2)}
+    assert popped == {"mid", "fresh"}
+    assert d.scheduler.pop(timeout=0)[0] is None
+    states = d.journal.request_states()
+    assert states["broken"]["state"] == "failed"
+    assert states["gone"]["state"] == "done"  # terminal: not re-run
+
+
+# ------------------------------------------------- subprocess daemon tests
+
+SERVE_FLAGS = ["--serve", "--http-port", "0", "--rotation", "roll",
+               "--fft_mode", "dft", "--max_iter", "3", "--io-workers", "1"]
+BATCH_FLAGS = ["--fleet", "--rotation", "roll", "--fft_mode", "dft",
+               "--max_iter", "3", "--io-workers", "1", "-q"]
+
+
+def _start_daemon(tmp_path, extra=(), **env):
+    out_path = str(tmp_path / "daemon.out")
+    outf = open(out_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "iterative_cleaner_tpu", *SERVE_FLAGS,
+         "--spool", "spool", *extra],
+        env=repo_subprocess_env(ICLEAN_PROBE_TIMEOUT="0", **env),
+        cwd=str(tmp_path), stdout=outf, stderr=subprocess.STDOUT)
+    return proc, out_path
+
+
+def _daemon_port(proc, out_path, timeout=120):
+    needle = "serve: http listening on 127.0.0.1:"
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        text = open(out_path).read() if os.path.exists(out_path) else ""
+        for line in text.splitlines():
+            if line.startswith(needle):
+                return int(line[len(needle):])
+        if proc.poll() is not None:
+            pytest.fail("daemon exited before binding (rc %s):\n%s"
+                        % (proc.returncode, text[-3000:]))
+        time.sleep(0.1)
+    proc.kill()
+    pytest.fail("daemon never printed its port:\n"
+                + open(out_path).read()[-3000:])
+
+
+def _wait_request_done(jpath, rid, proc=None, timeout=240):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(jpath):
+            j = FleetJournal(jpath)
+            state = j.request_states().get(rid, {}).get("state")
+            if state in ("done", "failed"):
+                return state
+        if proc is not None and proc.poll() is not None:
+            pytest.fail("daemon exited early (rc %s)" % proc.returncode)
+        time.sleep(0.2)
+    pytest.fail("request %s never reached a terminal state" % rid)
+
+
+def _count_done_lines(jpath):
+    if not os.path.exists(jpath):
+        return []
+    out = []
+    for ln in open(jpath).read().splitlines():
+        try:
+            e = json.loads(ln)
+        except ValueError:
+            continue
+        if e.get("event") == "done":
+            out.append(e["path"])
+    return out
+
+
+def _sigterm_and_wait(proc, timeout=120):
+    proc.send_signal(signal.SIGTERM)
+    return proc.wait(timeout=timeout)
+
+
+def _run_batch_reference(tmp_path, paths):
+    r = subprocess.run(
+        [sys.executable, "-m", "iterative_cleaner_tpu", *BATCH_FLAGS,
+         *[os.path.basename(p) for p in paths]],
+        env=repo_subprocess_env(ICLEAN_PROBE_TIMEOUT="0"),
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def _assert_outputs_bit_equal(paths, ref_paths, ext):
+    for p, rp in zip(paths, ref_paths):
+        out, ref = p + "_cleaned" + ext, rp + "_cleaned" + ext
+        assert os.path.exists(out), out
+        with open(out, "rb") as a, open(ref, "rb") as b:
+            assert a.read() == b.read(), os.path.basename(out)
+
+
+def test_serve_kill9_restart_zero_duplicate_cleans(tmp_path):
+    """The daemon's crash contract end-to-end: wedge a request mid-fleet
+    with a hang fault, ``kill -9`` the daemon, restart it — the journaled
+    request re-enqueues, already-journaled archives are skipped, and the
+    outputs are byte-identical to an uninterrupted batch CLI run.
+    ``.icar`` outputs are raw little-endian arrays, so byte comparison is
+    exact."""
+    geoms = [(6, 16, 32)] * 2 + [(8, 16, 32)] * 2
+    paths = _write_fleet(tmp_path, geoms, ext=".icar")
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    ref_paths = _write_fleet(ref_dir, geoms, ext=".icar")
+    _run_batch_reference(ref_dir, ref_paths)
+    jpath = str(tmp_path / "serve.journal.jsonl")
+
+    # daemon 1: the 3rd load hangs 600s -> first bucket (2 archives)
+    # completes and journals, then the pipeline wedges
+    proc, out = _start_daemon(tmp_path,
+                              extra=["--faults", "load:hang@3"],
+                              ICLEAN_FAULT_HANG_S="600")
+    _daemon_port(proc, out)
+    _spool_submit(str(tmp_path / "spool"), "big",
+                  {"paths": [os.path.basename(p) for p in paths]})
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if len(_count_done_lines(jpath)) >= 2:
+            break
+        if proc.poll() is not None:
+            pytest.fail("daemon exited early (rc %s):\n%s"
+                        % (proc.returncode, open(out).read()[-3000:]))
+        time.sleep(0.2)
+    else:
+        proc.kill()
+        pytest.fail("journal never showed per-archive progress")
+    os.kill(proc.pid, signal.SIGKILL)
+    assert proc.wait(timeout=60) == -signal.SIGKILL
+    assert len(_count_done_lines(jpath)) == 2
+
+    # daemon 2: same cwd, no faults — recovery re-runs the journaled
+    # request; the two journaled archives must not re-clean
+    proc2, out2 = _start_daemon(tmp_path)
+    _daemon_port(proc2, out2)
+    assert _wait_request_done(jpath, "big", proc2) == "done"
+    assert _sigterm_and_wait(proc2) == 0
+
+    done = _count_done_lines(jpath)
+    assert len(done) == 4 and len(set(done)) == 4  # exactly once each
+    states = FleetJournal(jpath).request_states()
+    assert states["big"]["state"] == "done"
+    assert states["big"]["n_skipped"] == 2  # resumed, not re-cleaned
+    assert states["big"]["n_cleaned"] == 2
+    _assert_outputs_bit_equal(paths, ref_paths, ".icar")
+    assert "serve: recovered 1 journaled request" in open(out2).read()
+
+
+def test_serve_sigterm_drains_gracefully(tmp_path):
+    """SIGTERM during an active clean: the request finishes and journals,
+    mid-drain spool submissions stay untouched, exit code 0."""
+    paths = _write_fleet(tmp_path, [(6, 16, 32)] * 2, ext=".icar")
+    jpath = str(tmp_path / "serve.journal.jsonl")
+    proc, out = _start_daemon(tmp_path)
+    _daemon_port(proc, out)
+    spool = str(tmp_path / "spool")
+    _spool_submit(spool, "work",
+                  {"paths": [os.path.basename(p) for p in paths]})
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if '"state": "running"' in (open(jpath).read()
+                                    if os.path.exists(jpath) else ""):
+            break
+        if proc.poll() is not None:
+            pytest.fail("daemon exited early:\n" + open(out).read()[-3000:])
+        time.sleep(0.05)
+    else:
+        proc.kill()
+        pytest.fail("request never started running")
+    proc.send_signal(signal.SIGTERM)
+    _spool_submit(spool, "mid_drain", {"paths": ["whatever.icar"]})
+    assert proc.wait(timeout=120) == 0
+    # the active request finished and journaled before exit
+    states = FleetJournal(jpath).request_states()
+    assert states["work"]["state"] in ("done", "failed")
+    # a mid-drain submission is left for the next daemon start
+    assert "mid_drain.json" in os.listdir(spool)
+    assert "drained" in open(out).read()
+
+
+def test_serve_second_sigterm_forces_nonzero_exit(tmp_path):
+    """A wedged drain stays killable: the first SIGTERM starts the drain,
+    the second force-exits non-zero without waiting."""
+    from iterative_cleaner_tpu.serve.daemon import FORCE_EXIT_CODE
+
+    paths = _write_fleet(tmp_path, [(6, 16, 32)], ext=".icar")
+    jpath = str(tmp_path / "serve.journal.jsonl")
+    proc, out = _start_daemon(tmp_path,
+                              extra=["--faults", "execute:hang@1",
+                                     "--stage-timeout", "0"],
+                              ICLEAN_FAULT_HANG_S="600")
+    _daemon_port(proc, out)
+    _spool_submit(str(tmp_path / "spool"), "stuck",
+                  {"paths": [os.path.basename(paths[0])]})
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if '"state": "running"' in (open(jpath).read()
+                                    if os.path.exists(jpath) else ""):
+            break
+        time.sleep(0.05)
+    else:
+        proc.kill()
+        pytest.fail("request never started running")
+    time.sleep(0.5)  # let the execute hang actually begin
+    proc.send_signal(signal.SIGTERM)
+    time.sleep(1.0)
+    assert proc.poll() is None  # draining, wedged, still alive
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=60) == FORCE_EXIT_CODE
+
+
+def test_serve_fault_soak_masks_bit_equal(tmp_path):
+    """Deterministic serve-layer fault soak: intake, scheduler, load and
+    execute faults all fire; the daemon never wedges, keeps answering
+    /healthz, every request ends terminal, and the masks stay
+    bit-identical to a fault-free batch CLI run."""
+    geoms = [(6, 16, 32), (6, 16, 32), (8, 16, 32)]
+    paths = _write_fleet(tmp_path, geoms, ext=".icar")
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    ref_paths = _write_fleet(ref_dir, geoms, ext=".icar")
+    _run_batch_reference(ref_dir, ref_paths)
+    jpath = str(tmp_path / "serve.journal.jsonl")
+
+    proc, out = _start_daemon(
+        tmp_path,
+        extra=["--faults", "intake:err@1,sched:err@2,load:err@2,"
+                           "execute:oom@1",
+               "--retries", "3"],
+        ICLEAN_FAULT_HANG_S="0.01")
+    port = _daemon_port(proc, out)
+    url = "http://127.0.0.1:%d" % port
+    spool = str(tmp_path / "spool")
+    for i, p in enumerate(paths):
+        _spool_submit(spool, "req%d" % i,
+                      {"paths": [os.path.basename(p)], "priority": i})
+    for i in range(len(paths)):
+        assert _wait_request_done(jpath, "req%d" % i, proc) == "done"
+    h = json.load(urllib.request.urlopen(url + "/healthz", timeout=10))
+    assert h["status"] == "ok"
+    assert h["completed"] == len(paths) and h["failed"] == 0
+    text = urllib.request.urlopen(url + "/metrics", timeout=10).read()
+    from iterative_cleaner_tpu.telemetry import parse_prometheus_text
+
+    c = parse_prometheus_text(text.decode())
+    assert c["icln_serve_accepted_total"] == len(paths)
+    assert c["icln_serve_completed_total"] == len(paths)
+    assert c.get("icln_serve_retries_total", 0) >= 2  # intake+sched faults
+    assert c.get("icln_fleet_retries_total", 0) >= 1  # load transient
+    # the OOM lands on whichever group runs first; a multi-archive group
+    # splits, a singleton degrades — either way the ladder absorbed it
+    assert (c.get("icln_fleet_oom_splits_total", 0)
+            + c.get("icln_fleet_degraded_total", 0)) >= 1
+    assert _sigterm_and_wait(proc) == 0
+    _assert_outputs_bit_equal(paths, ref_paths, ".icar")
+
+
+def test_serve_warm_repeat_geometry_zero_new_cache_entries(tmp_path):
+    """A warm daemon serves a repeat-geometry request from the resident
+    AOT executables: fleet_precompile_hits grows and the persistent
+    compile cache gains NO new entries."""
+    a, b = _write_fleet(tmp_path, [(6, 16, 32), (6, 16, 32)], ext=".npz")
+    cache = str(tmp_path / "cache")
+    jpath = str(tmp_path / "serve.journal.jsonl")
+    proc, out = _start_daemon(tmp_path,
+                              extra=["--compile-cache", "cache"])
+    port = _daemon_port(proc, out)
+    url = "http://127.0.0.1:%d" % port
+    from iterative_cleaner_tpu.telemetry import parse_prometheus_text
+
+    def scrape():
+        text = urllib.request.urlopen(url + "/metrics", timeout=10).read()
+        return parse_prometheus_text(text.decode())
+
+    _spool_submit(str(tmp_path / "spool"), "cold",
+                  {"paths": [os.path.basename(a)]})
+    assert _wait_request_done(jpath, "cold", proc) == "done"
+    hits_cold = scrape().get("icln_fleet_precompile_hits_total", 0)
+    entries = sorted(os.listdir(cache))
+    assert entries, "cold request wrote no persistent-cache entries"
+
+    _spool_submit(str(tmp_path / "spool"), "warm",
+                  {"paths": [os.path.basename(b)]})
+    assert _wait_request_done(jpath, "warm", proc) == "done"
+    assert (scrape().get("icln_fleet_precompile_hits_total", 0)
+            >= hits_cold + 1)
+    assert sorted(os.listdir(cache)) == entries, \
+        "warm repeat-geometry request wrote new compile-cache entries"
+    assert _sigterm_and_wait(proc) == 0

@@ -466,3 +466,28 @@ def test_cli_underscore_flag_aliases():
     assert args.metrics_json == "a.json"
     assert args.prom_textfile == "b.prom"
     assert args.log_format == "json" and args.event_log == "e.jsonl"
+
+
+def test_counters_mark_and_since_delta():
+    """counters_mark/counters_since: the long-lived-process idiom — a
+    monotonic registry yields per-interval figures as deltas against a
+    mark (how the serve daemon attributes fleet_* counts to a request)."""
+    from iterative_cleaner_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter_inc("fleet_compiles", 3)
+    reg.counter_inc("fleet_cleaned", 5)
+    mark = reg.counters_mark()
+    assert reg.counters_since(mark) == {}          # nothing happened yet
+    reg.counter_inc("fleet_cleaned", 2)
+    reg.counter_inc("fleet_precompile_hits")        # absent from the mark
+    delta = reg.counters_since(mark)
+    assert delta == {"fleet_cleaned": 2.0, "fleet_precompile_hits": 1.0}
+    # unchanged counters are omitted: the delta reads as the interval
+    assert "fleet_compiles" not in delta
+    # the mark is a plain copy, immune to later increments
+    assert mark["fleet_cleaned"] == 5.0
+    # marks compose: a second interval counts from its own baseline
+    mark2 = reg.counters_mark()
+    reg.counter_inc("fleet_cleaned")
+    assert reg.counters_since(mark2) == {"fleet_cleaned": 1.0}
