@@ -887,6 +887,183 @@ def bench_serve(n_requests, geometries, max_iter=3, io_workers=2,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_multihost(n_archives, geometries, max_iter=2, claim_ttl=5.0):
+    """Multi-host fleet row: the SAME archive list served by one
+    ``--fleet`` process and by two cooperating ``--hosts 2`` processes
+    sharing a journal (the pod-slice topology, degenerately on one
+    machine — exactly how CI verifies it).
+
+    Scenario A (scaling + parity): both host processes run to
+    completion concurrently.  ``fleet_multihost_vs_single`` is the ratio
+    of the slice's serve time (max of the two hosts' ``fleet_serve_s``
+    gauges — the straggler defines the slice) to the single process's;
+    on a multi-core host it must come in under 1.0 (each process
+    compiles and serves only its hash-affine buckets), while on a single
+    core the two processes merely timeshare, so the assert is gated on
+    ``os.cpu_count()``.  Every output mask must be bit-equal to the
+    single-process run's and every archive journaled 'done' exactly once
+    — zero duplicate cleans (the rows' shared parity-is-fatal contract).
+
+    Scenario B (host death): a fresh journal is pre-seeded with an
+    EXPIRED claim from a fabricated dead host 1 (claimed, heartbeats
+    stopped — the on-disk state an actual mid-serve SIGKILL leaves
+    behind), then host 0 serves alone under ``--hosts 2``.  It must
+    steal every host-1 bucket (``fleet_stolen`` >= 1), re-serve with
+    bit-equal masks, and journal each archive done exactly once.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    import jax
+
+    from iterative_cleaner_tpu.io import load_archive, save_archive
+    from iterative_cleaner_tpu.io.synthetic import (
+        bench_rfi_density,
+        make_synthetic_archive,
+    )
+    from iterative_cleaner_tpu.parallel.fleet import (
+        bucket_host,
+        bucket_work_key,
+    )
+    from iterative_cleaner_tpu.resilience import FleetJournal
+
+    tmp = tempfile.mkdtemp(prefix="bench_multihost_")
+    try:
+        t0 = time.perf_counter()
+        paths, keys = [], set()
+        for i in range(n_archives):
+            nsub, nchan, nbin = geometries[i % len(geometries)]
+            ar, _ = make_synthetic_archive(
+                nsub=nsub, nchan=nchan, nbin=nbin,
+                **bench_rfi_density(nsub, nchan), seed=i, dtype=np.float32)
+            p = os.path.join(tmp, "mh_%03d.npz" % i)
+            save_archive(ar, p)
+            paths.append(p)
+            keys.add((nsub, nchan, nbin, bool(ar.dedispersed)))
+        owners = {bucket_host(k, 2) for k in keys}
+        assert owners == {0, 1}, \
+            f"geometry list hashes to hosts {owners}; pick shapes that " \
+            "split across both hosts or the row measures nothing"
+        _log(f"multihost stage: {n_archives} archives x {len(keys)} "
+             f"buckets generated in {time.perf_counter() - t0:.1f}s")
+
+        env = {**os.environ,
+               "ICLEAN_PLATFORM": jax.default_backend(),
+               "ICLEAN_PROBE_TIMEOUT": "0",
+               "PYTHONPATH": os.pathsep.join(
+                   [os.path.dirname(os.path.abspath(__file__))]
+                   + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+               ).rstrip(os.pathsep)}
+
+        def fleet_cmd(tag, extra):
+            metrics = os.path.join(tmp, f"metrics_{tag}.json")
+            return metrics, [sys.executable, "-m", "iterative_cleaner_tpu",
+                             "-q", "--fleet", "--max_iter", str(max_iter),
+                             "--metrics-json", metrics] + extra + paths
+
+        def read_metrics(path):
+            with open(path) as fh:
+                return json.load(fh)
+
+        def collect_outputs():
+            """Snapshot then DELETE the cleaned outputs, so each scenario
+            proves its own writes (never a predecessor's leftovers)."""
+            out = {}
+            for p in paths:
+                op = p + "_cleaned.npz"
+                out[p] = load_archive(op).weights.copy()
+                os.unlink(op)
+            return out
+
+        def assert_done_once(jpath):
+            n_done = {}
+            with open(jpath) as fh:
+                for line in fh:
+                    try:
+                        e = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(e, dict) and e.get("event") == "done":
+                        n_done[e["path"]] = n_done.get(e["path"], 0) + 1
+            dup = {p: n for p, n in n_done.items() if n != 1}
+            assert not dup, f"duplicate cleans journaled: {dup}"
+            assert len(n_done) == len(paths), \
+                f"{len(n_done)}/{len(paths)} archives journaled done"
+
+        # -- single-process reference ---------------------------------
+        metrics_1, cmd = fleet_cmd("single", [])
+        subprocess.run(cmd, env=env, check=True, stdout=subprocess.DEVNULL)
+        serve_1 = float(read_metrics(metrics_1)["gauges"]["fleet_serve_s"])
+        want = collect_outputs()
+
+        # -- scenario A: two cooperating processes --------------------
+        j_multi = os.path.join(tmp, "journal_multi.jsonl")
+        procs = []
+        for hid in (0, 1):
+            metrics, cmd = fleet_cmd(
+                f"h{hid}", ["--journal", j_multi, "--hosts", "2",
+                            "--host-id", str(hid),
+                            "--claim-ttl", str(claim_ttl)])
+            procs.append((metrics, subprocess.Popen(
+                cmd, env=env, stdout=subprocess.DEVNULL)))
+        for _metrics, proc in procs:
+            assert proc.wait(timeout=600) == 0, \
+                f"multihost fleet process exited rc={proc.returncode}"
+        serve_2 = max(
+            float(read_metrics(m)["gauges"]["fleet_serve_s"])
+            for m, _p in procs)
+        got = collect_outputs()
+        for i, p in enumerate(paths):
+            assert np.array_equal(want[p], got[p]), \
+                f"2-process masks diverged from single process (archive {i})"
+        assert_done_once(j_multi)
+        ratio = serve_2 / serve_1
+        cores = os.cpu_count() or 1
+        _log(f"multihost stage: slice serve {serve_2:.2f}s (2 procs) vs "
+             f"{serve_1:.2f}s (1 proc) -> {ratio:.2f}x on {cores} cores")
+        if cores >= 2:
+            assert ratio < 1.0, \
+                f"2 processes served in {serve_2:.2f}s vs single " \
+                f"{serve_1:.2f}s on {cores} cores; sharding bought nothing"
+
+        # -- scenario B: dead host's buckets stolen -------------------
+        j_steal = os.path.join(tmp, "journal_steal.jsonl")
+        dead = FleetJournal(j_steal)
+        for k in keys:
+            if bucket_host(k, 2) == 1:
+                dead.record_claim(bucket_work_key(k), host=1,
+                                  nonce="h1-dead-0-00000000", ttl_s=1.0,
+                                  now=time.time() - 60.0)
+        metrics_s, cmd = fleet_cmd(
+            "steal", ["--journal", j_steal, "--hosts", "2", "--host-id",
+                      "0", "--claim-ttl", str(claim_ttl)])
+        subprocess.run(cmd, env=env, check=True, stdout=subprocess.DEVNULL)
+        doc = read_metrics(metrics_s)
+        stolen = int(doc["counters"].get("fleet_stolen", 0))
+        assert stolen >= 1, \
+            "survivor host stole no buckets from the dead host"
+        got = collect_outputs()
+        for i, p in enumerate(paths):
+            assert np.array_equal(want[p], got[p]), \
+                f"stolen re-serve masks diverged (archive {i})"
+        assert_done_once(j_steal)
+        _log(f"multihost stage: survivor stole {stolen} bucket(s) from "
+             "the dead host, masks bit-equal, zero duplicate cleans")
+
+        return {
+            "fleet_hosts": 2,
+            "fleet_multihost_platform": jax.default_backend(),
+            "fleet_multihost_cores": cores,
+            "fleet_multihost_vs_single": round(ratio, 2),
+            "fleet_multihost_serve_s": round(serve_2, 2),
+            "fleet_singlehost_serve_s": round(serve_1, 2),
+            "fleet_stolen": stolen,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_numpy(nsub, nchan, nbin, max_iter=5):
     from iterative_cleaner_tpu.backends.numpy_backend import clean_cube
     from iterative_cleaner_tpu.config import CleanConfig
@@ -959,7 +1136,8 @@ def main():
     for env_key, stage in (("BENCH_STREAMING_ONLY", bench_streaming),
                            ("BENCH_BATCH_ONLY", bench_batch),
                            ("BENCH_FLEET_ONLY", bench_fleet),
-                           ("BENCH_SERVE_ONLY", bench_serve)):
+                           ("BENCH_SERVE_ONLY", bench_serve),
+                           ("BENCH_MULTIHOST_ONLY", bench_multihost)):
         if os.environ.get(env_key):
             geom = json.loads(os.environ[env_key])
             fallback_to_cpu_if_unreachable(
@@ -1076,6 +1254,25 @@ def main():
         label="serve")
     if row:
         extras = {**(extras or {}), **row}
+
+    # multi-host fleet row (parallel/fleet.py + resilience/journal.py):
+    # the same fleet served by 1 process vs 2 journal-coordinated
+    # processes (hash-partitioned buckets, work stealing), plus the
+    # dead-host steal drill — parity-is-fatal like every row above.
+    # BENCH_SKIP_MULTIHOST=1 opts out: the stage launches four CLI
+    # processes, which the tier-1 bench-schema test cannot afford inside
+    # its wall-clock budget (tests/test_bench_config.py pins this row's
+    # keys in a dedicated slow test instead).
+    if os.environ.get("BENCH_SKIP_MULTIHOST") != "1":
+        m_n, m_geoms = ((4, [[16, 32, 32], [12, 32, 32]]) if small else
+                        (8, [[16, 32, 32], [12, 32, 32]]))
+        row = _bench_row_subprocess(
+            "BENCH_MULTIHOST_ONLY",
+            {"n_archives": m_n, "geometries": m_geoms},
+            timeout=float(os.environ.get("BENCH_MULTIHOST_TIMEOUT", "900")),
+            label="multihost")
+        if row:
+            extras = {**(extras or {}), **row}
 
     if not small and jax_cfg == (1024, 4096, 128):
         # Headline methodology (BASELINE.md "Measured baselines"): divide by

@@ -296,6 +296,40 @@ def build_parser() -> argparse.ArgumentParser:
                              "duplicated cleans. Requires --journal PATH "
                              "(an implicit default journal would silently "
                              "resume against the wrong file).")
+    parser.add_argument("--hosts", type=int, default=None, metavar="N",
+                        help="Multi-host fleet sharding: serve this --fleet "
+                             "as one of N cooperating hosts (pod-slice "
+                             "processes, or N CPU processes on one box). "
+                             "Geometry buckets partition across hosts by a "
+                             "deterministic hash; hosts coordinate through "
+                             "the shared --journal (claim leases + work "
+                             "stealing), so a host that finishes early or "
+                             "dies has its buckets re-served exactly once. "
+                             "Requires --journal on storage all hosts "
+                             "share. Mirrors ICLEAN_HOSTS; defaults to a "
+                             "live jax.distributed process count when "
+                             "neither is given.")
+    parser.add_argument("--host-id", "--host_id", type=int, default=None,
+                        dest="host_id", metavar="I",
+                        help="This process's host index in [0, --hosts). "
+                             "Mirrors ICLEAN_HOST_ID.")
+    parser.add_argument("--coordinator", type=str, default="",
+                        metavar="HOST:PORT",
+                        help="Bootstrap jax.distributed for the multi-host "
+                             "fleet: the coordinator's address (process 0 "
+                             "binds it). Optional — the journal alone "
+                             "coordinates the work; the distributed "
+                             "runtime additionally enables cross-process "
+                             "metric reduction and device visibility. "
+                             "Requires --hosts and --host-id. Mirrors "
+                             "ICLEAN_COORDINATOR.")
+    parser.add_argument("--claim-ttl", "--claim_ttl", type=float,
+                        default=None, dest="claim_ttl", metavar="S",
+                        help="Multi-host claim-lease duration in seconds: "
+                             "a serving host heartbeats its bucket's lease "
+                             "at S/3; a dead host's buckets become "
+                             "stealable after at most S. Default: "
+                             "ICLEAN_CLAIM_TTL env var, else 60.")
     parser.add_argument("--serve", action="store_true",
                         help="Run as a long-lived cleaning service instead "
                              "of a batch run: keep the process (and its "
@@ -383,6 +417,11 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     return build_parser().parse_args(argv)
 
 
+def _env_int(name: str):
+    v = os.environ.get(name, "")
+    return int(v) if v else None
+
+
 def config_from_args(args: argparse.Namespace) -> CleanConfig:
     return CleanConfig(
         chanthresh=args.chanthresh,
@@ -406,6 +445,15 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
                           else CleanConfig.fleet_group_size),
         fleet_retries=getattr(args, "retries", None),
         stage_timeout_s=getattr(args, "stage_timeout", None),
+        # fold the env mirrors here so the config cross-validates the
+        # COMBINED topology (e.g. --host-id with ICLEAN_HOSTS=2 is fine)
+        fleet_hosts=(getattr(args, "hosts", None)
+                     if getattr(args, "hosts", None) is not None
+                     else _env_int("ICLEAN_HOSTS")),
+        fleet_host_id=(getattr(args, "host_id", None)
+                       if getattr(args, "host_id", None) is not None
+                       else _env_int("ICLEAN_HOST_ID")),
+        fleet_claim_ttl_s=getattr(args, "claim_ttl", None),
         compile_cache_dir=(getattr(args, "compile_cache", "") or None),
         donate_buffers=not getattr(args, "no_donate", False),
         unload_res=args.unload_res,
@@ -754,12 +802,32 @@ def _run_fleet(args, telemetry=None) -> list:
         resolve_stage_timeout,
     )
 
+    from iterative_cleaner_tpu.parallel.distributed import (
+        initialize,
+        resolve_host_topology,
+    )
+
     cfg = config_from_args(args)
+    coordinator = (args.coordinator
+                   or os.environ.get("ICLEAN_COORDINATOR", ""))
+    if coordinator:
+        # optional: the journal alone coordinates the work; the
+        # distributed runtime adds cross-process metric reduction
+        initialize(coordinator_address=coordinator,
+                   num_processes=cfg.fleet_hosts,
+                   process_id=cfg.fleet_host_id)
+    topo = resolve_host_topology(cfg.fleet_hosts, cfg.fleet_host_id)
     mesh = None
     if getattr(args, "mesh", "off") == "batch":
-        from iterative_cleaner_tpu.parallel.mesh import batch_mesh
+        from iterative_cleaner_tpu.parallel.mesh import (
+            batch_mesh,
+            local_batch_mesh,
+        )
 
-        mesh = batch_mesh()
+        # a multi-host fleet shards over LOCAL devices only: whole
+        # archives never span hosts, and a global mesh would turn every
+        # group into a collective a dead host could hang
+        mesh = local_batch_mesh() if topo.is_multi else batch_mesh()
     timer = (telemetry.registry.timer if telemetry is not None else None)
     failed: list = []
     write_lock = threading.Lock()
@@ -801,11 +869,18 @@ def _run_fleet(args, telemetry=None) -> list:
         # journal entries record the output's path+signature so a resume
         # can re-verify it; only the default naming rule is a pure
         # function of the input path (--output std needs the archive)
-        out_path_fn=default_out_path if args.output == "" else None)
+        out_path_fn=default_out_path if args.output == "" else None,
+        hosts=topo)
     if report.skipped and not args.quiet:
         print("resumed: %d archive%s already complete in %s"
               % (len(report.skipped),
                  "" if len(report.skipped) == 1 else "s", journal_path))
+    if topo.is_multi and not args.quiet:
+        print("host %d/%d: %d cleaned, %d bucket%s owned, %d stolen"
+              % (topo.host_id, topo.n_hosts, len(report.results),
+                 report.n_buckets_owned,
+                 "" if report.n_buckets_owned == 1 else "s",
+                 report.n_stolen))
     return failed
 
 
@@ -1019,6 +1094,38 @@ def main(argv=None) -> int:
             "--retries/--stage-timeout/--faults/--journal/--resume "
             "configure the --fleet/--serve resilience ladder; pass "
             "--fleet or --serve")
+    if ((args.hosts is not None or args.host_id is not None
+         or args.coordinator or args.claim_ttl is not None)
+            and not args.fleet):
+        # host sharding only exists in the fleet scheduler — a silently
+        # ignored flag would mislead (same contract as --bucket-pad)
+        build_parser().error(
+            "--hosts/--host-id/--coordinator/--claim-ttl configure the "
+            "--fleet multi-host scheduler; pass --fleet")
+    if args.hosts is not None and args.hosts < 1:
+        build_parser().error(f"--hosts must be >= 1, got {args.hosts}")
+    if args.host_id is not None and args.host_id < 0:
+        build_parser().error(
+            f"--host-id must be >= 0, got {args.host_id}")
+    if args.claim_ttl is not None and args.claim_ttl <= 0:
+        build_parser().error(
+            f"--claim-ttl must be > 0, got {args.claim_ttl}")
+    if args.host_id is not None and args.hosts is None \
+            and not os.environ.get("ICLEAN_HOSTS"):
+        build_parser().error(
+            "--host-id needs the host count: pass --hosts N (or set "
+            "ICLEAN_HOSTS)")
+    eff_hosts = (args.hosts if args.hosts is not None
+                 else _env_int("ICLEAN_HOSTS"))
+    if eff_hosts is not None and eff_hosts > 1 and not args.journal:
+        build_parser().error(
+            "--hosts N > 1 coordinates through the shared journal "
+            "(claim leases, work stealing, exactly-once accounting); "
+            "pass --journal PATH on storage every host shares")
+    if args.coordinator and (args.hosts is None or args.host_id is None):
+        build_parser().error(
+            "--coordinator bootstraps an explicit process grid; pass "
+            "both --hosts and --host-id with it")
     if args.retries is not None and args.retries < 0:
         build_parser().error(f"--retries must be >= 0, got {args.retries}")
     if args.stage_timeout is not None and args.stage_timeout < 0:

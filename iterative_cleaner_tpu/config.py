@@ -110,6 +110,24 @@ class CleanConfig:
     # watchdog (ROUND5_NOTES' 27-minute silent wedge).  None defers to
     # the ICLEAN_STAGE_TIMEOUT env var, then off; 0 means off.
     stage_timeout_s: Optional[float] = None
+    # multi-host fleet sharding (parallel/fleet.py + parallel/
+    # distributed.py): how many cooperating hosts serve this fleet and
+    # which one this process is.  Buckets partition across hosts by a
+    # deterministic hash of their geometry key, coordinated through the
+    # shared --journal (claim leases, work stealing) — so the degenerate
+    # deployment is N CPU processes on one machine, and a TPU pod slice
+    # fills the same two numbers from jax.distributed.  None defers to
+    # the ICLEAN_HOSTS/ICLEAN_HOST_ID env mirrors, then to an already
+    # bootstrapped jax.distributed run, then to single-host.  Placement
+    # knobs never change any archive's mask, so all three are excluded
+    # from the checkpoint/journal config identity.
+    fleet_hosts: Optional[int] = None
+    fleet_host_id: Optional[int] = None
+    # claim-lease duration (seconds): a serving host heartbeats its
+    # bucket's lease at ttl/3; when a host dies its lease expires after
+    # at most this long and another host steals the bucket.  None defers
+    # to ICLEAN_CLAIM_TTL, then 60.
+    fleet_claim_ttl_s: Optional[float] = None
     # persistent XLA compilation-cache directory
     # (utils.configure_compilation_cache): compiled programs are reloaded
     # across process restarts, so a warm re-serve of the same fleet pays
@@ -196,6 +214,22 @@ class CleanConfig:
             raise ValueError(
                 f"stage_timeout_s must be >= 0 (0/None disables the "
                 f"watchdog), got {self.stage_timeout_s}")
+        if self.fleet_hosts is not None and self.fleet_hosts < 1:
+            raise ValueError(
+                f"fleet_hosts must be >= 1, got {self.fleet_hosts}")
+        if self.fleet_host_id is not None:
+            if self.fleet_hosts is None:
+                raise ValueError(
+                    "fleet_host_id without fleet_hosts: a host index is "
+                    "meaningless without the host count")
+            if not 0 <= self.fleet_host_id < self.fleet_hosts:
+                raise ValueError(
+                    f"fleet_host_id must be in [0, {self.fleet_hosts}), "
+                    f"got {self.fleet_host_id}")
+        if self.fleet_claim_ttl_s is not None and self.fleet_claim_ttl_s <= 0:
+            raise ValueError(
+                f"fleet_claim_ttl_s must be > 0, got "
+                f"{self.fleet_claim_ttl_s}")
 
 
 @dataclasses.dataclass(frozen=True)

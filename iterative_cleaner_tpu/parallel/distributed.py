@@ -19,10 +19,78 @@ along them every iteration — ride ICI within a host's slice.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import os
+import sys
 from typing import Optional, Sequence
 
 import numpy as np
+
+ENV_HOSTS = "ICLEAN_HOSTS"
+ENV_HOST_ID = "ICLEAN_HOST_ID"
+ENV_COORDINATOR = "ICLEAN_COORDINATOR"
+
+
+@dataclasses.dataclass(frozen=True)
+class HostTopology:
+    """This process's slot in a multi-host fleet: which host it is and
+    how many hosts share the work.  Purely logical — N cooperating CPU
+    processes over one shared journal are a valid 'pod slice' (that is
+    how CI exercises the multi-host path); a real ``jax.distributed``
+    bootstrap just fills the same two numbers in."""
+
+    host_id: int = 0
+    n_hosts: int = 1
+
+    def __post_init__(self) -> None:
+        if self.n_hosts < 1:
+            raise ValueError(f"n_hosts must be >= 1, got {self.n_hosts}")
+        if not 0 <= self.host_id < self.n_hosts:
+            raise ValueError(
+                f"host_id must be in [0, {self.n_hosts}), got "
+                f"{self.host_id}")
+
+    @property
+    def is_multi(self) -> bool:
+        return self.n_hosts > 1
+
+
+def resolve_host_topology(hosts: Optional[int] = None,
+                          host_id: Optional[int] = None) -> HostTopology:
+    """Resolve the fleet host topology: explicit values, else the
+    ``ICLEAN_HOSTS``/``ICLEAN_HOST_ID`` env mirrors, else an already
+    bootstrapped ``jax.distributed`` run (process index/count), else a
+    single host.  Never imports jax itself (the numpy-oracle path stays
+    jax-free); half-specified topologies are an error, not a guess."""
+    if hosts is None:
+        env = os.environ.get(ENV_HOSTS, "")
+        hosts = int(env) if env else None
+    if host_id is None:
+        env = os.environ.get(ENV_HOST_ID, "")
+        host_id = int(env) if env else None
+    if hosts is None and host_id is None:
+        jax = sys.modules.get("jax")
+        if jax is not None and jax.process_count() > 1:
+            return HostTopology(host_id=jax.process_index(),
+                                n_hosts=jax.process_count())
+        return HostTopology()
+    if hosts is None or (host_id is None and hosts > 1):
+        raise ValueError(
+            "half-specified host topology: pass both hosts and host_id "
+            "(or both ICLEAN_HOSTS and ICLEAN_HOST_ID) — guessing the "
+            "missing half would serve the wrong bucket set")
+    return HostTopology(host_id=int(host_id or 0), n_hosts=int(hosts))
+
+
+def stable_shard(key: str, n_shards: int) -> int:
+    """Deterministic, process/seed-independent shard assignment: a
+    blake2b of the key string modulo ``n_shards``.  Python's builtin
+    ``hash`` is salted per process (PYTHONHASHSEED), so two hosts would
+    disagree on every assignment — the one property this function must
+    never lose."""
+    n = max(1, int(n_shards))
+    digest = hashlib.blake2b(str(key).encode(), digest_size=8).digest()
+    return int.from_bytes(digest, "big") % n
 
 
 @dataclasses.dataclass(frozen=True)
@@ -126,6 +194,16 @@ def aggregate_metrics_across_processes(counters: dict) -> dict:
     Collective discipline: all processes must call this with the SAME key
     set in the same program position (keys are reduced in sorted order);
     values must be numeric.
+
+    Telemetry must never sink a run that already finished its real work:
+    when the backend cannot run the allgather (CPU multi-process JAX
+    rejects ``process_allgather`` even though sharded-jit collectives
+    work — tests/test_multiprocess.py), this degrades to the LOCAL
+    counters with a stderr note instead of raising.  Multi-host fleet
+    runs still export whole-slice totals either way, through the
+    journal's stats fold (``<counter>_slice`` gauges — see
+    parallel/fleet._publish_host_stats), which needs no collective at
+    all.
     """
     import jax
 
@@ -136,8 +214,14 @@ def aggregate_metrics_across_processes(counters: dict) -> dict:
     names = sorted(counters)
     stacked = np.asarray([float(counters[k]) for k in names],
                          dtype=np.float64)
-    summed = np.asarray(multihost_utils.process_allgather(stacked)).sum(
-        axis=0)
+    try:
+        summed = np.asarray(
+            multihost_utils.process_allgather(stacked)).sum(axis=0)
+    except Exception as exc:  # backend-dependent collective support
+        print("WARNING: cross-process metric reduction unavailable "
+              f"({type(exc).__name__}); exporting this process's local "
+              "counters", file=sys.stderr)
+        return dict(counters)
     return {k: float(v) for k, v in zip(names, summed)}
 
 

@@ -59,6 +59,20 @@ This module is that bucketing, plus the serving pipeline around it:
    (``fleet_resumed_skips``).  The deterministic fault injector
    (``ICLEAN_FAULTS`` / ``--faults``) drills every one of these paths at
    the named sites peek/load/compile/execute/write without hardware.
+6. **Multi-host sharding** (``clean_fleet(..., hosts=...)`` /
+   ``--hosts``): buckets partition across a pod slice — or N cooperating
+   CPU processes — by a deterministic hash of their geometry key
+   (:func:`bucket_host`), so every host computes the same plan and the
+   same assignment with zero communication, and each host precompiles
+   only the buckets it will serve.  Coordination runs entirely through
+   the shared flock'd journal: a host claims a bucket (lease +
+   heartbeats) before serving it, steals unclaimed or lease-expired
+   buckets once its own are done, and skips any archive another host
+   already journaled — a dead host's work is re-served exactly once,
+   with bit-equal masks (``fleet_stolen``/``fleet_buckets_owned``/
+   ``fleet_claim_conflicts``).  No collectives on the serve path, so a
+   dead host can never hang the survivors; whole-slice telemetry folds
+   from per-host journal 'stats' snapshots instead.
 
 Mask parity: with quantization off (``bucket_pad=(0, 0)``, the default) every
 archive's results are bit-equal to the sequential per-archive path — batch
@@ -100,6 +114,40 @@ def resolve_io_workers(value: Optional[int] = None) -> int:
     if value < 1:
         raise ValueError(f"io_workers must be >= 1, got {value}")
     return value
+
+
+def resolve_claim_ttl(value: Optional[float] = None) -> float:
+    """The multi-host claim-lease duration: explicit value, else the
+    ``ICLEAN_CLAIM_TTL`` env var, else 60 s (a serving host heartbeats
+    at ttl/3, so a dead host's buckets are stealable within a minute)."""
+    if value is None:
+        env = os.environ.get("ICLEAN_CLAIM_TTL", "")
+        value = float(env) if env else 60.0
+    value = float(value)
+    if value <= 0:
+        raise ValueError(f"claim ttl must be > 0, got {value}")
+    return value
+
+
+def bucket_host(key: ShapeKey, n_hosts: int) -> int:
+    """Deterministic bucket -> host affinity: a stable hash of the
+    compiled geometry key modulo the host count.  Every host computes the
+    same full plan and the same assignment with zero communication — and
+    because the key IS the compiled geometry, a host precompiles exactly
+    the programs it will serve (the per-host warm-start win)."""
+    from iterative_cleaner_tpu.parallel.distributed import stable_shard
+
+    nsub, nchan, nbin, ded = key
+    return stable_shard("%dx%dx%d:%d" % (int(nsub), int(nchan), int(nbin),
+                                         int(bool(ded))), n_hosts)
+
+
+def bucket_work_key(key: ShapeKey) -> str:
+    """The journal claim key for one bucket — geometry, not host, so a
+    steal targets exactly the work the dead host left."""
+    nsub, nchan, nbin, ded = key
+    return "bucket:%dx%dx%d:%d" % (int(nsub), int(nchan), int(nbin),
+                                   int(bool(ded)))
 
 
 def quantize_geometry(nsub: int, nchan: int,
@@ -325,6 +373,15 @@ class FleetReport:
     n_oom_splits: int = 0
     n_degraded: int = 0
     n_watchdog_trips: int = 0
+    # multi-host accounting: this process's slot, how many buckets its
+    # hash owned vs stole, and — once every host published its journal
+    # 'stats' snapshot — the whole slice's per-host counter breakdown
+    host_id: int = 0
+    n_hosts: int = 1
+    n_buckets_owned: int = 0
+    n_stolen: int = 0
+    host_counters: Dict[int, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -369,7 +426,8 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
                                             None]] = None,
                 precompile: bool = True,
                 resilience=None,
-                out_path_fn: Optional[Callable[[str], str]] = None
+                out_path_fn: Optional[Callable[[str], str]] = None,
+                hosts=None
                 ) -> FleetReport:
     """Serve an arbitrary archive-path list through the compiled batch path.
 
@@ -406,6 +464,19 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
     ``out_path_fn(path)`` (when provided) names the output file each
     completion entry records, so a resume can re-verify the output's
     signature before trusting it.
+
+    ``hosts`` (a :class:`~iterative_cleaner_tpu.parallel.distributed
+    .HostTopology`, default resolved from the config's ``fleet_hosts``/
+    ``fleet_host_id``, their env mirrors, or a live ``jax.distributed``
+    bootstrap) scales the fleet across a pod slice — or, degenerately,
+    N cooperating CPU processes.  Buckets partition across hosts by
+    :func:`bucket_host` (each host precompiles only its own buckets,
+    preserving the per-host warm start), every bucket is served under a
+    journal claim lease with heartbeats, and a host that finishes early
+    steals unclaimed or lease-expired buckets — already-journaled
+    archives are skipped on a steal, so a dead host's work is re-served
+    exactly once with bit-equal masks.  Multi-host serving therefore
+    REQUIRES ``resilience.journal`` on storage every host shares.
     """
     import concurrent.futures as cf
 
@@ -438,7 +509,23 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
     if res.faults is not None:
         res.faults.bind(reg)
 
-    report = FleetReport(results={}, failures=[])
+    from iterative_cleaner_tpu.parallel.distributed import (
+        HostTopology,
+        resolve_host_topology,
+    )
+
+    topo: HostTopology = (hosts if hosts is not None
+                          else resolve_host_topology(config.fleet_hosts,
+                                                     config.fleet_host_id))
+    if topo.is_multi and res.journal is None:
+        raise ValueError(
+            "multi-host fleet serving coordinates through the shared "
+            "journal (claim leases, work stealing, exactly-once "
+            "accounting); pass a ResiliencePlan with a journal on "
+            "storage every host shares (--journal PATH)")
+
+    report = FleetReport(results={}, failures=[],
+                         host_id=topo.host_id, n_hosts=topo.n_hosts)
 
     def fail(path: str, stage: str, exc: BaseException) -> None:
         report.failures.append((path, stage, exc))
@@ -504,20 +591,30 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
         events.emit("fleet_plan", n_archives=len(entries),
                     n_buckets=len(plan.buckets), n_groups=len(groups),
                     bucket_pad=list(bucket_pad), group_size=group_size)
-    if not groups:
+    if not groups and not topo.is_multi:
         return report
 
     serve_t0 = time.perf_counter()
-    precompiler = (BucketPrecompiler(plan, config, mesh=mesh, registry=reg,
-                                     faults=res.faults)
-                   if precompile else None)
-    try:
-        _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
-                      io_workers, load_fn, write_fn, clean_archives_batched,
-                      cf, res, cfg_hash, out_path_fn)
-    finally:
-        if precompiler is not None:
-            precompiler.shutdown()
+    if topo.is_multi:
+        reg.gauge_set("fleet_hosts", topo.n_hosts)
+        reg.gauge_set("fleet_host_id", topo.host_id)
+        if groups:
+            _serve_multihost(plan, topo, config, mesh, reg, report, fail,
+                             precompile, io_workers, load_fn, write_fn,
+                             clean_archives_batched, cf, res, cfg_hash,
+                             out_path_fn, events)
+    else:
+        precompiler = (BucketPrecompiler(plan, config, mesh=mesh,
+                                         registry=reg, faults=res.faults)
+                       if precompile else None)
+        try:
+            _serve_groups(groups, config, mesh, reg, report, fail,
+                          precompiler, io_workers, load_fn, write_fn,
+                          clean_archives_batched, cf, res, cfg_hash,
+                          out_path_fn)
+        finally:
+            if precompiler is not None:
+                precompiler.shutdown()
     reg.gauge_set("fleet_serve_s", time.perf_counter() - serve_t0)
     report.n_compiles = int(reg.counters.get("fleet_compiles", 0.0))
     delta = reg.counters_since(mark)
@@ -526,16 +623,206 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
     report.n_degraded = int(delta.get("fleet_degraded", 0.0))
     report.n_watchdog_trips = int(delta.get("fleet_watchdog_trips", 0.0))
     reg.counter_inc("fleet_cleaned", len(report.results))
+    if topo.is_multi:
+        report.n_buckets_owned = int(delta.get("fleet_buckets_owned", 0.0))
+        report.n_stolen = int(delta.get("fleet_stolen", 0.0))
+        # paths another host finished land in `skipped` — every input
+        # path still resolves to exactly one of results/skipped/failures
+        done = res.journal.completed(cfg_hash)
+        accounted = set(report.results)
+        accounted.update(p for p, _stage, _exc in report.failures)
+        accounted.update(report.skipped)
+        for p in pending_paths:
+            if p in accounted:
+                continue
+            if os.path.abspath(p) in done:
+                report.skipped.append(p)
+                reg.counter_inc("fleet_remote_done")
+        _publish_host_stats(topo, reg, report, res.journal,
+                            reg.counters_since(mark))
     record_builder_cache_stats(reg)
     return report
 
 
+def _publish_host_stats(topo, reg, report, journal, delta) -> None:
+    """Whole-slice telemetry without a collective: append this host's
+    ``fleet_*`` counter deltas to the shared journal, then fold every
+    host's last snapshot into per-host breakdown gauges
+    (``<counter>_host<i>``) and slice totals (``<counter>_slice``).  A
+    dead host simply contributes its last-published numbers (or none) —
+    unlike an allgather, nobody blocks on it.  The last host to finish
+    sees the complete slice; earlier finishers see a prefix."""
+    stats = {k: float(v) for k, v in delta.items()
+             if k.startswith("fleet_")}
+    journal.record_host_stats(topo.host_id, stats)
+    all_stats = journal.host_stats()
+    report.host_counters = {int(h): dict(c) for h, c in all_stats.items()}
+    slice_totals: Dict[str, float] = {}
+    for hid in sorted(all_stats):
+        for k, v in sorted(all_stats[hid].items()):
+            reg.gauge_set("%s_host%d" % (k, hid), float(v))
+            slice_totals[k] = slice_totals.get(k, 0.0) + float(v)
+    for k in sorted(slice_totals):
+        reg.gauge_set(k + "_slice", slice_totals[k])
+
+
+def _journal_done(done: Dict[str, dict], path: str) -> bool:
+    """Is ``path`` verifiably complete per the shared journal?  The
+    multi-host exactly-once check: a 'done' entry exists AND still
+    re-verifies (input unchanged, output present) — the same rule
+    ``--resume`` trusts, applied per bucket claim so stolen work skips
+    everything the dead host actually finished."""
+    from iterative_cleaner_tpu.resilience import entry_is_current
+
+    entry = done.get(os.path.abspath(path))
+    return entry is not None and entry_is_current(entry)
+
+
+class _ClaimHeartbeat:
+    """Background lease refresher for one claimed bucket: appends an
+    'hb' line every ttl/3 until stopped, so a live (even slow) host is
+    never stolen from — only a dead one, whose heartbeats stop."""
+
+    def __init__(self, journal, work: str, host: int, nonce: str,
+                 ttl_s: float) -> None:
+        import threading
+
+        self._stop = threading.Event()
+
+        def beat() -> None:
+            while not self._stop.wait(ttl_s / 3.0):
+                try:
+                    journal.heartbeat(work, host=host, nonce=nonce,
+                                      ttl_s=ttl_s)
+                except Exception:
+                    # a missed heartbeat only risks an early steal, and
+                    # steals are idempotent — never kill the serve thread
+                    pass
+
+        self._thread = threading.Thread(target=beat, daemon=True,
+                                        name="icln-claim-hb")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+def _serve_multihost(plan, topo, config, mesh, reg, report, fail,
+                     precompile, io_workers, load_fn, write_fn,
+                     clean_archives_batched, cf, res, cfg_hash,
+                     out_path_fn, events) -> None:
+    """:func:`clean_fleet`'s multi-host serve loop.
+
+    Sweep the plan's buckets — own (hash-affine) buckets first, foreign
+    ones only once own work is done — claiming each through the shared
+    journal before serving it and releasing after its writes landed.
+    Unclaimed and lease-expired foreign buckets are stolen; buckets
+    under another host's live (heartbeating) lease are left alone.  The
+    loop exits when every bucket is either journal-complete or was
+    attempted locally — so the slice drains even if this host ends up
+    serving everything (the degenerate one-survivor case), and a host
+    whose peers are still serving waits for their 'done' entries (or
+    their lease expiry) rather than exiting with the slice incomplete."""
+    journal = res.journal
+    ttl = resolve_claim_ttl(config.fleet_claim_ttl_s)
+    poll_s = min(1.0, ttl / 4.0)
+    # host id + pid + random tag: a restarted host must not inherit its
+    # dead predecessor's lease
+    nonce = "h%d-%d-%s" % (topo.host_id, os.getpid(), os.urandom(4).hex())
+    owned = [b for b in plan.buckets
+             if bucket_host(b.key, topo.n_hosts) == topo.host_id]
+    foreign = [b for b in plan.buckets
+               if bucket_host(b.key, topo.n_hosts) != topo.host_id]
+    own_keys = {b.key for b in owned}
+    reg.counter_inc("fleet_buckets_owned", len(owned))
+    if events is not None:
+        events.emit("fleet_hosts", host_id=topo.host_id,
+                    n_hosts=topo.n_hosts, owned=len(owned),
+                    foreign=len(foreign), claim_ttl_s=ttl)
+    # per-host precompiler over OWN buckets only: each host AOT-compiles
+    # exactly the programs its hash affinity will serve (the per-host
+    # warm-start win); stolen buckets compile inline — rare by design
+    own_plan = FleetPlan(buckets=owned, bucket_pad=plan.bucket_pad,
+                         group_size=plan.group_size)
+    precompiler = (BucketPrecompiler(own_plan, config, mesh=mesh,
+                                     registry=reg, faults=res.faults)
+                   if precompile and owned else None)
+    finished = set()        # bucket keys this host is done considering
+    try:
+        while True:
+            progressed = False
+            for bucket in owned + foreign:
+                if bucket.key in finished:
+                    continue
+                own_pending = any(b.key not in finished for b in owned)
+                if bucket.key not in own_keys and own_pending:
+                    continue    # steal only once own work is done
+                done = journal.completed(cfg_hash)
+                remaining = [it for it in bucket.items
+                             if not _journal_done(done, it.path)]
+                if not remaining:
+                    finished.add(bucket.key)
+                    progressed = True
+                    continue
+                work = bucket_work_key(bucket.key)
+                owner = journal.claim_table().get(work)
+                if (owner is not None and owner["live"]
+                        and owner["nonce"] != nonce):
+                    continue    # live lease elsewhere: leave it be
+                if not journal.try_claim(work, host=topo.host_id,
+                                         nonce=nonce, ttl_s=ttl):
+                    reg.counter_inc("fleet_claim_conflicts")
+                    continue    # lost the append race
+                stolen = bucket.key not in own_keys
+                if stolen:
+                    reg.counter_inc("fleet_stolen")
+                if events is not None:
+                    events.emit("fleet_claim", work=work, stolen=stolen,
+                                n_items=len(remaining))
+                # same key and batch_dim as the full bucket: identical
+                # compiled program, and batch-pad lanes are independent,
+                # so a partial re-serve keeps every mask bit-equal
+                sub = FleetBucket(key=bucket.key, items=remaining,
+                                  batch_dim=bucket.batch_dim)
+                sub_groups = [(sub, chunk) for chunk in sub.groups()]
+                hb = _ClaimHeartbeat(journal, work, topo.host_id, nonce,
+                                     ttl)
+                try:
+                    _serve_groups(sub_groups, config, mesh, reg, report,
+                                  fail, precompiler, io_workers, load_fn,
+                                  write_fn, clean_archives_batched, cf,
+                                  res, cfg_hash, out_path_fn,
+                                  journal_unwritten=True)
+                finally:
+                    hb.stop()
+                journal.release(work, host=topo.host_id, nonce=nonce)
+                finished.add(bucket.key)
+                progressed = True
+            if all(b.key in finished for b in plan.buckets):
+                break
+            if not progressed:
+                time.sleep(poll_s)
+    finally:
+        if precompiler is not None:
+            precompiler.shutdown()
+
+
 def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                   io_workers, load_fn, write_fn, clean_archives_batched,
-                  cf, res, cfg_hash, out_path_fn) -> None:
+                  cf, res, cfg_hash, out_path_fn,
+                  journal_unwritten: bool = False) -> None:
     """:func:`clean_fleet`'s pipeline body: load lookahead -> rendezvous
     with the precompiler -> batched clean (through the OOM/retry recovery
-    ladder) -> async journaled write-back."""
+    ladder) -> async journaled write-back.
+
+    ``journal_unwritten`` (the multi-host serve loop sets it) journals a
+    'done' entry even when there is no ``write_fn``: with no output file
+    the clean's completion IS the unit of work peers must not repeat, so
+    it has to land in the journal before the bucket lease is released.
+    Single-host serving keeps the write-gated behaviour — a resume with
+    no recorded output would otherwise skip the re-clean that produces
+    the in-memory result the caller asked for."""
     from iterative_cleaner_tpu.resilience import (
         OOM,
         TRANSIENT,
@@ -735,6 +1022,11 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                 if write_fn is not None:
                     write_futs.append(
                         (it, write_pool.submit(write_task, it.path, ar, r)))
+                elif journal_unwritten and res.journal is not None:
+                    res.journal.record_done(
+                        it.path, config_hash=cfg_hash,
+                        out_path=out_path_fn(it.path) if out_path_fn
+                        else None)
         for it, fut in write_futs:
             try:
                 fut.result()

@@ -39,3 +39,15 @@ def batch_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = No
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), ("batch",))
+
+
+def local_batch_mesh(n_devices: Optional[int] = None):
+    """1-D ('batch',) mesh over THIS process's local devices only — the
+    multi-host fleet's per-host mesh.  Each host serves whole archives
+    on its own chips (the batch axis is embarrassingly parallel, so
+    nothing is gained by spanning hosts), and a mesh of global devices
+    would turn every group into a collective that a dead host hangs —
+    exactly what the journal-mediated design avoids."""
+    import jax
+
+    return batch_mesh(n_devices, devices=jax.local_devices())

@@ -39,6 +39,7 @@ from iterative_cleaner_tpu.resilience.faults import (  # noqa: F401
     parse_fault_spec,
 )
 from iterative_cleaner_tpu.resilience.journal import (  # noqa: F401
+    CLAIM_STATES,
     FleetJournal,
     entry_is_current,
 )

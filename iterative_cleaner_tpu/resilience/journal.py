@@ -37,12 +37,38 @@ make the re-run skip every archive that already finished — exactly-once
 cleaning across the crash.  The two event kinds never collide: archive
 readers filter ``event == "done"``, request readers ``event == "req"``.
 
+**Claim events** (the multi-host fleet's work-stealing substrate) share
+the file too::
+
+    {"schema": "icln-fleet-journal/1", "event": "claim",
+     "work": "<bucket key>", "host": 0, "nonce": "<unique claimant id>",
+     "state": "claim" | "hb" | "release", "t": <epoch s>, "ttl": <s>}
+
+Claims are leases, not locks: a 'claim' grants ``work`` to ``nonce``
+when the work is unowned, already owned by the same nonce, or the
+current owner's lease had expired at the claim's timestamp; 'hb'
+(heartbeat) extends the owner's lease; 'release' ends it.  Because
+appends are serialized by the flock and every reader folds the SAME
+line order through the SAME rule (:meth:`FleetJournal.claim_table`),
+all hosts agree on every work item's owner without any other channel —
+:meth:`FleetJournal.try_claim` is append-then-read-back.  A dead host
+stops heartbeating, its lease expires, and a finisher steals the work;
+the per-archive 'done' entries above keep the steal idempotent (already
+-finished archives are skipped, never re-cleaned).
+
+**Host stats events** carry each host's final ``fleet_*`` counter
+deltas (``event: "stats"``) so any process — or a post-mortem reader —
+can aggregate whole-slice telemetry from the journal alone, without a
+collective that a dead host would hang.
+
 **Compaction** (:meth:`FleetJournal.compact`): a long-lived daemon's
 journal grows one line per archive forever; compaction atomically
 rewrites it keeping only the live lines — the last 'done' entry per
-archive path and the last 'req' entry per request id (terminal request
-ids keep one line apiece so accepted-entry replay stays impossible).
-The rewrite runs under the appenders' flock via
+archive path, the last 'req' entry per request id (terminal request
+ids keep one line apiece so accepted-entry replay stays impossible),
+every claim line of works whose lease is still granted (the fold needs
+the history; released works drop all their lines) and the last 'stats'
+line per host.  The rewrite runs under the appenders' flock via
 :func:`~iterative_cleaner_tpu.utils.logging.compact_under_lock`, so
 compacting under live traffic loses no entries.
 """
@@ -51,6 +77,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Dict, List, Optional
 
 SCHEMA = "icln-fleet-journal/1"
@@ -58,6 +85,9 @@ SCHEMA = "icln-fleet-journal/1"
 # request lifecycle states; the daemon may only trust "done"/"failed" as
 # final — anything else re-enqueues on restart
 REQUEST_TERMINAL = ("done", "failed")
+
+# claim lease states: grant / extend / end
+CLAIM_STATES = ("claim", "hb", "release")
 
 
 def entry_is_current(entry: dict) -> bool:
@@ -108,7 +138,20 @@ class FleetJournal:
     def _append(self, entry: dict) -> None:
         from iterative_cleaner_tpu.utils.logging import locked_append
 
-        locked_append(self.path, json.dumps(entry, sort_keys=True) + "\n")
+        text = json.dumps(entry, sort_keys=True) + "\n"
+        # heal a torn tail: a writer killed mid-line leaves no trailing
+        # newline, and appending straight after it would glue THIS line
+        # onto the garbage — losing a good entry, not just the torn one.
+        # The probe races other appenders at worst into a spurious blank
+        # line, which readers skip.
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                if f.read(1) != b"\n":
+                    text = "\n" + text
+        except (OSError, ValueError):
+            pass          # absent or empty file: nothing to heal
+        locked_append(self.path, text)
 
     def record_done(self, in_path: str, *, config_hash: str,
                     out_path: Optional[str] = None) -> None:
@@ -177,17 +220,141 @@ class FleetJournal:
                 out[rid] = merged
         return out
 
+    # ------------------------------------------------------ work claims
+
+    def record_claim(self, work: str, *, host: int, nonce: str,
+                     ttl_s: float, state: str = "claim",
+                     now: Optional[float] = None) -> None:
+        """Append one claim-lease line.  ``work`` is an opaque work-item
+        key (the fleet uses the bucket geometry), ``nonce`` uniquely
+        identifies the claimant attempt (host id + pid + random tag — a
+        restarted host must not inherit its dead predecessor's lease),
+        ``ttl_s`` the lease duration from ``now``."""
+        if state not in CLAIM_STATES:
+            raise ValueError(f"unknown claim state {state!r}")
+        self._append({
+            "schema": SCHEMA, "event": "claim", "work": str(work),
+            "host": int(host), "nonce": str(nonce), "state": state,
+            "t": float(time.time() if now is None else now),
+            "ttl": float(ttl_s),
+        })
+
+    @staticmethod
+    def _fold_claims(entries) -> Dict[str, dict]:
+        """Fold claim lines (file order) into work -> owner.  Every
+        reader applies this same rule to the same flock-serialized line
+        order, so all hosts agree on each lease with no other channel:
+        a 'claim' wins iff the work is unowned, owned by the same nonce,
+        or the owner's lease had already expired at the claim's own
+        timestamp; 'hb' extends the owner's lease; 'release' ends it."""
+        owners: Dict[str, dict] = {}
+        for entry in entries:
+            if entry.get("event") != "claim" or not entry.get("work"):
+                continue
+            work, state = entry["work"], entry.get("state")
+            t = float(entry.get("t", 0.0))
+            ttl = float(entry.get("ttl", 0.0))
+            cur = owners.get(work)
+            if state == "claim":
+                if (cur is None or cur["nonce"] == entry.get("nonce")
+                        or cur["expires"] <= t):
+                    owners[work] = {"host": int(entry.get("host", -1)),
+                                    "nonce": str(entry.get("nonce", "")),
+                                    "expires": t + ttl}
+            elif state == "hb":
+                if cur is not None and cur["nonce"] == entry.get("nonce"):
+                    cur["expires"] = t + ttl
+            elif state == "release":
+                if cur is not None and cur["nonce"] == entry.get("nonce"):
+                    del owners[work]
+        return owners
+
+    def claim_table(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """work -> ``{"host", "nonce", "expires", "live"}`` for every
+        work item whose lease was granted and not released.  ``live`` is
+        False once the lease expired (stealable).  Torn tails and
+        foreign lines are skipped, never fatal."""
+        if now is None:
+            now = time.time()
+        if not os.path.exists(self.path):
+            return {}
+        with open(self.path, "r") as f:
+            owners = self._fold_claims(_parse_lines(f.read()))
+        for own in owners.values():
+            own["live"] = own["expires"] > now
+        return owners
+
+    def try_claim(self, work: str, *, host: int, nonce: str,
+                  ttl_s: float, now: Optional[float] = None) -> bool:
+        """Atomically try to take (or steal) ``work``: append a claim
+        line, then read the fold back — True iff this ``nonce`` is the
+        owner.  Losing a race costs one dead line; the flock'd append
+        order guarantees exactly one winner, on every host's reading."""
+        self.record_claim(work, host=host, nonce=nonce, ttl_s=ttl_s,
+                          now=now)
+        own = self.claim_table(now=now).get(str(work))
+        return own is not None and own["nonce"] == str(nonce)
+
+    def heartbeat(self, work: str, *, host: int, nonce: str,
+                  ttl_s: float, now: Optional[float] = None) -> None:
+        """Extend a held lease (no-op in the fold if the lease was lost
+        — a heartbeat never steals)."""
+        self.record_claim(work, host=host, nonce=nonce, ttl_s=ttl_s,
+                          state="hb", now=now)
+
+    def release(self, work: str, *, host: int, nonce: str,
+                now: Optional[float] = None) -> None:
+        self.record_claim(work, host=host, nonce=nonce, ttl_s=0.0,
+                          state="release", now=now)
+
+    # ------------------------------------------------------- host stats
+
+    def record_host_stats(self, host: int, counters: Dict[str, float]
+                          ) -> None:
+        """Append one per-host telemetry snapshot (the host's fleet_*
+        counter deltas for this run) — the collective-free aggregation
+        substrate: any process can sum the slice from the journal even
+        when another host is dead."""
+        self._append({"schema": SCHEMA, "event": "stats",
+                      "host": int(host),
+                      "counters": {str(k): float(v)
+                                   for k, v in counters.items()}})
+
+    def host_stats(self) -> Dict[int, dict]:
+        """host id -> last recorded counter snapshot."""
+        out: Dict[int, dict] = {}
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path, "r") as f:
+            for entry in _parse_lines(f.read()):
+                if entry.get("event") != "stats":
+                    continue
+                try:
+                    host = int(entry.get("host"))
+                except (TypeError, ValueError):
+                    continue
+                counters = entry.get("counters")
+                if isinstance(counters, dict):
+                    out[host] = counters
+        return out
+
     # ----------------------------------------------------- compaction
 
     def live_lines(self, text: str) -> List[str]:
         """The keep-set of a compaction pass over ``text``: the last
-        'done' line per archive path and the last 'req' line per request
-        id, in last-seen order.  For a request the kept line is
-        re-serialized from the MERGED lifecycle view, so the accepted
-        entry's description survives even though only its final state
-        line is kept."""
+        'done' line per archive path, the last 'req' line per request
+        id, every claim line of works still under a granted lease (the
+        lease fold needs the full history; released works drop all
+        their claim lines) and the last 'stats' line per host, in
+        last-seen order.  For a request the kept line is re-serialized
+        from the MERGED lifecycle view, so the accepted entry's
+        description survives even though only its final state line is
+        kept."""
         done: Dict[str, str] = {}
         reqs: Dict[str, dict] = {}
+        claims: Dict[str, List[str]] = {}
+        claim_entries: List[dict] = []
+        stats: Dict[str, str] = {}
         order: List[str] = []
 
         def touch(key: str) -> None:
@@ -206,13 +373,30 @@ class FleetJournal:
                 merged.update(entry)
                 reqs[rid] = merged
                 touch("req:" + rid)
+            elif entry.get("event") == "claim" and entry.get("work"):
+                work = entry["work"]
+                claims.setdefault(work, []).append(
+                    json.dumps(entry, sort_keys=True))
+                claim_entries.append(entry)
+                touch("claim:" + work)
+            elif entry.get("event") == "stats" \
+                    and entry.get("host") is not None:
+                hid = str(entry["host"])
+                stats[hid] = json.dumps(entry, sort_keys=True)
+                touch("stats:" + hid)
+        owned = self._fold_claims(claim_entries)
         lines = []
         for key in order:
             kind, _, ident = key.partition(":")
             if kind == "done":
                 lines.append(done[ident])
-            else:
+            elif kind == "req":
                 lines.append(json.dumps(reqs[ident], sort_keys=True))
+            elif kind == "claim":
+                if ident in owned:      # released works drop entirely
+                    lines.extend(claims[ident])
+            else:
+                lines.append(stats[ident])
         return lines
 
     def compact(self) -> bool:

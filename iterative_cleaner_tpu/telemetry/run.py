@@ -23,10 +23,18 @@ from iterative_cleaner_tpu.telemetry.registry import MetricsRegistry
 # (missing keys count 0) keeps the allgather shape identical on every
 # process even when their archive slices diverge (e.g. failures on one
 # host only) — the collective-discipline requirement of
-# ``aggregate_metrics_across_processes``.
+# ``aggregate_metrics_across_processes``.  The fleet_* keys make a
+# multi-host ``--fleet`` run's /metrics show whole-slice totals; they sit
+# in the same fixed tuple so a host that served nothing still
+# participates with zeros.  (This reduction runs only on the shared
+# session-exit path where every process is alive; the kill-a-host
+# scenarios aggregate through the journal's 'stats' snapshots instead —
+# see parallel/fleet._publish_host_stats.)
 _AGGREGATED_COUNTERS = ("archives_cleaned", "archives_converged",
                         "archives_failed", "cells_total", "cells_zapped",
-                        "iterations_total")
+                        "iterations_total", "fleet_cleaned",
+                        "fleet_failures", "fleet_resumed_skips",
+                        "fleet_stolen", "fleet_buckets_owned")
 
 
 class RunTelemetry:

@@ -35,7 +35,11 @@ FORMAT_VERSION = 1
 # change whether a faulted run survives, never what a surviving archive's
 # mask is — a resume under a different --retries must still match).
 _IDENTITY_EXCLUDE = {"unload_res", "record_history",
-                     "fleet_retries", "stage_timeout_s"}
+                     "fleet_retries", "stage_timeout_s",
+                     # host placement/lease knobs: which process serves a
+                     # bucket never changes its mask — stolen work must
+                     # satisfy the original host's journal entries
+                     "fleet_hosts", "fleet_host_id", "fleet_claim_ttl_s"}
 
 
 def config_identity(config: CleanConfig) -> str:

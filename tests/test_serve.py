@@ -284,6 +284,120 @@ def test_journal_compact_while_appending_loses_nothing(tmp_path):
     assert len(paths) == N_THREADS * N_EACH
 
 
+# ------------------------------------------------- journal claim leases
+
+def test_journal_claim_grammar(tmp_path):
+    """The multi-host lease fold: claim wins on unowned work, a live
+    lease blocks a steal, an expired lease allows it, heartbeats extend
+    only the owner, release frees the work.  Explicit ``now`` values
+    keep every transition deterministic."""
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    assert j.try_claim("w", host=0, nonce="a", ttl_s=10.0, now=100.0)
+    # re-claim by the owner: allowed (lease refresh)
+    assert j.try_claim("w", host=0, nonce="a", ttl_s=10.0, now=105.0)
+    # live lease blocks another nonce
+    assert not j.try_claim("w", host=1, nonce="b", ttl_s=10.0, now=109.0)
+    own = j.claim_table(now=109.0)["w"]
+    assert (own["host"], own["nonce"], own["live"]) == (0, "a", True)
+    # expired lease is stealable
+    assert not j.claim_table(now=120.0)["w"]["live"]
+    assert j.try_claim("w", host=1, nonce="b", ttl_s=10.0, now=120.0)
+    assert j.claim_table(now=121.0)["w"]["host"] == 1
+    # release frees the work for anyone
+    j.release("w", host=1, nonce="b", now=122.0)
+    assert "w" not in j.claim_table(now=122.0)
+    assert j.try_claim("w", host=0, nonce="c", ttl_s=10.0, now=123.0)
+    with pytest.raises(ValueError):
+        j.record_claim("w", host=0, nonce="c", ttl_s=1.0, state="bogus")
+
+
+def test_journal_claim_heartbeat_extends_but_never_steals(tmp_path):
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    assert j.try_claim("w", host=0, nonce="a", ttl_s=10.0, now=100.0)
+    j.heartbeat("w", host=0, nonce="a", ttl_s=10.0, now=108.0)
+    assert j.claim_table(now=115.0)["w"]["live"]  # extended past 110
+    # a loser's heartbeat is a fold no-op, not a takeover
+    j.heartbeat("w", host=1, nonce="b", ttl_s=100.0, now=116.0)
+    own = j.claim_table(now=117.0)["w"]
+    assert (own["host"], own["nonce"]) == (0, "a")
+    # an out-of-order claim (timestamp before the owner expired) loses
+    assert not j.try_claim("w", host=1, nonce="b", ttl_s=10.0, now=112.0)
+
+
+def test_journal_claim_torn_tail_tolerated_and_healed(tmp_path):
+    """A crash mid-append leaves a torn last line: readers must skip it
+    and the next append must heal it (prepend the missing newline) so
+    the glued bytes never corrupt a good entry."""
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    assert j.try_claim("w1", host=0, nonce="a", ttl_s=10.0, now=100.0)
+    with open(j.path, "a") as f:
+        f.write('{"schema": "icln-fleet-journal/1", "event": "cl')
+    assert j.claim_table(now=101.0)["w1"]["nonce"] == "a"  # torn: skipped
+    assert j.try_claim("w2", host=1, nonce="b", ttl_s=10.0, now=101.0)
+    table = j.claim_table(now=102.0)
+    assert table["w1"]["nonce"] == "a" and table["w2"]["nonce"] == "b"
+    # exactly one unparseable relic (the torn line); everything else is
+    # whole json — the heal prepended a newline instead of gluing on
+    def parses(ln):
+        try:
+            json.loads(ln)
+            return True
+        except ValueError:
+            return False
+
+    lines = [ln for ln in open(j.path).read().splitlines() if ln]
+    assert sum(1 for ln in lines if not parses(ln)) == 1
+
+
+def test_journal_compaction_keeps_live_claims_and_stats(tmp_path):
+    """Compaction must preserve granted leases and each host's last
+    stats snapshot, and drop released works' lines entirely."""
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    assert j.try_claim("held", host=0, nonce="a", ttl_s=1e6, now=100.0)
+    j.heartbeat("held", host=0, nonce="a", ttl_s=1e6, now=101.0)
+    assert j.try_claim("freed", host=1, nonce="b", ttl_s=1e6, now=100.0)
+    j.release("freed", host=1, nonce="b", now=102.0)
+    j.record_host_stats(0, {"fleet_cleaned": 1.0})
+    j.record_host_stats(0, {"fleet_cleaned": 4.0})  # supersedes
+    j.record_host_stats(1, {"fleet_stolen": 2.0})
+    assert j.compact()
+    table = j.claim_table(now=103.0)
+    assert table["held"]["nonce"] == "a" and table["held"]["live"]
+    assert "freed" not in table
+    assert "freed" not in open(j.path).read()
+    stats = j.host_stats()
+    assert stats[0] == {"fleet_cleaned": 4.0}
+    assert stats[1] == {"fleet_stolen": 2.0}
+
+
+def test_journal_claim_two_process_flock_race(tmp_path):
+    """Two fresh processes race try_claim on the same work with distinct
+    nonces: the flock'd append serializes them, so exactly one must win
+    — and the journal must stay fully parseable afterwards."""
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    worker = (
+        "import sys\n"
+        "from iterative_cleaner_tpu.resilience import FleetJournal\n"
+        "j = FleetJournal(sys.argv[1])\n"
+        "won = j.try_claim('w', host=int(sys.argv[2]),\n"
+        "                  nonce=sys.argv[2], ttl_s=60.0)\n"
+        "print('WON' if won else 'LOST')\n")
+    from tests.conftest import repo_subprocess_env
+
+    env = repo_subprocess_env()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", worker, j.path, str(i)],
+        env=env, stdout=subprocess.PIPE, text=True) for i in (0, 1)]
+    outs = [p.communicate(timeout=60)[0].strip() for p in procs]
+    assert all(p.returncode == 0 for p in procs)
+    assert sorted(outs) == ["LOST", "WON"], outs
+    # the fold agrees with the winner's own read-back
+    winner = outs.index("WON")
+    assert j.claim_table(now=0.0)["w"]["nonce"] == str(winner)
+    for ln in open(j.path).read().splitlines():
+        assert json.loads(ln)["event"] == "claim"
+
+
 def test_compact_under_lock_missing_file(tmp_path):
     assert not compact_under_lock(str(tmp_path / "absent"), lambda t: t)
 
