@@ -705,12 +705,13 @@ def bench_serve(n_requests, geometries, max_iter=3, io_workers=2,
     (``serve_submit_to_done_ms``) — the number a pipeline scheduling
     against the daemon actually budgets.
 
-    Phase B (saturation): ``burst`` submissions fired back-to-back with a
-    per-tenant cap of ``max_inflight``, while a plug request on a fresh
-    geometry pins the worker in its compile; the daemon must answer the
-    overflow with 429s (``serve_burst_rejected`` >= 1 — backpressure is
-    explicit, never an unbounded queue) while every ACCEPTED request
-    still completes.
+    Phase B (saturation): ``max_inflight`` plug requests on fresh
+    geometries pin the tenant at its admission cap for their whole
+    seconds-long compiles, then ``burst`` submissions fire back-to-back;
+    the daemon must answer the overflow with 429s
+    (``serve_burst_rejected`` >= 1 — backpressure is explicit, never an
+    unbounded queue) while every ACCEPTED request still completes, and a
+    bounced id resubmitted after the plugs drain must be admitted.
 
     Masks must stay bit-equal to an in-process `clean_archive` over the
     same inputs (the rows' shared parity-is-fatal contract), and SIGTERM
@@ -804,8 +805,29 @@ def bench_serve(n_requests, geometries, max_iter=3, io_workers=2,
                 time.sleep(0.01)
             raise RuntimeError(f"request {rid} never finished")
 
+        def span_breakdown(rid):
+            """Pull the request's finished spans from the daemon's
+            in-memory store (GET /trace/<id> needs no --trace-out) and
+            split its wall-clock into the queue wait, the fleet execute
+            time, and the rest of the bucket-group work (pad + compile
+            stall + bookkeeping) — the trace-derived stage attribution
+            of ``serve_submit_to_done_ms``."""
+            with urllib.request.urlopen(url + "/trace/" + rid,
+                                        timeout=10) as r:
+                spans = json.loads(r.read()).get("spans", [])
+
+            def total(pred):
+                return sum((s["end_ts"] - s["start_ts"]) * 1e3
+                           for s in spans if pred(s) and s.get("end_ts"))
+
+            queue = total(lambda s: s["name"] == "queue")
+            execute = total(lambda s: s["name"] == "execute"
+                            and s.get("subsystem") == "fleet")
+            groups = total(lambda s: s["name"] == "group")
+            return queue, execute, max(groups - execute, 0.0)
+
         # phase A: sequential submit->done latency, cold then warm
-        lat_ms = []
+        lat_ms, span_rows = [], []
         for i, p in enumerate(paths):
             rid = "lat%03d" % i
             t0 = time.perf_counter()
@@ -813,32 +835,51 @@ def bench_serve(n_requests, geometries, max_iter=3, io_workers=2,
             assert status == 200, f"submit {rid} answered {status}"
             assert wait_done(rid) == "done", f"request {rid} failed"
             lat_ms.append((time.perf_counter() - t0) * 1e3)
+            span_rows.append(span_breakdown(rid))
         cold_ms = lat_ms[0]
         warm = sorted(lat_ms[1:]) or [cold_ms]
         warm_ms = warm[len(warm) // 2]
-        _log(f"serve stage: {n_requests} sequential requests, "
-             f"cold {cold_ms:.0f}ms -> warm median {warm_ms:.0f}ms")
+        assert all(any(v > 0 for v in row) for row in span_rows), \
+            "a served request produced no spans; /trace/<id> is broken"
 
-        # phase B: saturation burst against the per-tenant cap.  A warm
-        # worker can outrun back-to-back submits, so the burst fires
-        # while a "plug" request on a FRESH geometry holds the worker in
-        # its compile — the cap is then genuinely contended.
-        plug_ar, _ = make_synthetic_archive(
-            nsub=32, nchan=48, nbin=48, **bench_rfi_density(32, 48),
-            seed=999, dtype=np.float32)
-        plug_p = os.path.join(tmp, "serve_plug.npz")
-        save_archive(plug_ar, plug_p)
-        want_masks[plug_p] = clean_archive(plug_ar, cfg).final_weights == 0
-        paths.append(plug_p)
-        assert post({"paths": [plug_p], "id": "plug"}) == 200
-        end = time.time() + 60
-        while time.time() < end:
-            with urllib.request.urlopen(url + "/requests/plug",
-                                        timeout=10) as r:
-                if json.loads(r.read()).get("state") == "running":
-                    break
-            time.sleep(0.005)
-        accepted, rejected = [], 0
+        def med(vals):
+            vals = sorted(vals)
+            return vals[len(vals) // 2]
+
+        warm_rows = span_rows[1:] or span_rows
+        span_queue_ms = med([r[0] for r in warm_rows])
+        span_execute_ms = med([r[1] for r in warm_rows])
+        # compile/stall overhead is a COLD phenomenon (the warm daemon's
+        # whole point is that it vanishes): report the first request's
+        span_compile_ms = span_rows[0][2]
+        _log(f"serve stage: {n_requests} sequential requests, "
+             f"cold {cold_ms:.0f}ms -> warm median {warm_ms:.0f}ms "
+             f"(spans: queue {span_queue_ms:.1f}ms, execute "
+             f"{span_execute_ms:.1f}ms, cold compile+pad "
+             f"{span_compile_ms:.1f}ms)")
+
+        # phase B: saturation burst against the per-tenant cap.  The cap
+        # is an ADMISSION-time budget (inflight counts from accept to
+        # done), so ``max_inflight`` "plug" requests on FRESH geometries
+        # pin the tenant at its cap for the full seconds-long compile —
+        # the millisecond burst that follows then draws 429s
+        # deterministically, with no race against warm completions.
+        plug_ids = []
+        for j in range(max_inflight):
+            plug_ar, _ = make_synthetic_archive(
+                nsub=32 + 8 * j, nchan=48, nbin=48,
+                **bench_rfi_density(32 + 8 * j, 48),
+                seed=999 - j, dtype=np.float32)
+            plug_p = os.path.join(tmp, "serve_plug_%d.npz" % j)
+            save_archive(plug_ar, plug_p)
+            want_masks[plug_p] = \
+                clean_archive(plug_ar, cfg).final_weights == 0
+            paths.append(plug_p)
+            pid = "plug%d" % j
+            assert post({"paths": [plug_p], "id": pid}) == 200, \
+                f"plug {pid} was not admitted"
+            plug_ids.append(pid)
+        accepted, bounced = [], []
         for i in range(burst):
             rid = "burst%03d" % i
             status = post({"paths": [paths[i % len(paths)]], "id": rid})
@@ -846,13 +887,21 @@ def bench_serve(n_requests, geometries, max_iter=3, io_workers=2,
                 accepted.append(rid)
             else:
                 assert status == 429, f"burst overflow answered {status}"
-                rejected += 1
-        assert wait_done("plug") == "done", "plug request failed"
+                bounced.append(rid)
+        for pid in plug_ids:
+            assert wait_done(pid) == "done", f"plug {pid} failed"
         for rid in accepted:
             assert wait_done(rid) == "done", f"burst {rid} failed"
+        rejected = len(bounced)
         assert rejected >= 1, \
             f"burst of {burst} at cap {max_inflight} drew no 429s; " \
             "backpressure is not engaging"
+        # a 429 is backpressure, not a ban: the same id resubmitted
+        # once the plugs drain must be admitted and complete
+        assert post({"paths": [paths[0]], "id": bounced[0]}) == 200, \
+            "rejected id was not admitted after the burst drained"
+        assert wait_done(bounced[0]) == "done", \
+            f"resubmitted {bounced[0]} failed"
         _log(f"serve stage: burst {burst} -> {len(accepted)} accepted, "
              f"{rejected} rejected (cap {max_inflight})")
 
@@ -880,6 +929,9 @@ def bench_serve(n_requests, geometries, max_iter=3, io_workers=2,
             "serve_burst": burst,
             "serve_burst_rejected": rejected,
             "serve_drain_s": round(drain_s, 2),
+            "serve_span_queue_ms": round(span_queue_ms, 2),
+            "serve_span_execute_ms": round(span_execute_ms, 2),
+            "serve_span_compile_ms": round(span_compile_ms, 2),
         }
     finally:
         if proc is not None and proc.poll() is None:
@@ -956,11 +1008,20 @@ def bench_multihost(n_archives, geometries, max_iter=2, claim_ttl=5.0):
                    + os.environ.get("PYTHONPATH", "").split(os.pathsep)
                ).rstrip(os.pathsep)}
 
+        # BENCH_TRACE_OUT=PATH also exports the multi-host Perfetto trace:
+        # every host process spools spans to PATH.spans.jsonl and the last
+        # finisher renders PATH with one lane group per host, including
+        # the scenario-B steal stitched under the dead host's trace
+        trace_out = os.environ.get("BENCH_TRACE_OUT", "")
+
         def fleet_cmd(tag, extra):
             metrics = os.path.join(tmp, f"metrics_{tag}.json")
+            traced = (["--trace-out", trace_out] if trace_out
+                      and tag != "single" else [])
             return metrics, [sys.executable, "-m", "iterative_cleaner_tpu",
                              "-q", "--fleet", "--max_iter", str(max_iter),
-                             "--metrics-json", metrics] + extra + paths
+                             "--metrics-json", metrics] + traced \
+                + extra + paths
 
         def read_metrics(path):
             with open(path) as fh:
@@ -1050,6 +1111,18 @@ def bench_multihost(n_archives, geometries, max_iter=2, claim_ttl=5.0):
         assert_done_once(j_steal)
         _log(f"multihost stage: survivor stole {stolen} bucket(s) from "
              "the dead host, masks bit-equal, zero duplicate cleans")
+
+        if trace_out:
+            with open(trace_out) as fh:
+                tdoc = json.load(fh)
+            tev = tdoc["traceEvents"]
+            hosts_seen = {e["pid"] for e in tev if e.get("ph") == "X"}
+            assert len(hosts_seen) >= 2, \
+                f"trace file covers {len(hosts_seen)} host lane(s); " \
+                "expected spans from both fleet processes"
+            _log(f"multihost stage: {trace_out} holds "
+                 f"{sum(1 for e in tev if e.get('ph') == 'X')} spans "
+                 f"across {len(hosts_seen)} host lanes")
 
         return {
             "fleet_hosts": 2,

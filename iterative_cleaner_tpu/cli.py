@@ -361,6 +361,28 @@ def build_parser() -> argparse.ArgumentParser:
                              "mirrors ICLEAN_MAX_INFLIGHT; the global "
                              "queue bound is ICLEAN_SERVE_QUEUE, default "
                              "64).")
+    parser.add_argument("--trace-out", "--trace_out", type=str, default="",
+                        dest="trace_out", metavar="PATH",
+                        help="Export a Chrome/Perfetto trace_events JSON "
+                             "of the run's distributed spans (request -> "
+                             "queue -> fleet -> bucket -> load/execute/"
+                             "write) to PATH; lanes are hosts/buckets. "
+                             "Each host spools spans to PATH.spans.jsonl "
+                             "and re-renders the full trace at exit, so "
+                             "one file covers a multi-host run. Works "
+                             "with --fleet and --serve. Mirrors "
+                             "ICLEAN_TRACE_OUT.")
+    parser.add_argument("--flight-recorder", "--flight_recorder", type=str,
+                        default=None, dest="flight_recorder",
+                        metavar="PATH",
+                        help="Crash flight recorder: keep a bounded "
+                             "in-memory ring of recent spans/events per "
+                             "subsystem and dump it (with every thread's "
+                             "stack) to PATH on watchdog trips, unhandled "
+                             "daemon exceptions, SIGQUIT and second-signal "
+                             "force-exit. --serve defaults to "
+                             "serve.flight.json; pass '' to disable. "
+                             "Mirrors ICLEAN_FLIGHT_RECORDER.")
     parser.add_argument("--stream", type=int, default=0, metavar="CHUNK",
                         help="Clean each archive in CHUNK-subint streaming "
                              "tiles (parallel/streaming.py) instead of one "
@@ -860,6 +882,33 @@ def _run_fleet(args, telemetry=None) -> list:
     def default_out_path(p):
         return p + "_cleaned" + (os.path.splitext(p)[1] or ".npz")
 
+    # opt-in distributed tracing + flight recorder for the batch fleet
+    # path (the serve daemon builds its own from ServeConfig)
+    trace_out = args.trace_out or os.environ.get("ICLEAN_TRACE_OUT", "")
+    flight = (args.flight_recorder if args.flight_recorder is not None
+              else os.environ.get("ICLEAN_FLIGHT_RECORDER", ""))
+    recorder = None
+    if flight:
+        from iterative_cleaner_tpu.telemetry.recorder import (
+            FlightRecorder,
+            set_active,
+        )
+
+        recorder = FlightRecorder(path=flight)
+        set_active(recorder)
+    tracer = None
+    if trace_out:
+        from iterative_cleaner_tpu.telemetry.tracing import (
+            Tracer,
+            spool_path_for,
+        )
+
+        tracer = Tracer(
+            host="h%d" % topo.host_id,
+            spool_path=spool_path_for(trace_out),
+            events=(telemetry.events if telemetry is not None else None),
+            recorder=recorder)
+
     report = clean_fleet(
         list(args.archive), cfg, mesh=mesh,
         registry=(telemetry.registry if telemetry is not None else None),
@@ -870,7 +919,13 @@ def _run_fleet(args, telemetry=None) -> list:
         # can re-verify it; only the default naming rule is a pure
         # function of the input path (--output std needs the archive)
         out_path_fn=default_out_path if args.output == "" else None,
-        hosts=topo)
+        hosts=topo, tracer=tracer)
+    if tracer is not None:
+        try:
+            tracer.flush_perfetto(trace_out)
+        except OSError as exc:
+            print("WARNING: could not write trace file %s: %s"
+                  % (trace_out, exc), file=sys.stderr)
     if report.skipped and not args.quiet:
         print("resumed: %d archive%s already complete in %s"
               % (len(report.skipped),
@@ -900,6 +955,9 @@ def _run_serve(args, telemetry=None) -> int:
             http_port=args.http_port,
             max_inflight=args.max_inflight,
             journal_path=args.journal or None,
+            trace_out=args.trace_out or None,
+            # None = not passed (env/default applies); '' disables
+            flight_recorder=args.flight_recorder,
         )
     except ValueError as exc:
         build_parser().error(f"--serve: {exc}")
@@ -908,7 +966,8 @@ def _run_serve(args, telemetry=None) -> int:
     return run_serve(
         serve_cfg, cfg,
         registry=(telemetry.registry if telemetry is not None else None),
-        faults=faults, io_workers=args.io_workers, quiet=args.quiet)
+        faults=faults, io_workers=args.io_workers, quiet=args.quiet,
+        events=(telemetry.events if telemetry is not None else None))
 
 
 def _parse_geometry_spec(spec: str):
@@ -1126,6 +1185,17 @@ def main(argv=None) -> int:
         build_parser().error(
             "--coordinator bootstraps an explicit process grid; pass "
             "both --hosts and --host-id with it")
+    if args.trace_out and not (args.fleet or args.serve):
+        # spans are recorded by the fleet/serve pipelines; a sequential
+        # batch run would silently produce an empty trace file
+        build_parser().error(
+            "--trace-out records the --fleet/--serve pipeline spans; "
+            "pass --fleet or --serve")
+    if args.flight_recorder is not None \
+            and not (args.fleet or args.serve):
+        build_parser().error(
+            "--flight-recorder instruments the --fleet/--serve "
+            "pipelines; pass --fleet or --serve")
     if args.retries is not None and args.retries < 0:
         build_parser().error(f"--retries must be >= 0, got {args.retries}")
     if args.stage_timeout is not None and args.stage_timeout < 0:

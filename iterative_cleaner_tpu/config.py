@@ -267,6 +267,15 @@ class ServeConfig:
     # exceeds journal_max_mb, trim clean.log beyond log_max_mb
     journal_max_mb: float = 16.0
     log_max_mb: float = 16.0
+    # Perfetto/Chrome trace_events export path: every finished span also
+    # spools to `<trace_out>.spans.jsonl` and the daemon renders the full
+    # trace file at shutdown; None disables the export (spans still live
+    # in the bounded in-memory store behind GET /trace/<id>)
+    trace_out: Optional[str] = None
+    # crash flight-recorder dump path (written on watchdog trips,
+    # unhandled daemon exceptions, SIGQUIT and second-signal force-exit);
+    # ON by default for a long-lived daemon — "" disables
+    flight_recorder: str = "serve.flight.json"
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -280,7 +289,17 @@ class ServeConfig:
             "http_port": env("ICLEAN_HTTP_PORT", int, None),
             "max_inflight": env("ICLEAN_MAX_INFLIGHT", int, 8),
             "queue_limit": env("ICLEAN_SERVE_QUEUE", int, 64),
+            "trace_out": env("ICLEAN_TRACE_OUT", str, None),
         }
+        # "" is a meaningful override here (recorder OFF), so resolve it
+        # outside the none-filtered update below
+        fields["flight_recorder"] = os.environ.get(
+            "ICLEAN_FLIGHT_RECORDER", "serve.flight.json")
+        if "flight_recorder" in overrides \
+                and overrides["flight_recorder"] is not None:
+            fields["flight_recorder"] = overrides["flight_recorder"]
+        overrides = {k: v for k, v in overrides.items()
+                     if k != "flight_recorder"}
         fields.update({k: v for k, v in overrides.items() if v is not None})
         return cls(**fields)
 

@@ -322,9 +322,12 @@ def precompile_batched_executable(config: CleanConfig, nsub: int, nchan: int,
     t0 = time.perf_counter()
     compiled = fn.lower(*avals).compile()
     if registry is not None:
+        from iterative_cleaner_tpu.telemetry.registry import SECONDS
+
         registry.counter_inc("batch_compiles")
         registry.histogram_observe("batch_precompile_s",
-                                   time.perf_counter() - t0)
+                                   time.perf_counter() - t0,
+                                   buckets=SECONDS)
         try:
             ma = compiled.memory_analysis()
             alias = int(ma.alias_size_in_bytes)

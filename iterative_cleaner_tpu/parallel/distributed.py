@@ -184,7 +184,8 @@ def host_fetch(outputs):
         outputs)
 
 
-def aggregate_metrics_across_processes(counters: dict) -> dict:
+def aggregate_metrics_across_processes(counters: dict, registry=None,
+                                       events=None) -> dict:
     """Sum a ``{name: value}`` counter dict across every process of a
     distributed run (each process cleans its own archive slice, so run
     totals need one cross-host reduction before the coordinator exports
@@ -199,9 +200,14 @@ def aggregate_metrics_across_processes(counters: dict) -> dict:
     when the backend cannot run the allgather (CPU multi-process JAX
     rejects ``process_allgather`` even though sharded-jit collectives
     work — tests/test_multiprocess.py), this degrades to the LOCAL
-    counters with a stderr note instead of raising.  Multi-host fleet
-    runs still export whole-slice totals either way, through the
-    journal's stats fold (``<counter>_slice`` gauges — see
+    counters instead of raising.  The degrade itself is telemetry, not
+    noise: it counts ``telemetry_degraded`` on ``registry`` and emits a
+    ``telemetry_degraded`` event on ``events`` (a RunEventLog) when
+    those sinks are given, falling back to a stderr note only when
+    neither is — a dashboard can alert on partial totals instead of an
+    operator spotting a buried WARNING line.  Multi-host fleet runs
+    still export whole-slice totals either way, through the journal's
+    stats fold (``<counter>_slice`` gauges — see
     parallel/fleet._publish_host_stats), which needs no collective at
     all.
     """
@@ -218,9 +224,16 @@ def aggregate_metrics_across_processes(counters: dict) -> dict:
         summed = np.asarray(
             multihost_utils.process_allgather(stacked)).sum(axis=0)
     except Exception as exc:  # backend-dependent collective support
-        print("WARNING: cross-process metric reduction unavailable "
-              f"({type(exc).__name__}); exporting this process's local "
-              "counters", file=sys.stderr)
+        detail = "%s: %s" % (type(exc).__name__, str(exc)[:200])
+        if registry is not None:
+            registry.counter_inc("telemetry_degraded")
+        if events is not None:
+            events.emit("telemetry_degraded", stage="metric_reduction",
+                        error=detail, scope="local_counters_only")
+        if registry is None and events is None:
+            print("WARNING: cross-process metric reduction unavailable "
+                  f"({type(exc).__name__}); exporting this process's "
+                  "local counters", file=sys.stderr)
         return dict(counters)
     return {k: float(v) for k, v in zip(names, summed)}
 

@@ -427,7 +427,9 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
                 precompile: bool = True,
                 resilience=None,
                 out_path_fn: Optional[Callable[[str], str]] = None,
-                hosts=None
+                hosts=None,
+                tracer=None,
+                trace: Optional[dict] = None
                 ) -> FleetReport:
     """Serve an arbitrary archive-path list through the compiled batch path.
 
@@ -477,6 +479,16 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
     archives are skipped on a steal, so a dead host's work is re-served
     exactly once with bit-equal masks.  Multi-host serving therefore
     REQUIRES ``resilience.journal`` on storage every host shares.
+
+    ``tracer`` (a :class:`~iterative_cleaner_tpu.telemetry.tracing
+    .Tracer`, default None = tracing off, zero overhead) records one span
+    per fleet run, group, archive load/write and batched execute, with
+    retry/OOM-split/degrade/watchdog moments attached as span events.
+    ``trace`` (a ``{"trace_id", "span_id"}`` context dict, e.g. from the
+    serve daemon's execute span) parents the fleet's root span so a
+    request's trace is one stitched tree; it also rides the journal's
+    claim and done lines, which is how a host that steals a dead peer's
+    bucket recovers the originating trace and continues it.
     """
     import concurrent.futures as cf
 
@@ -524,6 +536,18 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
             "accounting); pass a ResiliencePlan with a journal on "
             "storage every host shares (--journal PATH)")
 
+    # Root span for this fleet run.  `trace` (a {"trace_id","span_id"}
+    # context dict, e.g. the serve daemon's execute span) parents it so a
+    # request's trace stitches straight through into the bucket stages;
+    # with no tracer every span site below is a `None` check — zero work.
+    fleet_span = None
+    if tracer is not None:
+        _ctx = trace or {}
+        fleet_span = tracer.start(
+            "fleet", trace_id=_ctx.get("trace_id"),
+            parent_id=_ctx.get("span_id"), subsystem="fleet", lane="fleet",
+            host_id=topo.host_id, n_paths=len(paths))
+
     report = FleetReport(results={}, failures=[],
                          host_id=topo.host_id, n_hosts=topo.n_hosts)
 
@@ -567,7 +591,7 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
             entries.append((p, run_with_retries(
                 lambda p=p: shape_fn(p), stage="peek", policy=res.retry,
                 registry=reg, faults=res.faults,
-                deadline_s=res.stage_timeout_s)))
+                deadline_s=res.stage_timeout_s, span=fleet_span)))
         except Exception as exc:
             fail(p, "peek", exc)
 
@@ -591,7 +615,12 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
         events.emit("fleet_plan", n_archives=len(entries),
                     n_buckets=len(plan.buckets), n_groups=len(groups),
                     bucket_pad=list(bucket_pad), group_size=group_size)
+    if fleet_span is not None:
+        fleet_span.set("n_buckets", len(plan.buckets))
+        fleet_span.set("n_groups", len(groups))
     if not groups and not topo.is_multi:
+        if fleet_span is not None:
+            fleet_span.end()
         return report
 
     serve_t0 = time.perf_counter()
@@ -602,7 +631,8 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
             _serve_multihost(plan, topo, config, mesh, reg, report, fail,
                              precompile, io_workers, load_fn, write_fn,
                              clean_archives_batched, cf, res, cfg_hash,
-                             out_path_fn, events)
+                             out_path_fn, events, tracer=tracer,
+                             parent_span=fleet_span)
     else:
         precompiler = (BucketPrecompiler(plan, config, mesh=mesh,
                                          registry=reg, faults=res.faults)
@@ -611,7 +641,9 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
             _serve_groups(groups, config, mesh, reg, report, fail,
                           precompiler, io_workers, load_fn, write_fn,
                           clean_archives_batched, cf, res, cfg_hash,
-                          out_path_fn)
+                          out_path_fn, tracer=tracer,
+                          trace=(fleet_span.context()
+                                 if fleet_span is not None else trace))
         finally:
             if precompiler is not None:
                 precompiler.shutdown()
@@ -641,6 +673,10 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
         _publish_host_stats(topo, reg, report, res.journal,
                             reg.counters_since(mark))
     record_builder_cache_stats(reg)
+    if fleet_span is not None:
+        fleet_span.set("n_cleaned", len(report.results))
+        fleet_span.set("n_failed", len(report.failures))
+        fleet_span.end("ok" if not report.failures else "partial")
     return report
 
 
@@ -711,7 +747,8 @@ class _ClaimHeartbeat:
 def _serve_multihost(plan, topo, config, mesh, reg, report, fail,
                      precompile, io_workers, load_fn, write_fn,
                      clean_archives_batched, cf, res, cfg_hash,
-                     out_path_fn, events) -> None:
+                     out_path_fn, events, tracer=None,
+                     parent_span=None) -> None:
     """:func:`clean_fleet`'s multi-host serve loop.
 
     Sweep the plan's buckets — own (hash-affine) buckets first, foreign
@@ -770,13 +807,48 @@ def _serve_multihost(plan, topo, config, mesh, reg, report, fail,
                 if (owner is not None and owner["live"]
                         and owner["nonce"] != nonce):
                     continue    # live lease elsewhere: leave it be
-                if not journal.try_claim(work, host=topo.host_id,
-                                         nonce=nonce, ttl_s=ttl):
-                    reg.counter_inc("fleet_claim_conflicts")
-                    continue    # lost the append race
                 stolen = bucket.key not in own_keys
+                # Trace stitching across the steal: the expired lease we
+                # are about to take over carries the victim's span context
+                # (recorded on its claim line); parent the stolen bucket's
+                # span THERE, so the originating request's trace tree
+                # shows the bucket migrating hosts instead of a second,
+                # orphaned trace appearing out of nowhere.
+                bspan = None
+                if tracer is not None:
+                    vtrace = (owner or {}).get("trace") \
+                        if owner is not None else None
+                    if (stolen and isinstance(vtrace, dict)
+                            and vtrace.get("trace_id")):
+                        b_tid = vtrace.get("trace_id")
+                        b_pid = vtrace.get("span_id")
+                    else:
+                        pctx = (parent_span.context()
+                                if parent_span is not None else {})
+                        b_tid = pctx.get("trace_id")
+                        b_pid = pctx.get("span_id")
+                    bspan = tracer.start(
+                        "serve_bucket", trace_id=b_tid, parent_id=b_pid,
+                        subsystem="fleet", lane=work,
+                        host_id=topo.host_id, stolen=stolen,
+                        n_items=len(remaining))
+                if not journal.try_claim(
+                        work, host=topo.host_id, nonce=nonce, ttl_s=ttl,
+                        trace=(bspan.context() if bspan is not None
+                               else None)):
+                    reg.counter_inc("fleet_claim_conflicts")
+                    if bspan is not None:
+                        bspan.end("claim_lost")
+                    continue    # lost the append race
                 if stolen:
                     reg.counter_inc("fleet_stolen")
+                    if bspan is not None:
+                        bspan.event(
+                            "stolen",
+                            from_host=int((owner or {}).get("host", -1)),
+                            recovered_trace=bool(
+                                isinstance((owner or {}).get("trace"),
+                                           dict)))
                 if events is not None:
                     events.emit("fleet_claim", work=work, stolen=stolen,
                                 n_items=len(remaining))
@@ -793,10 +865,14 @@ def _serve_multihost(plan, topo, config, mesh, reg, report, fail,
                                   fail, precompiler, io_workers, load_fn,
                                   write_fn, clean_archives_batched, cf,
                                   res, cfg_hash, out_path_fn,
-                                  journal_unwritten=True)
+                                  journal_unwritten=True, tracer=tracer,
+                                  trace=(bspan.context()
+                                         if bspan is not None else None))
                 finally:
                     hb.stop()
                 journal.release(work, host=topo.host_id, nonce=nonce)
+                if bspan is not None:
+                    bspan.end()
                 finished.add(bucket.key)
                 progressed = True
             if all(b.key in finished for b in plan.buckets):
@@ -811,7 +887,8 @@ def _serve_multihost(plan, topo, config, mesh, reg, report, fail,
 def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                   io_workers, load_fn, write_fn, clean_archives_batched,
                   cf, res, cfg_hash, out_path_fn,
-                  journal_unwritten: bool = False) -> None:
+                  journal_unwritten: bool = False, tracer=None,
+                  trace=None) -> None:
     """:func:`clean_fleet`'s pipeline body: load lookahead -> rendezvous
     with the precompiler -> batched clean (through the OOM/retry recovery
     ladder) -> async journaled write-back.
@@ -831,24 +908,38 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
         run_with_retries,
     )
     from iterative_cleaner_tpu.resilience import classify_error as classify
+    from iterative_cleaner_tpu.telemetry.registry import SECONDS
+    from iterative_cleaner_tpu.telemetry.tracing import maybe_span
+
+    _ctx = trace or {}
+    t_tid, t_pid = _ctx.get("trace_id"), _ctx.get("span_id")
+    done_trace = dict(trace) if trace else None
 
     def load_task(path: str) -> Archive:
-        return run_with_retries(
-            lambda: load_fn(path), stage="load", policy=res.retry,
-            registry=reg, faults=res.faults, deadline_s=res.stage_timeout_s)
+        with maybe_span(tracer, "load", trace_id=t_tid, parent_id=t_pid,
+                        subsystem="fleet", lane="io",
+                        path=os.path.basename(path)) as s:
+            return run_with_retries(
+                lambda: load_fn(path), stage="load", policy=res.retry,
+                registry=reg, faults=res.faults,
+                deadline_s=res.stage_timeout_s, span=s)
 
     def write_task(path: str, ar: Archive, result: CleanResult) -> None:
-        run_with_retries(
-            lambda: write_fn(path, ar, result), stage="write",
-            policy=res.retry, registry=reg, faults=res.faults,
-            deadline_s=res.stage_timeout_s)
+        with maybe_span(tracer, "write", trace_id=t_tid, parent_id=t_pid,
+                        subsystem="fleet", lane="io",
+                        path=os.path.basename(path)) as s:
+            run_with_retries(
+                lambda: write_fn(path, ar, result), stage="write",
+                policy=res.retry, registry=reg, faults=res.faults,
+                deadline_s=res.stage_timeout_s, span=s)
         if res.journal is not None:
             # journal strictly after the (atomic) output write succeeded:
             # a crash between the two re-cleans the archive on resume —
             # never the reverse (a journaled path with no output)
             res.journal.record_done(
                 path, config_hash=cfg_hash,
-                out_path=out_path_fn(path) if out_path_fn else None)
+                out_path=out_path_fn(path) if out_path_fn else None,
+                trace=done_trace)
 
     with cf.ThreadPoolExecutor(max_workers=io_workers) as load_pool, \
             cf.ThreadPoolExecutor(max_workers=io_workers) as write_pool:
@@ -864,6 +955,14 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
         for gi, (bucket, chunk) in enumerate(groups):
             # next group's host IO overlaps this group's device compute
             submit_loads(gi + 1)
+            # one span per group, lane = the bucket's work key; ended
+            # explicitly at every `continue` (Span.end is idempotent)
+            gspan = None
+            if tracer is not None:
+                gspan = tracer.start(
+                    "group", trace_id=t_tid, parent_id=t_pid,
+                    subsystem="fleet", lane=bucket_work_key(bucket.key),
+                    group=gi, n_items=len(chunk))
             loaded = []
             t0 = time.perf_counter()
             for it, fut in pending.pop(gi):
@@ -873,9 +972,14 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                     fail(it.path, "load", exc)
                     continue
                 loaded.append((it, ar))
-            reg.histogram_observe("fleet_load_stall_s",
-                                  time.perf_counter() - t0)
+            load_stall = time.perf_counter() - t0
+            reg.histogram_observe("fleet_load_stall_s", load_stall,
+                                  buckets=SECONDS)
+            if gspan is not None:
+                gspan.set("load_stall_s", round(load_stall, 6))
             if not loaded:
+                if gspan is not None:
+                    gspan.end("empty")
                 continue
             padded, raw_shapes, pad_cells = [], [], 0
             try:
@@ -891,6 +995,8 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                 # rewritten file): the whole group is suspect
                 for it, _ar in loaded:
                     fail(it.path, "load", exc)
+                if gspan is not None:
+                    gspan.end("load_error")
                 continue
             if pad_cells:
                 reg.counter_inc("fleet_pad_cells", pad_cells)
@@ -899,7 +1005,11 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                 executable, ready, stall_s = precompiler.obtain(bucket)
                 reg.counter_inc("fleet_precompile_hits" if ready
                                 else "fleet_precompile_misses")
-                reg.histogram_observe("fleet_compile_stall_s", stall_s)
+                reg.histogram_observe("fleet_compile_stall_s", stall_s,
+                                      buckets=SECONDS)
+                if gspan is not None:
+                    gspan.set("precompiled", bool(ready))
+                    gspan.set("compile_stall_s", round(stall_s, 6))
 
             group_stats = {"compiles": 0}
             results: List[Optional[CleanResult]] = [None] * len(loaded)
@@ -926,7 +1036,8 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
 
                 try:
                     return call_with_deadline(run, res.stage_timeout_s,
-                                              "execute", registry=reg)
+                                              "execute", registry=reg,
+                                              span=espan)
                 finally:
                     group_stats["compiles"] += int(
                         stats.get("compiles", 0) or 0)
@@ -944,8 +1055,12 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                     lambda: backends.clean_archive(
                         raw_ar, dataclasses.replace(config,
                                                     backend="numpy")),
-                    res.stage_timeout_s, "execute", registry=reg)
+                    res.stage_timeout_s, "execute", registry=reg,
+                    span=espan)
                 reg.counter_inc("fleet_degraded")
+                if espan is not None:
+                    espan.event("degrade",
+                                path=os.path.basename(_it.path))
                 return out
 
             def serve(idx, exe, pad_to, attempt=0):
@@ -969,6 +1084,9 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                         # the inline jit path, uncharged.  OOM skips this
                         # rung — replaying the identical program inline
                         # would exhaust the same memory again
+                        if espan is not None:
+                            espan.event("exe_reject",
+                                        error=type(exc).__name__)
                         serve(idx, None, pad_to, attempt)
                         return
                     if kind == OOM and len(idx) > 1:
@@ -976,6 +1094,8 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                         # so every archive's mask stays bit-equal — only
                         # the vmap lane count shrinks
                         reg.counter_inc("fleet_oom_splits")
+                        if espan is not None:
+                            espan.event("oom_split", n=len(idx))
                         mid = len(idx) // 2
                         serve(idx[:mid], None, None)
                         serve(idx[mid:], None, None)
@@ -988,6 +1108,12 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                         return
                     if kind == TRANSIENT and attempt < res.retry.max_retries:
                         reg.counter_inc("fleet_retries")
+                        if espan is not None:
+                            espan.event("retry", stage="execute",
+                                        attempt=attempt,
+                                        error="%s: %s"
+                                        % (type(exc).__name__,
+                                           str(exc)[:120]))
                         time.sleep(res.retry.backoff(attempt))
                         serve(idx, None, pad_to, attempt + 1)
                         return
@@ -997,6 +1123,12 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                 for i, r in zip(idx, out):
                     results[i] = r
 
+            espan = None
+            if gspan is not None:
+                espan = tracer.start(
+                    "execute", trace_id=t_tid, parent_id=gspan.span_id,
+                    subsystem="fleet", lane=bucket_work_key(bucket.key),
+                    n_items=len(loaded))
             t0 = time.perf_counter()
             serve(list(range(len(loaded))), executable, bucket.batch_dim)
             dt = time.perf_counter() - t0
@@ -1010,10 +1142,14 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
             if inline_compiles or stall_s:
                 reg.counter_inc("fleet_compile_misses")
                 reg.histogram_observe("fleet_group_compile_s",
-                                      dt + stall_s)
+                                      dt + stall_s, buckets=SECONDS)
             else:
                 reg.counter_inc("fleet_compile_hits")
-                reg.histogram_observe("fleet_group_execute_s", dt)
+                reg.histogram_observe("fleet_group_execute_s", dt,
+                                      buckets=SECONDS)
+            if espan is not None:
+                espan.set("compiles", inline_compiles)
+                espan.end()
             for i, (it, ar) in enumerate(loaded):
                 r = results[i]
                 if r is None:
@@ -1026,7 +1162,9 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                     res.journal.record_done(
                         it.path, config_hash=cfg_hash,
                         out_path=out_path_fn(it.path) if out_path_fn
-                        else None)
+                        else None, trace=done_trace)
+            if gspan is not None:
+                gspan.end()
         for it, fut in write_futs:
             try:
                 fut.result()

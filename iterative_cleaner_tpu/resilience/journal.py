@@ -42,7 +42,14 @@ the file too::
 
     {"schema": "icln-fleet-journal/1", "event": "claim",
      "work": "<bucket key>", "host": 0, "nonce": "<unique claimant id>",
-     "state": "claim" | "hb" | "release", "t": <epoch s>, "ttl": <s>}
+     "state": "claim" | "hb" | "release", "t": <epoch s>, "ttl": <s>,
+     "trace": {"trace_id": "...", "span_id": "..."}}   # optional
+
+``trace`` is the claimant's distributed-tracing span context; the fold
+keeps it on the lease, so a host stealing an expired claim recovers the
+originating request's trace context from the journal alone and its
+bucket span stitches under that request's tree (ARCHITECTURE.md
+"Observability" — journal trace-context grammar).
 
 Claims are leases, not locks: a 'claim' grants ``work`` to ``nonce``
 when the work is unowned, already owned by the same nonce, or the
@@ -154,9 +161,12 @@ class FleetJournal:
         locked_append(self.path, text)
 
     def record_done(self, in_path: str, *, config_hash: str,
-                    out_path: Optional[str] = None) -> None:
+                    out_path: Optional[str] = None,
+                    trace: Optional[dict] = None) -> None:
         """Append one completion entry; signatures are taken now, i.e.
-        after the (atomic) output write landed."""
+        after the (atomic) output write landed.  ``trace`` (a span's
+        ``{"trace_id", "span_id"}`` context) records which request tree
+        this archive finished under — post-mortem trace stitching."""
         from iterative_cleaner_tpu.utils.checkpoint import file_signature
 
         entry = {
@@ -169,6 +179,8 @@ class FleetJournal:
         if out_path:
             entry["out"] = os.path.abspath(out_path)
             entry["out_sig"] = file_signature(out_path)
+        if trace:
+            entry["trace"] = dict(trace)
         self._append(entry)
 
     def completed(self, config_hash: str) -> Dict[str, dict]:
@@ -224,20 +236,31 @@ class FleetJournal:
 
     def record_claim(self, work: str, *, host: int, nonce: str,
                      ttl_s: float, state: str = "claim",
-                     now: Optional[float] = None) -> None:
+                     now: Optional[float] = None,
+                     trace: Optional[dict] = None) -> None:
         """Append one claim-lease line.  ``work`` is an opaque work-item
         key (the fleet uses the bucket geometry), ``nonce`` uniquely
         identifies the claimant attempt (host id + pid + random tag — a
         restarted host must not inherit its dead predecessor's lease),
-        ``ttl_s`` the lease duration from ``now``."""
+        ``ttl_s`` the lease duration from ``now``.
+
+        ``trace`` (``{"trace_id", "span_id"}``) is the claimant's span
+        context.  It rides the lease through the fold, which is how a
+        stolen bucket's spans stitch under the ORIGINATING request: the
+        stealer never saw the request, but it reads the dead owner's
+        trace context off the expired lease and parents its own bucket
+        span there."""
         if state not in CLAIM_STATES:
             raise ValueError(f"unknown claim state {state!r}")
-        self._append({
+        entry = {
             "schema": SCHEMA, "event": "claim", "work": str(work),
             "host": int(host), "nonce": str(nonce), "state": state,
             "t": float(time.time() if now is None else now),
             "ttl": float(ttl_s),
-        })
+        }
+        if trace:
+            entry["trace"] = dict(trace)
+        self._append(entry)
 
     @staticmethod
     def _fold_claims(entries) -> Dict[str, dict]:
@@ -258,9 +281,15 @@ class FleetJournal:
             if state == "claim":
                 if (cur is None or cur["nonce"] == entry.get("nonce")
                         or cur["expires"] <= t):
-                    owners[work] = {"host": int(entry.get("host", -1)),
-                                    "nonce": str(entry.get("nonce", "")),
-                                    "expires": t + ttl}
+                    own = {"host": int(entry.get("host", -1)),
+                           "nonce": str(entry.get("nonce", "")),
+                           "expires": t + ttl}
+                    # trace context survives the fold so a stealer can
+                    # stitch its span under the dead owner's request
+                    trace = entry.get("trace")
+                    if isinstance(trace, dict):
+                        own["trace"] = trace
+                    owners[work] = own
             elif state == "hb":
                 if cur is not None and cur["nonce"] == entry.get("nonce"):
                     cur["expires"] = t + ttl
@@ -285,13 +314,14 @@ class FleetJournal:
         return owners
 
     def try_claim(self, work: str, *, host: int, nonce: str,
-                  ttl_s: float, now: Optional[float] = None) -> bool:
+                  ttl_s: float, now: Optional[float] = None,
+                  trace: Optional[dict] = None) -> bool:
         """Atomically try to take (or steal) ``work``: append a claim
         line, then read the fold back — True iff this ``nonce`` is the
         owner.  Losing a race costs one dead line; the flock'd append
         order guarantees exactly one winner, on every host's reading."""
         self.record_claim(work, host=host, nonce=nonce, ttl_s=ttl_s,
-                          now=now)
+                          now=now, trace=trace)
         own = self.claim_table(now=now).get(str(work))
         return own is not None and own["nonce"] == str(nonce)
 

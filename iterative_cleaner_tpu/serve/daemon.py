@@ -53,7 +53,7 @@ FORCE_EXIT_CODE = 70  # second signal mid-drain: EX_SOFTWARE-ish, non-zero
 # journal/request fields safe to echo back over GET /requests/<id>
 _STATUS_FIELDS = ("state", "tenant", "priority", "deadline_ts",
                   "submitted_ts", "paths", "error", "n_cleaned",
-                  "n_skipped", "n_failed", "duration_s")
+                  "n_skipped", "n_failed", "duration_s", "trace_id")
 
 
 def default_out_path(p: str) -> str:
@@ -72,7 +72,7 @@ class ServeDaemon:
                  *, registry=None, faults=None, retry=None,
                  stage_timeout_s: Optional[float] = None,
                  io_workers: Optional[int] = None,
-                 quiet: bool = False) -> None:
+                 quiet: bool = False, events=None) -> None:
         from iterative_cleaner_tpu.resilience import (
             FleetJournal,
             RetryPolicy,
@@ -80,6 +80,14 @@ class ServeDaemon:
             resolve_stage_timeout,
         )
         from iterative_cleaner_tpu.telemetry import MetricsRegistry
+        from iterative_cleaner_tpu.telemetry.recorder import (
+            FlightRecorder,
+            set_active,
+        )
+        from iterative_cleaner_tpu.telemetry.tracing import (
+            Tracer,
+            spool_path_for,
+        )
 
         self.serve_config = serve_config
         self.base_config = base_config
@@ -95,11 +103,29 @@ class ServeDaemon:
             else getattr(base_config, "stage_timeout_s", None))
         self.io_workers = io_workers
         self.quiet = quiet
+        self.events = events
         self.journal = FleetJournal(serve_config.journal_path)
+        # the black box: always armed in a daemon (a crash with no dump
+        # is the failure mode this PR exists to kill); path "" disables.
+        self.recorder = (FlightRecorder(path=serve_config.flight_recorder)
+                         if serve_config.flight_recorder else None)
+        set_active(self.recorder)
+        # spans are always recorded in-memory (bounded; they feed
+        # GET /trace/<id> and the flight recorder); the spool/Perfetto
+        # export only exists under --trace-out, the event-log export
+        # only under an events sink.
+        self.trace_out = serve_config.trace_out or None
+        self.tracer = Tracer(
+            host="serve",
+            spool_path=(spool_path_for(self.trace_out)
+                        if self.trace_out else None),
+            events=events, recorder=self.recorder)
+        self._root_spans: Dict[str, object] = {}
         self.scheduler = ServeScheduler(
             queue_limit=serve_config.queue_limit,
             max_inflight=serve_config.max_inflight,
-            registry=self.registry, faults=self.faults)
+            registry=self.registry, faults=self.faults,
+            tracer=self.tracer)
         self.spool = (SpoolWatcher(
             serve_config.spool_dir,
             on_request=lambda req, _path: self.admit(req, source="spool"),
@@ -120,7 +146,12 @@ class ServeDaemon:
         request its submitter never saw acknowledged (the HTTP 200 /
         spool ``.accepted`` rename both happen strictly after this
         returns) — so the submitter's retry is correct."""
-        self.scheduler.submit(req)
+        self._open_root_span(req, source=source)
+        try:
+            self.scheduler.submit(req)
+        except Rejection:
+            self._root_spans.pop(req.request_id, None)  # never admitted
+            raise
         self.journal.record_request(req.request_id, "accepted",
                                     source=source, **req.journal_fields())
         self._say("serve: accepted %s (%s, tenant=%s, %d path%s)"
@@ -138,10 +169,12 @@ class ServeDaemon:
                 continue
             try:
                 req = ServeRequest.from_journal_entry(rid, view)
+                self._open_root_span(req, source="recover")
                 self.scheduler.submit(req, already_journaled=True)
             except (RequestError, Rejection) as exc:
                 # un-replayable (compacted away, corrupt, or beyond the
                 # queue bound): fail it terminally rather than loop on it
+                self._root_spans.pop(rid, None)
                 self.journal.record_request(rid, "failed",
                                             error=f"unrecoverable: {exc}")
                 self.registry.counter_inc("serve_failed")
@@ -154,6 +187,22 @@ class ServeDaemon:
         return n
 
     # ------------------------------------------------------ observability
+    def _open_root_span(self, req: ServeRequest, *, source: str) -> None:
+        """The request's root span: intake → terminal state.  Everything
+        else (queue wait, execute, every fleet stage on every host)
+        parents under it via ``req.trace_id``/``req.root_span_id``."""
+        root = self.tracer.start(
+            "request", trace_id=req.trace_id, subsystem="serve",
+            lane="serve", request_id=req.request_id, tenant=req.tenant,
+            source=source, n_paths=len(req.paths))
+        req.root_span_id = root.span_id
+        self._root_spans[req.request_id] = root
+
+    def _close_root_span(self, req: ServeRequest, status: str) -> None:
+        root = self._root_spans.pop(req.request_id, None)
+        if root is not None:
+            root.end(status=status)
+
     def health(self) -> dict:
         snap = self.registry.snapshot()
         counters = snap.get("counters", {})
@@ -182,6 +231,34 @@ class ServeDaemon:
         doc["id"] = request_id
         return doc
 
+    def trace_view(self, trace_or_request_id: str) -> Optional[dict]:
+        """GET /trace/<id>: the finished spans of one trace, accepting
+        either the trace id itself or a request id (resolved through the
+        journal, so it works after the in-memory request map moved on)."""
+        spans = self.tracer.spans_for(trace_or_request_id)
+        trace_id = trace_or_request_id
+        if not spans:
+            view = self.journal.request_states().get(trace_or_request_id)
+            if view is None or not view.get("trace_id"):
+                return None
+            trace_id = str(view["trace_id"])
+            spans = self.tracer.spans_for(trace_id)
+        return {"trace_id": trace_id, "n_spans": len(spans), "spans": spans}
+
+    def debug_vars(self) -> dict:
+        """GET /debug/vars: one scrape with everything a live debugging
+        session starts from — health, config, counters, recent spans."""
+        snap = self.registry.snapshot()
+        return {
+            "health": self.health(),
+            "serve_config": dataclasses.asdict(self.serve_config),
+            "counters": snap.get("counters", {}),
+            "gauges": snap.get("gauges", {}),
+            "recent_spans": self.tracer.recent(50),
+            "flight_recorder": getattr(self.recorder, "path", None),
+            "trace_out": self.trace_out,
+        }
+
     def _say(self, msg: str) -> None:
         if not self.quiet:
             print(msg, flush=True)
@@ -200,6 +277,10 @@ class ServeDaemon:
         self.journal.record_request(req.request_id, "running")
         mark = self.registry.counters_mark()
         t0 = time.perf_counter()
+        span = self.tracer.start(
+            "execute", trace_id=req.trace_id,
+            parent_id=req.root_span_id, subsystem="serve", lane="serve",
+            request_id=req.request_id, tenant=req.tenant)
         try:
             cfg = req.effective_config(self.base_config)
             plan = ResiliencePlan(
@@ -210,15 +291,20 @@ class ServeDaemon:
                 req.paths, cfg, registry=self.registry,
                 io_workers=self.io_workers,
                 write_fn=self._write_one, resilience=plan,
-                out_path_fn=default_out_path)
+                out_path_fn=default_out_path,
+                tracer=self.tracer, trace=span.context())
         except Exception as exc:  # setup/override errors, not per-archive
             dt = time.perf_counter() - t0
+            span.event("error", type=type(exc).__name__,
+                       message=str(exc)[:200])
+            span.end(status="error")
             self.journal.record_request(
                 req.request_id, "failed",
                 error=f"{type(exc).__name__}: {exc}",
                 duration_s=round(dt, 6))
             self.registry.counter_inc("serve_failed")
-            self.registry.histogram_observe("serve_request_s", dt)
+            self._observe_latency(req, dt)
+            self._close_root_span(req, "failed")
             self._say("serve: failed %s: %s" % (req.request_id, exc))
             return
         finally:
@@ -231,10 +317,14 @@ class ServeDaemon:
             "n_failed": len(report.failures),
             "duration_s": round(dt, 6),
         }
-        self.registry.histogram_observe("serve_request_s", dt)
+        span.set("n_cleaned", len(report.results))
+        span.set("n_failed", len(report.failures))
+        span.end(status="ok" if report.ok else "failed")
+        self._observe_latency(req, dt)
         if report.ok:
             self.journal.record_request(req.request_id, "done", **fields)
             self.registry.counter_inc("serve_completed")
+            self._close_root_span(req, "ok")
             self._say("serve: done %s (%d cleaned, %d resumed, %.2fs, "
                       "%d precompile hits)"
                       % (req.request_id, len(report.results),
@@ -248,9 +338,24 @@ class ServeDaemon:
                 error=f"{len(report.failures)} archive(s) failed: {stages}",
                 **fields)
             self.registry.counter_inc("serve_failed")
+            self._close_root_span(req, "failed")
             self._say("serve: failed %s (%d of %d archives)"
                       % (req.request_id, len(report.failures),
                          len(req.paths)))
+
+    def _observe_latency(self, req: ServeRequest, run_s: float) -> None:
+        """The SLO signals: run duration, plus end-to-end (submit →
+        terminal, queue wait included) both global and per-tenant via the
+        label-suffix convention — ``serve_e2e_s{tenant=...}`` renders as
+        a real Prometheus label on /metrics."""
+        from iterative_cleaner_tpu.telemetry.registry import SECONDS, labeled
+
+        e2e = max(time.time() - req.submitted_ts, 0.0)
+        self.registry.histogram_observe("serve_request_s", run_s,
+                                        buckets=SECONDS)
+        self.registry.histogram_observe("serve_e2e_s", e2e, buckets=SECONDS)
+        self.registry.histogram_observe(
+            labeled("serve_e2e_s", tenant=req.tenant), e2e, buckets=SECONDS)
 
     def _write_one(self, path, ar, result) -> None:
         from iterative_cleaner_tpu import io as ar_io
@@ -265,15 +370,17 @@ class ServeDaemon:
                 req.request_id, "failed",
                 error="deadline expired before scheduling")
             self.registry.counter_inc("serve_failed")
+            self._close_root_span(req, "expired")
             self.scheduler.mark_done(req)
             self._say("serve: deadline expired for %s" % req.request_id)
 
     # -------------------------------------------------------- maintenance
     def _maintain(self) -> None:
-        """Idle-time growth bounds: compact the journal and trim clean.log
-        once they cross their configured sizes.  Both operations hold the
-        appenders' flock, so maintenance is safe under live traffic."""
-        from iterative_cleaner_tpu.utils.logging import trim_log
+        """Idle-time growth bounds: compact the journal, trim clean.log
+        and rotate the event log once they cross their configured sizes.
+        All three hold the appenders' flock, so maintenance is safe under
+        live traffic."""
+        from iterative_cleaner_tpu.utils.logging import rotate_log, trim_log
 
         cfg = self.serve_config
         try:
@@ -287,12 +394,24 @@ class ServeDaemon:
                           % (jsz, os.path.getsize(self.journal.path)))
         if trim_log("clean.log", int(cfg.log_max_mb * 1e6)):
             self.registry.counter_inc("serve_log_trims")
+        # the event log is append-only spans/events: unlike clean.log its
+        # old lines matter (they are the trace export), so rotation keeps
+        # one full previous generation (.1) instead of trimming in place
+        ev_path = getattr(self.events, "path", None)
+        if ev_path and rotate_log(ev_path, int(cfg.log_max_mb * 1e6)):
+            self.registry.counter_inc("serve_eventlog_rotations")
+            self._say("serve: rotated event log %s -> %s.1"
+                      % (ev_path, ev_path))
 
     # ------------------------------------------------------------ signals
     def _on_signal(self, signum, _frame) -> None:
         self._signals += 1
         if self._signals >= 2:
-            # a stuck drain must still be killable without SIGKILL
+            # a stuck drain must still be killable without SIGKILL; this
+            # is the one exit where atexit never runs, so the black box
+            # dumps here or not at all
+            if self.recorder is not None:
+                self.recorder.dump("force-exit")
             print("serve: second signal, forcing exit", flush=True)
             os._exit(FORCE_EXIT_CODE)
         print("serve: %s received, draining (queued requests stay "
@@ -306,11 +425,14 @@ class ServeDaemon:
         clean drain)."""
         import threading
 
+        from iterative_cleaner_tpu.telemetry.recorder import install_sigquit
+
         if threading.current_thread() is threading.main_thread():
             # in-process tests drive run() from a worker thread and
             # deliver "signals" by calling _on_signal directly
             signal.signal(signal.SIGTERM, self._on_signal)
             signal.signal(signal.SIGINT, self._on_signal)
+            install_sigquit()  # kill -QUIT: live black-box snapshot
         self.recover()
         if self.serve_config.http_port is not None:
             from iterative_cleaner_tpu.serve.http import (
@@ -349,6 +471,12 @@ class ServeDaemon:
                     self._execute(req)
                 finally:
                     self.scheduler.mark_done(req)
+        except Exception:
+            # an exception escaping the serve loop is exactly what the
+            # flight recorder exists for: dump, then die loudly
+            if self.recorder is not None:
+                self.recorder.dump("daemon-exception")
+            raise
         finally:
             self._shutdown()
         return 0
@@ -359,6 +487,12 @@ class ServeDaemon:
             self._httpd.server_close()
         queued = self.scheduler.depth()
         self.journal.compact()
+        if self.trace_out:
+            try:
+                self.tracer.flush_perfetto(self.trace_out)
+                self._say("serve: wrote trace %s" % self.trace_out)
+            except OSError as exc:
+                print("serve: trace export failed: %s" % exc, flush=True)
         snap = self.registry.snapshot()
         print("serve: drained (%d request%s left journaled) %s"
               % (queued, "" if queued == 1 else "s",
@@ -371,8 +505,9 @@ class ServeDaemon:
 
 def run_serve(serve_config: ServeConfig, base_config: CleanConfig, *,
               registry=None, faults=None, io_workers=None,
-              quiet: bool = False) -> int:
+              quiet: bool = False, events=None) -> int:
     """CLI entry: build and run a daemon; returns its exit code."""
     daemon = ServeDaemon(serve_config, base_config, registry=registry,
-                         faults=faults, io_workers=io_workers, quiet=quiet)
+                         faults=faults, io_workers=io_workers, quiet=quiet,
+                         events=events)
     return daemon.run()

@@ -12,6 +12,12 @@ proxy owns TLS/authn, exactly like node_exporter's model).  Endpoints::
     GET  /metrics       Prometheus text exposition of the LIVE registry
                         (the PR 1 exporter, served instead of
                         textfile-only)
+    GET  /trace/<id>    200 {"trace_id", "spans": [...]} — the finished
+                        spans of one trace, by trace id OR request id
+                        (the daemon's bounded in-memory span store; no
+                        --trace-out required)
+    GET  /debug/vars    200 one-scrape debugging state: health, config,
+                        counters, the most recent spans
 
 The server runs on daemon threads (`ThreadingHTTPServer`): submissions
 land in the scheduler under its own lock, so the single worker loop never
@@ -88,6 +94,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(404, {"error": f"unknown request {rid!r}"})
             else:
                 self._send_json(200, state)
+        elif path.startswith("/trace/"):
+            tid = path[len("/trace/"):]
+            view = daemon.trace_view(tid)
+            if view is None:
+                self._send_json(404, {"error": f"unknown trace {tid!r}"})
+            else:
+                self._send_json(200, view)
+        elif path == "/debug/vars":
+            self._send_json(200, daemon.debug_vars())
         else:
             self._send_json(404, {"error": f"no route {path!r}"})
 

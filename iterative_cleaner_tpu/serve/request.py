@@ -9,7 +9,9 @@ HTTP POST body::
      "tenant": "survey-A",            # optional, default "default"
      "priority": 5,                   # optional, higher serves sooner
      "deadline_s": 120.0,             # optional, relative to acceptance
-     "overrides": {"max_iter": 3}}    # optional CleanConfig overrides
+     "overrides": {"max_iter": 3},    # optional CleanConfig overrides
+     "trace": "req-7f3a"}             # optional client trace id (minted
+                                      # at intake when absent)
 
 ``overrides`` may only name whitelisted :class:`CleanConfig` fields — the
 mask-relevant per-request knobs.  Output/IO/resilience knobs stay
@@ -28,6 +30,7 @@ import uuid
 from typing import Dict, List, Optional, Tuple
 
 from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.telemetry.tracing import new_trace_id, valid_trace_id
 
 # CleanConfig fields a request may override: the per-request cleaning
 # semantics, nothing that changes where outputs land or how the daemon
@@ -55,6 +58,14 @@ class ServeRequest:
     deadline_ts: Optional[float] = None
     overrides: Dict[str, object] = dataclasses.field(default_factory=dict)
     submitted_ts: float = dataclasses.field(default_factory=time.time)
+    # distributed-tracing root for this request: minted at intake unless
+    # the client supplied one ('trace' wire field) — every span the
+    # request generates, on any host, carries this id.
+    trace_id: str = dataclasses.field(default_factory=new_trace_id)
+    # process-local: the daemon's root request span id, set at admission
+    # so child spans (queue wait, execute) parent under it.  Never
+    # journaled — a restarted daemon opens a fresh root span.
+    root_span_id: Optional[str] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline_ts is None:
@@ -82,6 +93,7 @@ class ServeRequest:
             "deadline_ts": self.deadline_ts,
             "overrides": dict(self.overrides),
             "submitted_ts": self.submitted_ts,
+            "trace_id": self.trace_id,
         }
 
     @classmethod
@@ -107,6 +119,10 @@ class ServeRequest:
                          if entry.get("deadline_ts") is not None else None),
             overrides=overrides,
             submitted_ts=float(entry.get("submitted_ts") or time.time()),
+            # a pre-tracing journal has no trace_id: mint one so the
+            # recovered re-run still traces end to end
+            trace_id=(str(entry["trace_id"]) if entry.get("trace_id")
+                      else new_trace_id()),
         )
 
 
@@ -184,14 +200,21 @@ def parse_request(payload, *, request_id: Optional[str] = None,
 
     overrides = _check_overrides(payload.get("overrides") or {})
 
-    known = {"paths", "id", "priority", "tenant", "deadline_s", "overrides"}
+    trace_id = payload.get("trace")
+    if trace_id is not None and not valid_trace_id(trace_id):
+        raise RequestError("'trace' must be a short alphanumeric trace id")
+
+    known = {"paths", "id", "priority", "tenant", "deadline_s", "overrides",
+             "trace"}
     unknown = sorted(set(payload) - known)
     if unknown:
         raise RequestError(f"unknown request fields: {', '.join(unknown)}")
 
     req = ServeRequest(request_id=rid, paths=list(paths), tenant=tenant,
                        priority=priority, deadline_ts=deadline_ts,
-                       overrides=overrides)
+                       overrides=overrides,
+                       trace_id=(str(trace_id) if trace_id
+                                 else new_trace_id()))
     if base_config is not None:
         req.effective_config(base_config)  # validate now, reject at intake
     return req

@@ -47,11 +47,12 @@ class ServeScheduler:
     ``serve_*`` counters and queue-depth gauges."""
 
     def __init__(self, *, queue_limit: int, max_inflight: int,
-                 registry=None, faults=None) -> None:
+                 registry=None, faults=None, tracer=None) -> None:
         self.queue_limit = int(queue_limit)
         self.max_inflight = int(max_inflight)
         self.registry = registry
         self.faults = faults
+        self.tracer = tracer
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._heap: List[Tuple[Tuple, ServeRequest]] = []
@@ -60,6 +61,8 @@ class ServeScheduler:
         self._inflight: Dict[str, int] = {}
         self._known_ids: set = set()
         self._draining = False
+        # request_id -> open queue-wait span (submit opens, pop closes)
+        self._queue_spans: Dict[str, object] = {}
 
     # ------------------------------------------------------------ helpers
     def _count(self, name: str, n: float = 1.0) -> None:
@@ -72,6 +75,24 @@ class ServeScheduler:
             self.registry.gauge_set(
                 "serve_requests_inflight",
                 float(sum(self._inflight.values())))
+
+    def _open_queue_span(self, req: ServeRequest) -> None:
+        """Start the queue-wait span at admission: its duration IS the
+        request's scheduling delay, stitched under the daemon's root
+        request span (``req.root_span_id``, set by the daemon's admit)."""
+        if self.tracer is None:
+            return
+        self._queue_spans[req.request_id] = self.tracer.start(
+            "queue", trace_id=req.trace_id,
+            parent_id=getattr(req, "root_span_id", None),
+            subsystem="sched", lane="sched",
+            request_id=req.request_id, tenant=req.tenant,
+            priority=req.priority)
+
+    def _close_queue_span(self, req: ServeRequest, status: str) -> None:
+        span = self._queue_spans.pop(req.request_id, None)
+        if span is not None:
+            span.end(status=status)
 
     @property
     def draining(self) -> bool:
@@ -126,6 +147,7 @@ class ServeScheduler:
             self._known_ids.add(req.request_id)
             self._inflight[req.tenant] = inflight + 1
             self._count("serve_accepted")
+            self._open_queue_span(req)
             self._gauges()
             self._not_empty.notify()
 
@@ -159,11 +181,13 @@ class ServeScheduler:
                     if req.expired(now):
                         heapq.heappop(self._heap)
                         self._count("serve_deadline_expired")
+                        self._close_queue_span(req, "expired")
                         expired.append(req)
                         continue
                     break
                 if self._heap:
                     _key, req = heapq.heappop(self._heap)
+                    self._close_queue_span(req, "ok")
                     self._gauges()
                     return req, expired
                 if self._draining:

@@ -21,6 +21,13 @@ boundary:
   JSON-lines run-event log (CLI ``--log-format json``), one event per
   archive / iteration / phase, alongside the reference-parity
   ``clean.log``.
+- :class:`~iterative_cleaner_tpu.telemetry.tracing.Tracer` /
+  :class:`~iterative_cleaner_tpu.telemetry.recorder.FlightRecorder` —
+  distributed request spans (serve → fleet → multi-host, stitched
+  across hosts through the journal) exported as JSON-lines and
+  Chrome/Perfetto ``trace_events`` (``--trace-out``), plus a bounded
+  in-memory black box dumped on watchdog trips, daemon crashes and
+  SIGQUIT.
 - ``jax.named_scope`` annotations on the engine's phases and
   ``jax.profiler.TraceAnnotation`` spans on the host phases, so
   ``--trace`` captures read as template/diagnostics/scalers/zap in
@@ -51,11 +58,19 @@ from iterative_cleaner_tpu.telemetry.exporters import (  # noqa: E402,F401
     write_metrics_json,
     write_prometheus_textfile,
 )
+from iterative_cleaner_tpu.telemetry.recorder import (  # noqa: E402,F401
+    FlightRecorder,
+)
 from iterative_cleaner_tpu.telemetry.registry import (  # noqa: E402,F401
     MetricsRegistry,
     PhaseTimer,
+    labeled,
 )
 from iterative_cleaner_tpu.telemetry.run import RunTelemetry  # noqa: E402,F401
+from iterative_cleaner_tpu.telemetry.tracing import (  # noqa: E402,F401
+    Tracer,
+    maybe_span,
+)
 
 
 def iter_metrics_dict(iter_metrics) -> dict:

@@ -25,6 +25,24 @@ def _prom_name(name: str, prefix: str) -> str:
     return f"{prefix}_{n}" if prefix else n
 
 
+def _prom_parts(name: str, prefix: str, suffix: str = ""):
+    """Split a label-suffixed registry key (``base{k=v}`` — see
+    :func:`iterative_cleaner_tpu.telemetry.registry.labeled`) into the
+    sanitised Prometheus metric name and a label-body string, so
+    ``serve_e2e_s{tenant=survey}`` renders as a real label set instead
+    of being mangled into the metric name."""
+    from iterative_cleaner_tpu.telemetry.registry import split_labels
+
+    base, labels = split_labels(name)
+    m = _prom_name(base, prefix)
+    if suffix and not m.endswith(suffix):
+        m += suffix
+    body = ",".join('%s="%s"' % (_NAME_RE.sub("_", k),
+                                 str(v).replace('"', "'"))
+                    for k, v in sorted(labels.items()))
+    return m, body
+
+
 def _prom_num(v: float) -> str:
     if math.isinf(v):
         return "+Inf" if v > 0 else "-Inf"
@@ -64,18 +82,24 @@ def metrics_to_prometheus(snapshot: dict, prefix: str = "icln") -> str:
     ``le`` buckets.
     """
     lines = []
+    typed = set()
+
+    def _type_line(m: str, kind: str) -> None:
+        if m not in typed:  # one TYPE row per family, even with labels
+            typed.add(m)
+            lines.append(f"# TYPE {m} {kind}")
 
     for name in sorted(snapshot.get("counters", {})):
-        m = _prom_name(name, prefix)
-        if not m.endswith("_total"):
-            m += "_total"
-        lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {_prom_num(snapshot['counters'][name])}")
+        m, body = _prom_parts(name, prefix, "_total")
+        _type_line(m, "counter")
+        sel = ("%s{%s}" % (m, body)) if body else m
+        lines.append(f"{sel} {_prom_num(snapshot['counters'][name])}")
 
     for name in sorted(snapshot.get("gauges", {})):
-        m = _prom_name(name, prefix)
-        lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {_prom_num(snapshot['gauges'][name])}")
+        m, body = _prom_parts(name, prefix)
+        _type_line(m, "gauge")
+        sel = ("%s{%s}" % (m, body)) if body else m
+        lines.append(f"{sel} {_prom_num(snapshot['gauges'][name])}")
 
     phases = snapshot.get("phases_s", {})
     if phases:
@@ -87,14 +111,16 @@ def metrics_to_prometheus(snapshot: dict, prefix: str = "icln") -> str:
 
     for name in sorted(snapshot.get("histograms", {})):
         h = snapshot["histograms"][name]
-        m = _prom_name(name, prefix)
-        lines.append(f"# TYPE {m} histogram")
+        m, body = _prom_parts(name, prefix)
+        _type_line(m, "histogram")
+        pre = body + "," if body else ""
         bounds = list(h["buckets"]) + [float("inf")]
         for le, c in zip(bounds, h["cumulative_counts"]):
-            lines.append('%s_bucket{le="%s"} %d'
-                         % (m, _prom_num(le), c))
-        lines.append(f"{m}_sum {_prom_num(h['sum'])}")
-        lines.append(f"{m}_count {h['count']}")
+            lines.append('%s_bucket{%sle="%s"} %d'
+                         % (m, pre, _prom_num(le), c))
+        suffix = ("{%s}" % body) if body else ""
+        lines.append(f"{m}_sum{suffix} {_prom_num(h['sum'])}")
+        lines.append(f"{m}_count{suffix} {h['count']}")
 
     return "\n".join(lines) + ("\n" if lines else "")
 

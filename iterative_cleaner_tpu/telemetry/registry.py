@@ -24,9 +24,43 @@ import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
-# Default histogram bucket upper bounds (seconds / loops / generic small
-# counts); callers can pass their own per-histogram.
+# Default histogram bucket upper bounds (generic small counts); callers
+# can pass their own per-histogram.  Prefer the named presets below —
+# one vector cannot fit seconds, loop counts and byte sizes at once.
 DEFAULT_BUCKETS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0)
+
+# Named presets: pass as ``buckets=`` so a latency histogram resolves
+# sub-second work and a size histogram spans KiB→GiB, instead of both
+# collapsing into one ill-fitting vector.
+SECONDS = (0.005, 0.025, 0.1, 0.5, 1.0, 2.5, 10.0, 30.0, 120.0)
+COUNTS = DEFAULT_BUCKETS
+BYTES = (1024.0, 16384.0, 262144.0, 1048576.0, 16777216.0,
+         268435456.0, 1073741824.0)
+
+
+def labeled(name: str, **labels) -> str:
+    """The label-suffix convention: a flat registry key that renders as a
+    real Prometheus label set — ``labeled("serve_e2e_s", tenant="a")`` →
+    ``'serve_e2e_s{tenant=a}'``.  The registry stays a plain dict of
+    floats; the exporters split the suffix back into labels.  Label keys
+    sort, so one (name, labels) pair always folds to one key."""
+    if not labels:
+        return name
+    body = ",".join("%s=%s" % (k, labels[k]) for k in sorted(labels))
+    return "%s{%s}" % (name, body)
+
+
+def split_labels(name: str):
+    """Inverse of :func:`labeled`: ``(base_name, {label: value})``."""
+    if "{" not in name or not name.endswith("}"):
+        return name, {}
+    base, _, body = name.partition("{")
+    out = {}
+    for part in body[:-1].split(","):
+        k, sep, v = part.partition("=")
+        if sep:
+            out[k.strip()] = v.strip()
+    return base, out
 
 
 @contextlib.contextmanager

@@ -98,7 +98,8 @@ class RunTelemetry:
         if result.converged:
             r.counter_inc("archives_converged")
         r.gauge_set("last_rfi_fraction", float(result.rfi_fraction))
-        r.histogram_observe("loops_per_archive", loops)
+        from iterative_cleaner_tpu.telemetry.registry import COUNTS
+        r.histogram_observe("loops_per_archive", loops, buckets=COUNTS)
 
         history = iter_metrics_dict(getattr(result, "iter_metrics", None))
         entry = {
@@ -150,7 +151,13 @@ class RunTelemetry:
                      for k in _AGGREGATED_COUNTERS}
             doc["counters"].update(
                 {k: v for k, v in
-                 aggregate_metrics_across_processes(local).items() if v})
+                 aggregate_metrics_across_processes(
+                     local, registry=self.registry,
+                     events=self.events).items() if v})
+            # a degrade recorded just now must be visible in THIS export
+            doc["counters"].update({
+                k: v for k, v in self.registry.snapshot()["counters"]
+                .items() if k == "telemetry_degraded"})
         doc["schema"] = METRICS_SCHEMA
         doc["archives"] = list(self.archives)
         return doc

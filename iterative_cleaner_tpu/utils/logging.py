@@ -115,6 +115,30 @@ def trim_log(path: str, max_bytes: int, keep_lines: int = 10000) -> bool:
     return compact_under_lock(path, rewrite)
 
 
+def rotate_log(path: str, max_bytes: int) -> bool:
+    """Size-capped keep-one rotation for logs whose OLD lines still
+    matter (the JSON-lines event log is the span/trace export — trimming
+    it in place would silently delete trace history): when ``path``
+    exceeds ``max_bytes`` its full content moves to ``path.1`` (replacing
+    the previous generation) and the live file restarts empty.  Uses
+    :func:`compact_under_lock`, so concurrent appenders lose nothing; the
+    daemon's disk footprint is bounded at ~2x the cap."""
+    try:
+        if os.path.getsize(path) <= max_bytes:
+            return False
+    except OSError:
+        return False
+    from iterative_cleaner_tpu.io.atomic import atomic_output
+
+    def rewrite(text: str) -> str:
+        with atomic_output(path + ".1") as tmp:
+            with open(tmp, "w") as f:
+                f.write(text)
+        return ""
+
+    return compact_under_lock(path, rewrite)
+
+
 def append_clean_log(ar_name: str, args_namespace, loops: int,
                      log_path: str = "clean.log", timestamp=None) -> None:
     """One line per cleaned archive: timestamp, archive name, the full

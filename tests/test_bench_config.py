@@ -56,9 +56,12 @@ def test_bench_small_end_to_end_json_schema():
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, proc.stdout
     out = json.loads(lines[0])
+    # a stage subprocess that dies non-fatally only logs to stderr and
+    # drops its row; carry the log tail into the missing-key message
+    err = proc.stderr[-3000:]
     for key in ("metric", "value", "unit", "vs_baseline", "platform",
                 "quality", "ms_per_iter", "loops"):
-        assert key in out, key
+        assert key in out, (key, err)
     assert out["unit"] == "cell-iters/s"
     assert out["value"] > 0 and out["vs_baseline"] > 0
     assert out["quality"]["precision"] is not None
@@ -66,7 +69,7 @@ def test_bench_small_end_to_end_json_schema():
     for key in ("streaming_geometry", "streaming_platform",
                 "streaming_tile_passes_per_s", "streaming_eff_gbps",
                 "streaming_h2d_bytes", "streaming_vs_whole"):
-        assert key in out, key
+        assert key in out, (key, err)
     # the interim modeled-throughput companion key is retired: every
     # shipped figure is measured
     assert not any(k.startswith("modeled_") for k in out), sorted(out)
@@ -76,7 +79,7 @@ def test_bench_small_end_to_end_json_schema():
     for key in ("batch_n", "batch_geometry", "batch_platform",
                 "batch_cell_iters_per_s", "batch_vs_sequential",
                 "batch_per_archive_ms", "batch_h2d_bytes"):
-        assert key in out, key
+        assert key in out, (key, err)
     assert out["batch_n"] >= 8
     assert out["batch_h2d_bytes"] > 0
     assert out["batch_cell_iters_per_s"] > 0
@@ -90,7 +93,7 @@ def test_bench_small_end_to_end_json_schema():
                 "fleet_precompile_hits", "fleet_precompile_misses",
                 "fleet_cold_vs_warm", "fleet_warm_compiles",
                 "fleet_retries", "fleet_oom_splits"):
-        assert key in out, key
+        assert key in out, (key, err)
     assert out["fleet_n"] >= 6
     assert out["fleet_buckets"] >= 2
     assert out["fleet_compiles"] == out["fleet_buckets"]
@@ -113,11 +116,19 @@ def test_bench_small_end_to_end_json_schema():
     # in-process reference is rc-7-fatal inside the stage)
     for key in ("serve_n", "serve_platform", "serve_cold_ms",
                 "serve_submit_to_done_ms", "serve_burst",
-                "serve_burst_rejected", "serve_drain_s"):
-        assert key in out, key
+                "serve_burst_rejected", "serve_drain_s",
+                "serve_span_queue_ms", "serve_span_execute_ms",
+                "serve_span_compile_ms"):
+        assert key in out, (key, err)
     assert out["serve_submit_to_done_ms"] > 0
     assert out["serve_burst_rejected"] >= 1
     assert out["serve_drain_s"] >= 0
+    # trace-derived stage attribution (scraped from GET /trace/<id>):
+    # the warm execute time is real work, and the stage split can never
+    # exceed the end-to-end latency it decomposes
+    assert out["serve_span_execute_ms"] > 0
+    assert out["serve_span_queue_ms"] >= 0
+    assert out["serve_span_compile_ms"] >= 0
 
 
 @pytest.mark.slow
@@ -136,10 +147,11 @@ def test_bench_multihost_row_keys():
              "max_iter": 2})),))
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
+    err = proc.stderr[-3000:]
     for key in ("fleet_hosts", "fleet_multihost_vs_single",
                 "fleet_multihost_serve_s", "fleet_singlehost_serve_s",
                 "fleet_multihost_cores", "fleet_stolen"):
-        assert key in out, key
+        assert key in out, (key, err)
     assert out["fleet_hosts"] == 2
     assert out["fleet_stolen"] >= 1
     assert out["fleet_multihost_vs_single"] > 0

@@ -1,0 +1,595 @@
+"""Distributed tracing + crash flight recorder (telemetry/tracing.py,
+telemetry/recorder.py, and their propagation through serve/ and
+parallel/fleet.py): span-tree units, Perfetto rendering, spool
+round-trips, the bounded in-memory stores, label-suffix metrics, bucket
+presets, keep-one log rotation, concurrent-registry safety, the
+in-process stitched serve trace (intake -> queue -> fleet -> bucket
+stages under one trace id), steal-time trace recovery from the journal,
+watchdog flight dumps, and the masks-unchanged-with-tracing-on parity
+contract."""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig, ServeConfig
+from iterative_cleaner_tpu.io import make_synthetic_archive, save_archive
+from iterative_cleaner_tpu.telemetry import MetricsRegistry
+from iterative_cleaner_tpu.telemetry.exporters import metrics_to_prometheus
+from iterative_cleaner_tpu.telemetry.recorder import (
+    FLIGHT_SCHEMA,
+    FlightRecorder,
+    dump_active,
+    set_active,
+)
+from iterative_cleaner_tpu.telemetry.registry import (
+    BYTES,
+    COUNTS,
+    SECONDS,
+    labeled,
+    split_labels,
+)
+from iterative_cleaner_tpu.telemetry.tracing import (
+    SPAN_SCHEMA,
+    Tracer,
+    maybe_span,
+    new_trace_id,
+    read_spans,
+    render_perfetto,
+    spool_path_for,
+    valid_trace_id,
+    write_perfetto,
+)
+from iterative_cleaner_tpu.utils.logging import locked_append, rotate_log
+
+NUMPY_BASE = CleanConfig(backend="numpy", max_iter=2)
+
+
+# ---------------------------------------------------------------- tracing
+
+def test_span_tree_ids_events_and_status():
+    tr = Tracer(host="h0")
+    with tr.span("request", subsystem="serve", lane="serve",
+                 request_id="r1") as root:
+        root.event("admitted", source="http")
+        with tr.span("queue", trace_id=root.trace_id,
+                     parent_id=root.span_id, subsystem="sched") as q:
+            q.set("depth", 3)
+    spans = tr.spans_for(root.trace_id)
+    assert [s["name"] for s in spans] == ["queue", "request"]  # end order
+    q_d, root_d = spans
+    assert q_d["trace_id"] == root_d["trace_id"] == root.trace_id
+    assert q_d["parent_id"] == root_d["span_id"]
+    assert root_d["parent_id"] is None
+    assert root_d["schema"] == SPAN_SCHEMA
+    assert root_d["attrs"]["request_id"] == "r1"
+    assert root_d["events"][0]["name"] == "admitted"
+    assert q_d["attrs"]["depth"] == 3
+    assert all(s["end_ts"] >= s["start_ts"] for s in spans)
+
+
+def test_span_records_error_status_on_exception():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("work") as s:
+            raise ValueError("boom")
+    d = tr.spans_for(s.trace_id)[0]
+    assert d["status"] == "error"
+    assert "boom" in json.dumps(d["events"])
+
+
+def test_trace_id_validation_and_minting():
+    assert valid_trace_id("req-7f3a") and valid_trace_id("a" * 64)
+    assert not valid_trace_id("") and not valid_trace_id("a" * 65)
+    assert not valid_trace_id("bad id") and not valid_trace_id("x/y")
+    minted = {new_trace_id() for _ in range(32)}
+    assert len(minted) == 32 and all(valid_trace_id(t) for t in minted)
+
+
+def test_maybe_span_without_tracer_is_inert():
+    with maybe_span(None, "anything", foo=1) as s:
+        assert s is None
+
+
+def test_tracer_store_is_bounded():
+    tr = Tracer()
+    ids = []
+    for i in range(Tracer.MAX_TRACES + 10):
+        with tr.span("t%d" % i) as s:
+            ids.append(s.trace_id)
+    assert len(tr._traces) == Tracer.MAX_TRACES
+    assert tr.spans_for(ids[0]) == []          # oldest evicted
+    assert tr.spans_for(ids[-1])               # newest retained
+    assert len(tr.recent(10)) == 10
+
+
+def test_spool_round_trip_tolerates_torn_tail(tmp_path):
+    spool = str(tmp_path / "t.spans.jsonl")
+    tr = Tracer(host="h3", spool_path=spool)
+    with tr.span("a"):
+        pass
+    with tr.span("b"):
+        pass
+    with open(spool, "a") as f:
+        f.write('{"schema": "icln-span/1", "torn')  # crash mid-append
+    spans = read_spans(spool)
+    assert sorted(s["name"] for s in spans) == ["a", "b"]
+    assert all(s["host"] == "h3" for s in spans)
+
+
+def test_perfetto_rendering_lanes_and_file(tmp_path):
+    tr0, tr1 = Tracer(host="h0"), Tracer(host="h1")
+    tid = new_trace_id()
+    spans = []
+    for tr, lane in ((tr0, "16x32x32xF"), (tr1, "12x32x32xF")):
+        s = tr.start("serve_bucket", trace_id=tid, subsystem="fleet",
+                     lane=lane)
+        s.event("stolen", from_host=1)
+        s.end()
+        spans.extend(tr.spans_for(tid))
+    events = render_perfetto(spans)["traceEvents"]
+    complete = [e for e in events if e["ph"] == "X"]
+    meta = [e for e in events if e["ph"] == "M"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(complete) == 2 and len(instants) == 2
+    assert len({e["pid"] for e in complete}) == 2       # one lane per host
+    names = {m["args"]["name"] for m in meta
+             if m["name"] == "process_name"}
+    assert names == {"host h0", "host h1"}
+    assert all(e["dur"] >= 1 for e in complete)          # min 1us, visible
+    out = str(tmp_path / "trace.json")
+    write_perfetto(out, spans)
+    doc = json.load(open(out))
+    assert doc["traceEvents"] and doc["displayTimeUnit"]
+
+
+def test_tracer_flush_perfetto_folds_multi_host_spool(tmp_path):
+    # two "hosts" share one spool (the multi-process export contract);
+    # the last finisher's flush renders everyone's spans
+    out = str(tmp_path / "trace.json")
+    spool = spool_path_for(out)
+    tr0 = Tracer(host="h0", spool_path=spool)
+    tr1 = Tracer(host="h1", spool_path=spool)
+    with tr0.span("fleet"):
+        pass
+    with tr1.span("fleet"):
+        pass
+    tr1.flush_perfetto(out)
+    doc = json.load(open(out))
+    pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert len(pids) == 2
+
+
+# --------------------------------------------------------- flight recorder
+
+def test_flight_recorder_ring_dump_and_thread_stacks(tmp_path):
+    path = str(tmp_path / "flight.json")
+    rec = FlightRecorder(path=path, ring=4)
+    for i in range(10):
+        rec.event("fleet", "tick", i=i)
+    rec.record("serve", "span", {"name": "request"})
+    got = rec.dump("test-reason")
+    assert got == path
+    doc = json.load(open(path))
+    assert doc["schema"] == FLIGHT_SCHEMA
+    assert doc["reason"] == "test-reason"
+    assert len(doc["rings"]["fleet"]) == 4            # bounded ring
+    assert doc["rings"]["fleet"][-1]["i"] == 9        # newest retained
+    assert doc["rings"]["serve"][0]["name"] == "request"
+    # every live thread's stack is in the dump (the wedged-stage story)
+    assert any("test_flight_recorder" in "".join(frames)
+               for frames in doc["threads"].values())
+    # successive dumps get distinct names, never clobber the first
+    second = rec.dump("again")
+    assert second != path and os.path.exists(second)
+    assert json.load(open(path))["reason"] == "test-reason"
+
+
+def test_watchdog_trip_dumps_active_recorder(tmp_path):
+    from iterative_cleaner_tpu.resilience import (
+        StageTimeout,
+        call_with_deadline,
+    )
+
+    path = str(tmp_path / "flight.json")
+    rec = FlightRecorder(path=path)
+    set_active(rec)
+    try:
+        tr = Tracer(recorder=rec)
+        span = tr.start("execute", subsystem="fleet", request_id="r1")
+        with pytest.raises(StageTimeout):
+            call_with_deadline(lambda: time.sleep(5.0), 0.05, "execute",
+                               span=span)
+        span.end("error")
+        assert os.path.exists(path), "watchdog trip left no flight dump"
+        doc = json.load(open(path))
+        assert doc["reason"] == "watchdog-trip:execute"
+        text = json.dumps(doc)
+        assert "watchdog_trip" in text
+        # the tripped request's span had not finished at dump time, but a
+        # later dump carries it through the recorder's span ring
+        second = dump_active("after")
+        assert "r1" in json.dumps(json.load(open(second)))
+    finally:
+        set_active(None)
+
+
+# ------------------------------------------- label-suffix metrics, presets
+
+def test_labeled_split_labels_round_trip():
+    name = labeled("serve_e2e_s", tenant="survey", prio="2")
+    assert name == "serve_e2e_s{prio=2,tenant=survey}"   # sorted keys
+    base, lab = split_labels(name)
+    assert base == "serve_e2e_s"
+    assert lab == {"tenant": "survey", "prio": "2"}
+    assert labeled("plain") == "plain"
+    assert split_labels("plain") == ("plain", {})
+
+
+def test_prometheus_rendering_of_labeled_series():
+    reg = MetricsRegistry()
+    reg.counter_inc(labeled("serve_e2e", tenant="a"), 2)
+    reg.counter_inc(labeled("serve_e2e", tenant="b"), 3)
+    reg.histogram_observe(labeled("serve_e2e_s", tenant="a"), 0.2,
+                          buckets=SECONDS)
+    text = metrics_to_prometheus(reg.snapshot())
+    assert 'icln_serve_e2e_total{tenant="a"} 2' in text
+    assert 'icln_serve_e2e_total{tenant="b"} 3' in text
+    assert 'icln_serve_e2e_s_bucket{tenant="a",le="0.5"} 1' in text
+    assert 'icln_serve_e2e_s_count{tenant="a"} 1' in text
+    # one TYPE row per family even with two labeled children
+    assert text.count("# TYPE icln_serve_e2e_total counter") == 1
+
+
+def test_bucket_presets_distinct_and_applied():
+    assert SECONDS != COUNTS != BYTES
+    assert SECONDS[0] < 0.01 and SECONDS[-1] >= 60     # latency spread
+    assert BYTES[-1] >= 1 << 30                        # up to GiB
+    reg = MetricsRegistry()
+    reg.histogram_observe("lat_s", 0.3, buckets=SECONDS)
+    reg.histogram_observe("n_loops", 7, buckets=COUNTS)
+    snap = reg.snapshot()["histograms"]
+    assert snap["lat_s"]["buckets"] == list(SECONDS)
+    assert snap["n_loops"]["buckets"] == list(COUNTS)
+
+
+def test_registry_concurrent_threads_lose_nothing():
+    """Satellite contract: counters_mark/counters_since/histogram_observe
+    under concurrent writers — totals exact, no torn histogram state."""
+    reg = MetricsRegistry()
+    n_threads, n_each = 8, 500
+    marks = []
+
+    def hammer(t):
+        for i in range(n_each):
+            reg.counter_inc("hits")
+            reg.counter_inc(labeled("hits", tenant="t%d" % (t % 2)))
+            reg.histogram_observe("lat_s", 0.001 * i, buckets=SECONDS)
+            if i % 100 == 0:
+                marks.append(reg.counters_since(reg.counters_mark()))
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * n_each
+    assert reg.counters["hits"] == total
+    assert (reg.counters['hits{tenant=t0}']
+            + reg.counters['hits{tenant=t1}']) == total
+    h = reg.snapshot()["histograms"]["lat_s"]
+    assert h["count"] == total
+    assert h["cumulative_counts"][-1] == total
+    # a since(mark) taken mid-run is a delta, so it can never go negative
+    assert all(v >= 0 for d in marks for v in d.values())
+
+
+# --------------------------------------------------------------- rotation
+
+def test_rotate_log_keep_one_generation(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    for i in range(50):
+        locked_append(path, json.dumps({"i": i}) + "\n")
+    assert not rotate_log(path, 10_000_000)            # under the cap
+    assert rotate_log(path, 100)                       # over: rotate
+    assert os.path.getsize(path) == 0                  # live file restarts
+    old = open(path + ".1").read().splitlines()
+    assert json.loads(old[0])["i"] == 0                # history preserved
+    assert json.loads(old[-1])["i"] == 49
+    # next rotation replaces .1 (keep-one bound, ~2x cap total)
+    locked_append(path, "x" * 200 + "\n")
+    assert rotate_log(path, 100)
+    assert open(path + ".1").read().startswith("x")
+
+
+# ------------------------------------- stitched serve trace (in-process)
+
+def _daemon(tmp_path, **serve_kw):
+    serve_kw.setdefault("http_port", 0)
+    serve_kw.setdefault("poll_s", 0.02)
+    serve_kw.setdefault("journal_path", str(tmp_path / "serve.jsonl"))
+    serve_kw.setdefault("flight_recorder",
+                        str(tmp_path / "serve.flight.json"))
+    from iterative_cleaner_tpu.serve.daemon import ServeDaemon
+
+    return ServeDaemon(ServeConfig(**serve_kw), NUMPY_BASE, quiet=True)
+
+
+def _start(daemon):
+    t = threading.Thread(target=daemon.run, daemon=True)
+    t.start()
+    deadline = time.time() + 30
+    while daemon._httpd is None:
+        assert time.time() < deadline, "daemon never bound its port"
+        time.sleep(0.01)
+    return t, "http://127.0.0.1:%d" % daemon._httpd.server_address[1]
+
+
+def _get(url, expect=200):
+    try:
+        r = urllib.request.urlopen(url, timeout=10)
+        assert r.status == expect
+        return json.loads(r.read())
+    except urllib.error.HTTPError as exc:
+        assert exc.code == expect, (exc.code, exc.read())
+        return json.loads(exc.read())
+
+
+def test_serve_request_trace_is_one_stitched_tree(tmp_path):
+    ar, _ = make_synthetic_archive(nsub=4, nchan=8, nbin=16, seed=7)
+    a = str(tmp_path / "a.npz")
+    save_archive(ar, a)
+    trace_out = str(tmp_path / "trace.json")
+    d = _daemon(tmp_path, trace_out=trace_out)
+    t, url = _start(d)
+    try:
+        body = json.dumps({"paths": [a], "id": "r1",
+                           "trace": "req-cafe42"}).encode()
+        r = urllib.request.urlopen(
+            urllib.request.Request(url + "/submit", data=body), timeout=10)
+        assert json.loads(r.read())["accepted"]
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = _get(url + "/requests/r1")
+            if st["state"] in ("done", "failed"):
+                break
+            time.sleep(0.05)
+        assert st["state"] == "done", st
+        assert st["trace_id"] == "req-cafe42"   # journaled lifecycle too
+
+        view = _get(url + "/trace/r1")          # request id OR trace id
+        assert view == _get(url + "/trace/req-cafe42")
+        spans = view["spans"]
+        assert view["trace_id"] == "req-cafe42"
+        names = [s["name"] for s in spans]
+        for want in ("request", "queue", "execute", "fleet", "group",
+                     "load", "write"):
+            assert want in names, (want, names)
+        # single stitched tree: one root, every parent link resolves,
+        # every span under the client's trace id
+        assert all(s["trace_id"] == "req-cafe42" for s in spans)
+        by_id = {s["span_id"]: s for s in spans}
+        roots = [s for s in spans if not s["parent_id"]]
+        assert [s["name"] for s in roots] == ["request"]
+        assert all(s["parent_id"] in by_id for s in spans
+                   if s["parent_id"])
+        assert _get(url + "/trace/ghost", expect=404)["error"]
+
+        dv = _get(url + "/debug/vars")
+        for key in ("health", "serve_config", "counters", "gauges",
+                    "recent_spans", "flight_recorder", "trace_out"):
+            assert key in dv, key
+        assert dv["recent_spans"]
+
+        # per-tenant e2e histogram rides the label-suffix convention
+        text = urllib.request.urlopen(url + "/metrics",
+                                      timeout=10).read().decode()
+        assert 'icln_serve_e2e_s_count{tenant="default"} 1' in text
+    finally:
+        d._on_signal(signal.SIGTERM, None)
+        t.join(30)
+    assert not t.is_alive()
+    # daemon shutdown rendered the Perfetto export
+    doc = json.load(open(trace_out))
+    assert any(e["ph"] == "X" and e["name"] == "request"
+               for e in doc["traceEvents"])
+    # spans also landed on the spool, schema-tagged
+    assert all(s["schema"] == SPAN_SCHEMA
+               for s in read_spans(spool_path_for(trace_out)))
+
+
+def test_rejected_request_leaves_no_root_span(tmp_path):
+    d = _daemon(tmp_path, queue_limit=1)
+    from iterative_cleaner_tpu.serve import Rejection, parse_request
+
+    d.admit(parse_request({"paths": ["/d/a.npz"], "id": "r1"}), "test")
+    with pytest.raises(Rejection):
+        d.admit(parse_request({"paths": ["/d/b.npz"], "id": "r2"}), "test")
+    assert "r1" in d._root_spans and "r2" not in d._root_spans
+
+
+# -------------------------------- trace recovery across a stolen bucket
+
+def _write_archives(tmp_path, geoms, seed0=70):
+    paths = []
+    for i, (nsub, nchan, nbin) in enumerate(geoms):
+        ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                       seed=seed0 + i)
+        p = str(tmp_path / ("obs_%02d.npz" % i))
+        save_archive(ar, p)
+        paths.append(p)
+    return paths
+
+
+def test_steal_recovers_victim_trace_from_journal(tmp_path):
+    """The cross-host stitching contract, in-process: host 0 serves a
+    2-host slice alone; the dead host 1's expired claim line carries its
+    trace context, and the stolen bucket's span must parent THERE —
+    the victim's request tree continues instead of a fresh orphan trace
+    appearing."""
+    from iterative_cleaner_tpu.parallel.distributed import HostTopology
+    from iterative_cleaner_tpu.parallel.fleet import (
+        bucket_host,
+        bucket_work_key,
+        clean_fleet,
+    )
+    from iterative_cleaner_tpu.resilience import (
+        FleetJournal,
+        ResiliencePlan,
+    )
+
+    geoms = [(16, 32, 32), (12, 32, 32)]
+    keys = [(n, c, b, False) for n, c, b in geoms]
+    owners = {k: bucket_host(k, 2) for k in keys}
+    assert set(owners.values()) == {0, 1}, owners
+    victim_key = next(k for k, h in owners.items() if h == 1)
+
+    paths = _write_archives(tmp_path, geoms)
+    jpath = str(tmp_path / "j.jsonl")
+    journal = FleetJournal(jpath)
+    journal.record_claim(
+        bucket_work_key(victim_key), host=1, nonce="h1-dead-0-00000000",
+        ttl_s=1.0, now=time.time() - 60.0,
+        trace={"trace_id": "victim-trace", "span_id": "cafe0123"})
+
+    cfg = CleanConfig(backend="jax", max_iter=2, fleet_claim_ttl_s=3.0)
+    tracer = Tracer(host="h0")
+    rep = clean_fleet(
+        paths, cfg, hosts=HostTopology(host_id=0, n_hosts=2),
+        resilience=ResiliencePlan(journal=FleetJournal(jpath)),
+        registry=MetricsRegistry(), tracer=tracer, precompile=False)
+    assert not rep.failures and rep.n_stolen >= 1
+
+    stolen = [s for s in tracer.recent(200)
+              if s["name"] == "serve_bucket" and s["attrs"].get("stolen")]
+    assert stolen, "no stolen-bucket span recorded"
+    s = stolen[0]
+    assert s["trace_id"] == "victim-trace"      # recovered from journal
+    assert s["parent_id"] == "cafe0123"         # stitched under victim
+    assert any(e["name"] == "stolen" and e.get("recovered_trace")
+               for e in s.get("events", ()))
+    # the claimant republished its own context on its claim line, and
+    # its done lines carry it too — a THIRD host could stitch onward
+    with open(jpath) as fh:
+        lines = [json.loads(ln) for ln in fh if ln.strip()]
+    claims = [e for e in lines if e.get("event") == "claim"
+              and e.get("host") == 0 and e.get("state") == "claim"
+              and e.get("work") == bucket_work_key(victim_key)]
+    assert claims and claims[-1]["trace"] == {
+        "trace_id": "victim-trace", "span_id": s["span_id"]}
+    done_traces = [e.get("trace") for e in lines
+                   if e.get("event") == "done"]
+    assert any(t and t.get("trace_id") == "victim-trace"
+               for t in done_traces)
+
+
+def test_fleet_masks_bit_equal_with_tracing_on(tmp_path):
+    """Tracing must observe, never perturb: identical masks with a live
+    tracer + spool as with tracing off."""
+    from iterative_cleaner_tpu.parallel.fleet import clean_fleet
+
+    paths = _write_archives(tmp_path, [(8, 16, 32), (6, 16, 32)])
+    cfg = CleanConfig(backend="jax", max_iter=2)
+    plain = clean_fleet(paths, cfg, registry=MetricsRegistry(),
+                        precompile=False)
+    traced = clean_fleet(
+        paths, cfg, registry=MetricsRegistry(), precompile=False,
+        tracer=Tracer(host="h0",
+                      spool_path=str(tmp_path / "t.spans.jsonl")),
+        trace={"trace_id": "parity-run", "span_id": "0011223344556677"})
+    assert not plain.failures and not traced.failures
+    for p in paths:
+        assert np.array_equal(plain.results[p].final_weights,
+                              traced.results[p].final_weights), p
+    spans = read_spans(str(tmp_path / "t.spans.jsonl"))
+    assert all(s["trace_id"] == "parity-run" for s in spans)
+    assert {"fleet", "group", "execute", "load"} <= \
+        {s["name"] for s in spans}
+
+
+@pytest.mark.slow
+def test_sigkilled_host_trace_stitches_in_survivor_subprocess(tmp_path):
+    """The acceptance drill end-to-end over the CLI: host 1 claims its
+    bucket (claim line carrying its trace context), wedges in execute and
+    is SIGKILLed; host 0 --trace-out steals after lease expiry.  The
+    shared span spool must hold the survivor's stolen serve_bucket span
+    UNDER THE DEAD HOST's trace id, and the Perfetto render must be valid
+    JSON with both hosts' lanes."""
+    import subprocess
+    import sys
+
+    from tests.conftest import repo_subprocess_env
+
+    paths = _write_archives(tmp_path, [(16, 32, 32), (12, 32, 32)] * 2)
+    env = repo_subprocess_env(JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    jpath = str(tmp_path / "j.jsonl")
+    trace_out = str(tmp_path / "trace.json")
+
+    def cmd(host_id, metrics):
+        return [sys.executable, "-m", "iterative_cleaner_tpu", "-q",
+                "--fleet", "--max_iter", "2", "--metrics-json", metrics,
+                "--journal", jpath, "--hosts", "2",
+                "--host-id", str(host_id), "--claim-ttl", "3",
+                "--trace-out", trace_out] + paths
+
+    victim = subprocess.Popen(
+        cmd(1, str(tmp_path / "m1.json")),
+        env=dict(env, ICLEAN_FAULTS="execute:hang@1",
+                 ICLEAN_FAULT_HANG_S="600"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def victim_claim():
+        try:
+            with open(jpath) as f:
+                for line in f:
+                    try:
+                        e = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (isinstance(e, dict) and e.get("event") == "claim"
+                            and e.get("host") == 1
+                            and e.get("state") == "claim"):
+                        return e
+        except OSError:
+            pass
+        return None
+
+    deadline = time.time() + 300
+    while victim_claim() is None:
+        assert victim.poll() is None, "victim exited before claiming"
+        assert time.time() < deadline, "victim never claimed its bucket"
+        time.sleep(0.25)
+    claim = victim_claim()
+    assert claim.get("trace"), "claim line carries no trace context"
+    victim_trace = claim["trace"]["trace_id"]
+    victim.send_signal(signal.SIGKILL)
+    victim.wait(timeout=60)
+
+    proc = subprocess.run(
+        cmd(0, str(tmp_path / "m0.json")), env=env, timeout=540,
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+
+    spans = read_spans(spool_path_for(trace_out))
+    stolen = [s for s in spans if s["name"] == "serve_bucket"
+              and (s.get("attrs") or {}).get("stolen")
+              and s["host"] == "h0"]
+    assert stolen, "survivor recorded no stolen-bucket span"
+    assert any(s["trace_id"] == victim_trace for s in stolen), (
+        victim_trace, [s["trace_id"] for s in stolen])
+    # both hosts spooled spans, and the rendered Perfetto file is valid
+    # JSON with one lane per host
+    assert {"h0", "h1"} <= {s["host"] for s in spans}
+    doc = json.load(open(trace_out))
+    names = {m["args"]["name"] for m in doc["traceEvents"]
+             if m["ph"] == "M" and m["name"] == "process_name"}
+    assert {"host h0", "host h1"} <= names
