@@ -1137,6 +1137,81 @@ def bench_multihost(n_archives, geometries, max_iter=2, claim_ttl=5.0):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_online(n_subints, nchan, nbin, reconcile_every=4, bucket_pad=8,
+                 max_iter=3):
+    """Online-mode row (online/session.py): per-subint zap latency for a
+    live stream, measured subint by subint through an OnlineSession.
+
+    Three contracts, all fatal when broken:
+
+    * ``online_recompiles_steady`` == 0 — after warm-up (the one step
+      compile plus one reconcile compile per capacity bucket) a live
+      stream must never hit the compiler again; a recompile in steady
+      state IS the latency regression this subsystem exists to prevent.
+    * ``online_vs_batch_masks`` — the close reconciliation's mask must be
+      bit-equal with ``clean_archive`` over the same subints (the rows'
+      shared parity-is-fatal contract, rc 7).
+    * ``online_subint_p99_ms`` is computed over post-warm-up subints
+      (the first pays the compile; a pipeline budgets the steady tail).
+    """
+    import jax  # noqa: F401  (the session's step is a compiled program)
+
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io.synthetic import (
+        bench_rfi_density,
+        make_synthetic_archive,
+    )
+    from iterative_cleaner_tpu.online import OnlineSession
+    from iterative_cleaner_tpu.online.chunks import StreamMeta
+    from iterative_cleaner_tpu.online.session import percentile_ms
+
+    ar, _ = make_synthetic_archive(
+        nsub=n_subints, nchan=nchan, nbin=nbin,
+        **bench_rfi_density(n_subints, nchan), seed=0, dtype=np.float32)
+    cfg = CleanConfig(backend="jax", max_iter=max_iter,
+                      fleet_bucket_pad=(bucket_pad, 0),
+                      stream_reconcile_every=reconcile_every)
+    cube = np.asarray(ar.total_intensity(), dtype=np.float64)
+    weights = np.asarray(ar.weights, dtype=np.float64)
+
+    session = OnlineSession(StreamMeta.from_archive(ar), cfg)
+    t0 = time.perf_counter()
+    for i in range(n_subints):
+        session.ingest(cube[i], weights[i], label="subint%03d" % i)
+    result = session.close()
+    dt = time.perf_counter() - t0
+
+    batch_mask = clean_archive(ar, cfg).final_weights == 0
+    online_mask = np.asarray(result.archive.weights) == 0
+    assert np.array_equal(online_mask, batch_mask), (
+        "online close-reconciled mask diverged from the batch clean "
+        "(%d cells)" % int(np.sum(online_mask != batch_mask)))
+    assert result.recompiles_steady == 0, (
+        "online mode recompiled %d time(s) in steady state (warm-up "
+        "compiles: %d)" % (result.recompiles_steady,
+                           result.warmup_compiles))
+
+    steady = result.latencies_s[1:] or result.latencies_s
+    p50 = percentile_ms(steady, 50.0)
+    p99 = percentile_ms(steady, 99.0)
+    _log(f"online ({n_subints} subints of {nchan}x{nbin}): "
+         f"p50 {p50:.1f} ms, p99 {p99:.1f} ms per subint, "
+         f"{result.warmup_compiles} warm-up compiles, 0 steady, "
+         f"{result.reconciles} reconciles, "
+         f"drift {result.mask_drift}+{result.final_drift}, {dt:.2f}s total")
+    return {
+        "online_n": n_subints,
+        "online_subint_p50_ms": round(p50, 3),
+        "online_subint_p99_ms": round(p99, 3),
+        "online_warmup_compiles": int(result.warmup_compiles),
+        "online_recompiles_steady": int(result.recompiles_steady),
+        "online_reconciles": int(result.reconciles),
+        "online_mask_drift": int(result.mask_drift + result.final_drift),
+        "online_vs_batch_masks": "identical",
+    }
+
+
 def bench_numpy(nsub, nchan, nbin, max_iter=5):
     from iterative_cleaner_tpu.backends.numpy_backend import clean_cube
     from iterative_cleaner_tpu.config import CleanConfig
@@ -1210,6 +1285,7 @@ def main():
                            ("BENCH_BATCH_ONLY", bench_batch),
                            ("BENCH_FLEET_ONLY", bench_fleet),
                            ("BENCH_SERVE_ONLY", bench_serve),
+                           ("BENCH_ONLINE_ONLY", bench_online),
                            ("BENCH_MULTIHOST_ONLY", bench_multihost)):
         if os.environ.get(env_key):
             geom = json.loads(os.environ[env_key])
@@ -1325,6 +1401,20 @@ def main():
         {"n_requests": sv_n, "geometries": sv_geoms},
         timeout=float(os.environ.get("BENCH_SERVE_TIMEOUT", "600")),
         label="serve")
+    if row:
+        extras = {**(extras or {}), **row}
+
+    # online-mode row (online/session.py): per-subint latency for a live
+    # stream, zero-steady-recompile and close-reconciliation-parity
+    # contracts enforced inside the stage — same killable-subprocess +
+    # parity-is-fatal contract as the rows above
+    o_n, o_geom = ((8, (16, 32)) if small else (64, (64, 128)))
+    row = _bench_row_subprocess(
+        "BENCH_ONLINE_ONLY",
+        {"n_subints": o_n, "nchan": o_geom[0], "nbin": o_geom[1],
+         "reconcile_every": 4, "bucket_pad": 4 if small else 16},
+        timeout=float(os.environ.get("BENCH_ONLINE_TIMEOUT", "600")),
+        label="online")
     if row:
         extras = {**(extras or {}), **row}
 

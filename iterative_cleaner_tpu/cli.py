@@ -383,13 +383,43 @@ def build_parser() -> argparse.ArgumentParser:
                              "force-exit. --serve defaults to "
                              "serve.flight.json; pass '' to disable. "
                              "Mirrors ICLEAN_FLIGHT_RECORDER.")
-    parser.add_argument("--stream", type=int, default=0, metavar="CHUNK",
-                        help="Clean each archive in CHUNK-subint streaming "
-                             "tiles (parallel/streaming.py) instead of one "
+    parser.add_argument("--stream", type=str, default="0",
+                        metavar="CHUNK|DIR",
+                        help="An integer CHUNK cleans each archive in "
+                             "CHUNK-subint streaming tiles "
+                             "(parallel/streaming.py) instead of one "
                              "device footprint — for observations larger "
-                             "than HBM; 0 (default) disables. Composes "
-                             "with --mesh cell (each tile sharded, either "
-                             "stream mode).")
+                             "than HBM; 0 (default) disables; composes "
+                             "with --mesh cell. A directory path instead "
+                             "runs the ONLINE mode (online/session.py): "
+                             "tail DIR for per-subint chunk files "
+                             "(.npy/.npz/subint-FITS, sorted name order), "
+                             "clean each within bounded latency as it "
+                             "lands, and finish on a 'stream.close' "
+                             "sentinel file (or ICLEAN_STREAM_IDLE_S "
+                             "seconds idle, default 30) — the final "
+                             "output is bit-equal with a batch clean of "
+                             "the same subints. Bare .npy chunks need a "
+                             "stream.json metadata file in DIR.")
+    parser.add_argument("--stream-reconcile-every", "--stream_reconcile_every",
+                        type=int, default=None, dest="stream_reconcile_every",
+                        metavar="K",
+                        help="Online mode: re-clean the accumulated cube "
+                             "through the batch pipeline every K subints, "
+                             "repairing provisional-mask drift mid-stream "
+                             "(default: ICLEAN_STREAM_RECONCILE_EVERY env "
+                             "var, else 8; 0 disables mid-stream "
+                             "reconciles — close always reconciles, so "
+                             "the final mask is unaffected).")
+    parser.add_argument("--stream-ew-alpha", "--stream_ew_alpha",
+                        type=float, default=None, dest="stream_ew_alpha",
+                        metavar="A",
+                        help="Online mode: exponential weight of the "
+                             "newest subint's profile in the running "
+                             "template, 0 < A <= 1 (default: "
+                             "ICLEAN_STREAM_EW_ALPHA env var, else 0.2). "
+                             "Only the provisional per-subint zap sees "
+                             "the template; the final mask is unaffected.")
     parser.add_argument("--stream_hbm_mb", type=float, default=None,
                         metavar="MB",
                         help="HBM byte budget (MiB) for the exact stream "
@@ -423,15 +453,21 @@ def build_parser() -> argparse.ArgumentParser:
                              "combine 'cell' with --rotation roll "
                              "--fft_mode dft (XLA:CPU's fft rejects "
                              "sharded layouts).")
-    parser.add_argument("--model", choices=("surgical_scrub", "quicklook"),
+    parser.add_argument("--model",
+                        choices=("surgical_scrub", "quicklook",
+                                 "online_ewt"),
                         default="surgical_scrub",
                         help="Cleaning strategy: the flagship iterative "
-                             "surgical scrub (reference algorithm), or the "
+                             "surgical scrub (reference algorithm); the "
                              "single-pass template-free quicklook triage "
                              "cleaner (models/quicklook.py; no template "
                              "stage, so --max_iter, -r/--pulse_region, "
                              "--stats_impl and --stats_frame do not "
-                             "apply).")
+                             "apply); or online_ewt (online/model.py), "
+                             "the streaming exponentially-weighted-"
+                             "template pass — the provisional per-subint "
+                             "answer the online mode gives before "
+                             "reconciliation.")
     return parser
 
 
@@ -460,6 +496,8 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         fft_mode=args.fft_mode,
         baseline_mode=args.baseline_mode,
         stream_hbm_mb=getattr(args, "stream_hbm_mb", None),
+        stream_reconcile_every=getattr(args, "stream_reconcile_every", None),
+        stream_ew_alpha=getattr(args, "stream_ew_alpha", None),
         fleet_bucket_pad=tuple(getattr(args, "bucket_pad", (0, 0))),
         # --fleet reuses --batch B as its group size (same knob, same
         # meaning: archives per compiled program)
@@ -970,6 +1008,95 @@ def _run_serve(args, telemetry=None) -> int:
         events=(telemetry.events if telemetry is not None else None))
 
 
+def _run_stream(args, telemetry=None) -> int:
+    """--stream DIR driver: the online mode for one live stream on this
+    host (no daemon).  Tails DIR for chunk files in sorted name order,
+    ingests each through an :class:`~iterative_cleaner_tpu.online.
+    OnlineSession` (bounded per-subint latency, provisional zap,
+    periodic reconciliation), and finishes when a ``stream.close``
+    sentinel file appears — or after ICLEAN_STREAM_IDLE_S seconds
+    (default 30) with no new chunks, so an interrupted producer still
+    yields a cleaned archive.  The close reconciliation makes the output
+    bit-equal with a batch clean of the same subints."""
+    import time as _time
+
+    from iterative_cleaner_tpu.online import (
+        CLOSE_SENTINEL,
+        OnlineSession,
+        is_chunk_name,
+        load_chunk,
+        load_stream_meta,
+    )
+
+    cfg = config_from_args(args)
+    d = os.path.abspath(args.stream_dir)
+    if not os.path.isdir(d):
+        print("ERROR: --stream directory %s does not exist" % d,
+              file=sys.stderr)
+        return 2
+    idle_s = float(os.environ.get("ICLEAN_STREAM_IDLE_S", "30"))
+    meta = load_stream_meta(d)  # None until an archive-container chunk
+    registry = telemetry.registry if telemetry is not None else None
+    session = None
+    seen: set = set()
+    last_new = _time.monotonic()
+    closed_by = "idle"
+    while True:
+        try:
+            names = sorted(os.listdir(d))
+        except OSError as exc:
+            print("ERROR: cannot list %s: %s" % (d, exc), file=sys.stderr)
+            return 1
+        progressed = False
+        for name in names:
+            if name in seen or not is_chunk_name(name):
+                continue
+            path = os.path.join(d, name)
+            seen.add(name)  # never spin on a chunk, good or bad
+            try:
+                data, weights, meta = load_chunk(path, meta)
+            except (OSError, ValueError) as exc:
+                print("ERROR reading chunk %s: %s" % (name, exc),
+                      file=sys.stderr)
+                continue
+            if session is None:
+                session = OnlineSession(meta, cfg, registry=registry)
+            n = session.ingest(data, weights, label=name)
+            progressed = True
+            if not args.quiet:
+                print("stream: subint %d <- %s (%.1f ms)"
+                      % (n, name, session.latencies_s[-1] * 1e3),
+                      flush=True)
+        if progressed:
+            last_new = _time.monotonic()
+            continue  # drain everything present before close/idle checks
+        if CLOSE_SENTINEL in names:
+            closed_by = "sentinel"
+            break
+        if _time.monotonic() - last_new >= idle_s:
+            break
+        _time.sleep(0.05)
+    if session is None:
+        print("ERROR: stream %s closed (%s) with no chunks ingested"
+              % (d, closed_by), file=sys.stderr)
+        return 1
+    result = session.close()
+    out = (args.output if args.output not in ("", "std")
+           else os.path.join(d, "stream_cleaned.npz"))
+    ar_io.save_archive(result.archive, out)
+    if not args.quiet:
+        print("stream: closed (%s) after %d subints — p99 %.1f ms, "
+              "%d warm-up compile%s, %d steady recompiles, %d reconciles, "
+              "drift %d mid + %d final"
+              % (closed_by, result.n_subints, result.p99_ms(),
+                 result.warmup_compiles,
+                 "" if result.warmup_compiles == 1 else "s",
+                 result.recompiles_steady, result.reconciles,
+                 result.mask_drift, result.final_drift))
+        print("Cleaned archive: %s" % out)
+    return 0
+
+
 def _parse_geometry_spec(spec: str):
     """'NSUBxNCHANxNBIN' -> (nsub, nchan, nbin) for --precompile arguments
     that are not paths; None when the string does not look like one."""
@@ -1052,6 +1179,18 @@ def main(argv=None) -> int:
         device_reachable,
     )
 
+    # --stream is overloaded: an integer is the tiled-streaming chunk
+    # size, anything else is the online mode's chunk directory.  Split
+    # the two here so every later `args.stream > 0` comparison keeps its
+    # original meaning.
+    raw_stream = str(args.stream)
+    if raw_stream.lstrip("-").isdigit():
+        args.stream = int(raw_stream)
+        args.stream_dir = ""
+    else:
+        args.stream_dir = raw_stream
+        args.stream = 0
+
     # pure-argument validation first: never make a bad invocation wait
     # out the device probe below before erroring
     if args.serve:
@@ -1061,14 +1200,15 @@ def main(argv=None) -> int:
                 "requests arrive via --spool/--http-port (drop the "
                 "paths, or drop --serve for a batch run)")
         if (args.fleet or args.precompile or args.resume or args.checkpoint
-                or args.stream > 0 or args.unload_res or args.batch > 1
-                or args.prefetch > 0 or args.output
+                or args.stream > 0 or args.stream_dir or args.unload_res
+                or args.batch > 1 or args.prefetch > 0 or args.output
                 or args.model != "surgical_scrub"):
             build_parser().error(
                 "--serve is incompatible with the batch-run flags "
                 "--fleet/--precompile/--resume/--checkpoint/--stream/"
                 "--unload_res/--batch/--prefetch/-o/--model quicklook "
-                "(requests carry their own per-request overrides)")
+                "(requests carry their own per-request overrides; live "
+                "streams arrive as kind: \"stream\" requests)")
         if args.backend != "jax":
             build_parser().error("--serve requires --backend jax (a "
                                  "resident numpy daemon has nothing to "
@@ -1088,9 +1228,10 @@ def main(argv=None) -> int:
         build_parser().error(
             "--spool/--http-port/--max-inflight configure the --serve "
             "daemon; pass --serve")
-    elif not args.archive:
+    elif not args.archive and not args.stream_dir:
         build_parser().error(
-            "at least one archive path is required (or pass --serve)")
+            "at least one archive path is required (or pass --serve, "
+            "or --stream DIR for the online mode)")
     if args.resume and not args.journal:
         build_parser().error(
             "--resume needs an explicit --journal PATH: resuming against "
@@ -1236,6 +1377,35 @@ def main(argv=None) -> int:
         build_parser().error(
             f"--stream must be a positive tile size (0 disables), got "
             f"{args.stream}")
+    if args.stream_dir:
+        if args.archive:
+            build_parser().error(
+                "--stream DIR (online mode) takes no archive arguments: "
+                "the chunks in DIR are the input")
+        if (args.fleet or args.precompile or args.batch > 1
+                or args.prefetch > 0 or args.mesh != "off"
+                or args.unload_res or args.checkpoint
+                or args.record_history
+                or args.model != "surgical_scrub"):
+            build_parser().error(
+                "--stream DIR (online mode) is incompatible with "
+                "--fleet/--precompile/--batch/--prefetch/--mesh/"
+                "--unload_res/--checkpoint/--record_history/--model "
+                "(one live stream, cleaned with the flagship strategy)")
+        if args.backend != "jax":
+            build_parser().error(
+                "--stream DIR (online mode) requires --backend jax (the "
+                "fixed-shape per-subint step is a compiled program)")
+    if ((args.stream_reconcile_every is not None
+         or args.stream_ew_alpha is not None)
+            and not (args.stream_dir or args.serve
+                     or args.model == "online_ewt")):
+        # the online knobs only exist in the online session — a silently
+        # ignored flag would mislead (same contract as --bucket-pad)
+        build_parser().error(
+            "--stream-reconcile-every/--stream-ew-alpha configure the "
+            "online mode; pass --stream DIR, --model online_ewt, or "
+            "--serve (whose stream requests inherit them)")
     if args.stream > 0 and (args.batch > 1 or args.unload_res
                             or args.record_history or args.checkpoint
                             or args.model != "surgical_scrub"):
@@ -1274,6 +1444,8 @@ def main(argv=None) -> int:
     with run_session(args) as telemetry:
         if args.serve:
             serve_rc = _run_serve(args, telemetry)
+        elif args.stream_dir:
+            serve_rc = _run_stream(args, telemetry)
         elif args.fleet:
             failed = _run_fleet(args, telemetry)
         elif args.batch > 1:
@@ -1298,7 +1470,7 @@ def main(argv=None) -> int:
                     print("ERROR cleaning %s: %s: %s"
                           % (in_path, type(exc).__name__, exc),
                           file=sys.stderr)
-    if args.serve:
+    if args.serve or args.stream_dir:
         return serve_rc
     if failed:
         print("Failed %d/%d archives: %s"

@@ -77,6 +77,20 @@ class CleanConfig:
     # behaviour, the right call when the observation must not compete
     # with anything else for HBM).
     stream_hbm_mb: Optional[float] = None
+    # online mode (online/session.py): mid-stream reconciliation period in
+    # subints — every N ingests the accumulated cube is re-cleaned by the
+    # batch pipeline and provisional-mask drift repaired.  None defers to
+    # the ICLEAN_STREAM_RECONCILE_EVERY env var, then 8; 0 disables
+    # mid-stream reconciles (the close-time reconcile always runs — the
+    # bit-equality contract with the offline cleaner is unconditional, so
+    # neither knob can change a closed stream's final mask).
+    stream_reconcile_every: Optional[int] = None
+    # EW running-template weight for the online per-subint step
+    # (online/ewt.py): T_n = (1-alpha) T_{n-1} + alpha p_n, i.e. a
+    # forgetting horizon of ~1/alpha subints.  Only the provisional zap
+    # sees the EW template.  None defers to ICLEAN_STREAM_EW_ALPHA,
+    # then 0.2.
+    stream_ew_alpha: Optional[float] = None
     # fleet scheduler (parallel/fleet.py) pad-to-bucket geometry
     # quantization: (nsub_step, nchan_step) grid the planner rounds raw
     # shapes up to, merging near-miss geometries into one compiled bucket.
@@ -198,6 +212,16 @@ class CleanConfig:
             raise ValueError(
                 f"stream_hbm_mb must be >= 0 (0 disables the stream tile "
                 f"cache), got {self.stream_hbm_mb}")
+        if self.stream_reconcile_every is not None \
+                and self.stream_reconcile_every < 0:
+            raise ValueError(
+                f"stream_reconcile_every must be >= 0 (0 = reconcile only "
+                f"at close), got {self.stream_reconcile_every}")
+        if self.stream_ew_alpha is not None \
+                and not 0 < self.stream_ew_alpha <= 1:
+            raise ValueError(
+                f"stream_ew_alpha must be in (0, 1], got "
+                f"{self.stream_ew_alpha}")
         if (len(tuple(self.fleet_bucket_pad)) != 2
                 or any(int(v) < 0 for v in self.fleet_bucket_pad)):
             raise ValueError(

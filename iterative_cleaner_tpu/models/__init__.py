@@ -12,8 +12,11 @@ the batched/sharded/streaming execution modes in
 ``SURGICAL_SCRUB`` is the flagship entry: clean one archive with a
 :class:`~iterative_cleaner_tpu.config.CleanConfig`.  ``QUICKLOOK``
 (:mod:`iterative_cleaner_tpu.models.quicklook`) is the single-pass
-template-free strategy for triage/pre-pass use; further strategies
-register the same way (a ``callable(archive, config) -> CleanResult``).
+template-free strategy for triage/pre-pass use, and ``ONLINE_EWT``
+(:mod:`iterative_cleaner_tpu.online.model`) is the streaming
+exponentially-weighted-template pass — the provisional per-subint answer
+the online mode gives before reconciliation; further strategies register
+the same way (a ``callable(archive, config) -> CleanResult``).
 """
 
 from iterative_cleaner_tpu.backends import CleanResult, clean_archive  # noqa: F401
@@ -47,14 +50,24 @@ def _quicklook(archive, config):
     return clean_archive_quicklook(archive, config)
 
 
+def _online_ewt(archive, config):
+    # lazy: the online session pulls in jax; keep numpy-oracle imports
+    # jax-free
+    from iterative_cleaner_tpu.online.model import clean_archive_online_ewt
+
+    return clean_archive_online_ewt(archive, config)
+
+
 # name -> callable(archive, config) -> CleanResult
 REGISTRY = {
     "surgical_scrub": clean_archive,
     "quicklook": _quicklook,
+    "online_ewt": _online_ewt,
 }
 
 SURGICAL_SCRUB = "surgical_scrub"
 QUICKLOOK = "quicklook"
+ONLINE_EWT = "online_ewt"
 
 
 def get_model(name: str = SURGICAL_SCRUB):

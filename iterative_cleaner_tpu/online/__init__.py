@@ -1,0 +1,49 @@
+"""Online low-latency cleaning for live telescope streams.
+
+The batch entry points (CLI, fleet, serve) all assume a complete archive
+on disk before any cleaning starts; a live pipeline needs subints
+cleaned as they arrive with bounded latency.  This package is that mode:
+
+``chunks``     per-subint chunk files (bare ``.npy`` tiles + a
+               ``stream.json`` metadata header, or any archive container
+               the io layer loads) and the assembled-archive round trip.
+``ewt``        the exponentially-weighted running template: updated per
+               subint instead of refit over the full archive.
+``session``    :class:`OnlineSession` — the ring-buffered ingest loop.
+               One fixed-shape jit step per subint (compiled once), host
+               capacity buffers quantized up the ``--bucket-pad`` grid
+               so steady-state ingestion performs zero recompiles.
+``reconcile``  periodic full-archive reconciliation: re-run the batch
+               cleaner over the accumulated cube, repair provisional
+               mask drift, and (at close) produce output bit-equal with
+               the offline path.
+``model``      ``online_ewt`` — the registry-selectable provisional
+               cleaner (the triage answer the live pipeline sees before
+               reconciliation).
+
+Wireups: ``--stream DIR`` in the CLI tails a chunk directory;
+``kind: "stream"`` serve requests (``POST /stream/<id>/subint`` /
+``/close``) flow the same session through the PR 6 daemon with
+journal-replayed crash recovery; per-subint latency histograms and
+spans ride the PR 9 tracer.
+"""
+
+from iterative_cleaner_tpu.online.chunks import (  # noqa: F401
+    CLOSE_SENTINEL,
+    STREAM_META_NAME,
+    StreamMeta,
+    assemble_archive,
+    is_chunk_name,
+    load_chunk,
+    load_stream_meta,
+    save_stream_meta,
+)
+from iterative_cleaner_tpu.online.session import (  # noqa: F401
+    DEFAULT_EW_ALPHA,
+    DEFAULT_NSUB_STEP,
+    DEFAULT_RECONCILE_EVERY,
+    OnlineResult,
+    OnlineSession,
+    resolve_ew_alpha,
+    resolve_reconcile_every,
+)

@@ -1,0 +1,42 @@
+"""Exponentially-weighted running template.
+
+The batch loop refits its template from the whole cube every iteration
+(``engine/loop.py::_build_template``); a live stream cannot afford a
+growing-cube refit per subint.  Instead the online session maintains
+
+    T_0     = p_0
+    T_n     = (1 - alpha) * T_{n-1} + alpha * p_n
+
+where ``p_n`` is subint ``n``'s weighted mean profile — the streaming
+analogue of the reference's weighted template, with an exponential
+forgetting horizon of ``1/alpha`` subints.  The provisional zap fits the
+EW template exactly like the batch fit
+(:func:`~iterative_cleaner_tpu.ops.dsp.fit_template_amplitudes`
+normalises per cell, so the template's overall scale cancels); the
+periodic reconciliation then replaces every provisional decision with
+the batch cleaner's, so EW-vs-refit drift never reaches the final mask.
+
+``xp``-style (numpy or jax.numpy) like :mod:`iterative_cleaner_tpu.ops`:
+the session traces these inside its jit step.
+"""
+
+from __future__ import annotations
+
+
+def subint_profile(ded_tile, weights_row, xp):
+    """Weighted mean profile of one ``(k, nchan, nbin)`` dedispersed tile
+    with ``(k, nchan)`` weights -> ``(nbin,)``.  All-zapped tiles return
+    zeros (the EW update then keeps the previous template)."""
+    wsum = xp.sum(weights_row)
+    num = xp.sum(ded_tile * weights_row[:, :, None], axis=(0, 1))
+    return xp.where(wsum > 0, num / xp.where(wsum > 0, wsum, 1.0),
+                    xp.zeros_like(num))
+
+
+def ew_update(template, count, profile, alpha, xp):
+    """One EW step.  ``count`` is how many profiles preceded this one:
+    the first real profile seeds the template outright (alpha would
+    otherwise anchor it to the zero init), and an all-zapped subint
+    (zero profile, detected by ``wsum``) is the caller's job to skip."""
+    seeded = (1.0 - alpha) * template + alpha * profile
+    return xp.where(count > 0, seeded, profile)

@@ -1,0 +1,47 @@
+"""``online_ewt`` — the EW-template online cleaner as a registry model.
+
+Streams an on-disk archive's subints through an :class:`.OnlineSession`
+exactly as a live pipeline would, and returns the **provisional**
+exponentially-weighted-template mask — the triage answer the online mode
+produces between reconciliations.  It sits in the model registry next to
+``quicklook``: both are cheap single-pass alternatives to the flagship
+``surgical_scrub``, but ``online_ewt``'s statistics are the streaming
+per-subint step (EW template fit + cell-local diagnostics), so it
+answers "what would the live mode have said, per subint, with no
+look-ahead?".
+
+Mid-stream and close reconciliation are deliberately NOT run here: with
+the whole archive already on disk, "reconcile" is just ``surgical_scrub``
+— select that model if the batch answer is what you want.  ``bad_chan``/
+``bad_subint`` sweeps apply as usual.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from iterative_cleaner_tpu.backends import apply_bad_parts
+from iterative_cleaner_tpu.backends.base import CleanResult
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.online.chunks import StreamMeta
+from iterative_cleaner_tpu.online.session import OnlineSession
+
+
+def clean_archive_online_ewt(archive, config: CleanConfig) -> CleanResult:
+    meta = StreamMeta.from_archive(archive)
+    session = OnlineSession(meta, config, reconcile_every=0)
+    cube = np.asarray(archive.total_intensity(), dtype=np.float64)
+    weights = np.asarray(archive.weights, dtype=np.float64)
+    for i in range(archive.nsub):
+        session.ingest(cube[i], weights[i])
+    zap_frac = float(np.mean(session.provisional_weights == 0)) \
+        if archive.nsub else 0.0
+    result = CleanResult(
+        final_weights=session.provisional_weights,
+        scores=session.provisional_scores,
+        loops=1, converged=True,
+        loop_diffs=np.array([float(np.sum(
+            (session.provisional_weights == 0) != (weights == 0)))]),
+        loop_rfi_frac=np.array([zap_frac]),
+    )
+    return apply_bad_parts(result, config)

@@ -1,0 +1,403 @@
+"""Ring-buffered online cleaning session.
+
+One :class:`OnlineSession` per live stream.  The ingest path is built
+for bounded per-subint latency with zero steady-state recompiles:
+
+* **Fixed-shape step.**  Every subint runs the same jitted
+  ``(1, nchan, nbin)`` program — baseline removal + dedispersion
+  (:func:`~iterative_cleaner_tpu.engine.loop.prepare_cube_jax`), an
+  in-graph exponentially-weighted template update (:mod:`.ewt`), then
+  the cell-local statistics half of the batch iteration
+  (:func:`~iterative_cleaner_tpu.engine.loop.diagnostics_given_template`
+  in the dedispersed frame +
+  :func:`~iterative_cleaner_tpu.stats.masked_jax.scale_and_combine`) and
+  the reference's zap rule.  The step compiles exactly once (warm-up).
+
+* **Bucketed capacity ring.**  Raw tiles accumulate in host buffers
+  whose capacity is quantized up the fleet's ``--bucket-pad`` nsub grid
+  (:func:`~iterative_cleaner_tpu.parallel.fleet.quantize_geometry`;
+  :data:`DEFAULT_NSUB_STEP` when unset).  Periodic reconciliation runs
+  the batch cleaner over the zero-weight-padded capacity cube, so its
+  compiled shapes walk the bucket grid: each capacity compiles once
+  (warm-up at bucket growth) and every later reconcile at that capacity
+  reuses it.  Any other compile increments ``recompiles_steady`` — the
+  bench/CI-pinned counter that must stay 0.
+
+* **Reconciliation contract** (:mod:`.reconcile`).  The per-subint zap
+  is provisional (a triage answer).  Every ``stream_reconcile_every``
+  subints the accumulated cube is re-cleaned by the real batch pipeline
+  and provisional-mask drift is counted and repaired; :meth:`close`
+  re-runs the offline path over the assembled archive, so the final
+  output is bit-equal with batch cleaning by construction.
+
+The per-subint statistics differ from a full refit in one honest way:
+with a single subint in view, the channel-axis median scaling degenerates
+(one sample per channel line), so a provisional zap is driven by how a
+cell stands out against the rest of *its own subint*.  Reconciliation
+replaces those decisions with the batch cleaner's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from iterative_cleaner_tpu.backends.base import CleanResult
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.online.chunks import StreamMeta, assemble_archive
+
+# Capacity grid when the config's fleet_bucket_pad nsub step is 0 (exact
+# bucketing makes sense for a fleet of fixed archives, but an online
+# session's nsub grows every subint — it must always quantize).
+DEFAULT_NSUB_STEP = 16
+DEFAULT_RECONCILE_EVERY = 8
+DEFAULT_EW_ALPHA = 0.2
+
+
+def resolve_reconcile_every(value: Optional[int]) -> int:
+    """Explicit config value, else ICLEAN_STREAM_RECONCILE_EVERY, else
+    :data:`DEFAULT_RECONCILE_EVERY`.  0 disables mid-stream reconciles
+    (close still reconciles — the bit-equality contract is unconditional)."""
+    if value is not None:
+        return int(value)
+    raw = os.environ.get("ICLEAN_STREAM_RECONCILE_EVERY", "")
+    return int(raw) if raw else DEFAULT_RECONCILE_EVERY
+
+
+def resolve_ew_alpha(value: Optional[float]) -> float:
+    """Explicit config value, else ICLEAN_STREAM_EW_ALPHA, else
+    :data:`DEFAULT_EW_ALPHA`."""
+    if value is not None:
+        return float(value)
+    raw = os.environ.get("ICLEAN_STREAM_EW_ALPHA", "")
+    return float(raw) if raw else DEFAULT_EW_ALPHA
+
+
+def percentile_ms(latencies_s, q: float) -> float:
+    """Exact (nearest-rank) percentile of a latency list, in ms."""
+    if not latencies_s:
+        return 0.0
+    xs = sorted(latencies_s)
+    idx = min(len(xs) - 1, max(0, math.ceil(q / 100.0 * len(xs)) - 1))
+    return xs[idx] * 1000.0
+
+
+def _jit_cache_size(fn) -> int:
+    """parallel/batch.py's compiled-executable probe, defaulting to 0
+    where the runtime hides it (counters then just stay at 0)."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return 0
+
+
+@dataclasses.dataclass
+class OnlineResult:
+    """What :meth:`OnlineSession.close` returns: the cleaned assembled
+    archive plus the session's latency/compile/drift accounting."""
+
+    archive: object                 # Archive with reconciled weights
+    result: CleanResult             # the close reconcile's batch result
+    n_subints: int
+    mask_drift: int                 # provisional cells repaired mid-stream
+    final_drift: int                # provisional cells repaired at close
+    warmup_compiles: int
+    recompiles_steady: int          # contract: 0
+    reconciles: int
+    latencies_s: List[float]
+
+    def p99_ms(self) -> float:
+        return percentile_ms(self.latencies_s, 99.0)
+
+
+class OnlineSession:
+    """Ingest subints one at a time; see the module docstring for the
+    latency/recompile/reconciliation design."""
+
+    def __init__(self, meta: StreamMeta, config: CleanConfig, *,
+                 reconcile_every: Optional[int] = None, registry=None,
+                 tracer=None, trace_id: Optional[str] = None,
+                 parent_span_id: Optional[str] = None):
+        self.meta = meta
+        self.config = config
+        self.alpha = resolve_ew_alpha(config.stream_ew_alpha)
+        self.reconcile_every = (
+            resolve_reconcile_every(config.stream_reconcile_every)
+            if reconcile_every is None else int(reconcile_every))
+        if self.reconcile_every < 0:
+            raise ValueError("reconcile_every must be >= 0")
+        self.nsub_step = int(config.fleet_bucket_pad[0]) or DEFAULT_NSUB_STEP
+        self.registry = registry
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.closed = False
+        # host capacity ring: raw tiles + as-ingested weights (what the
+        # reconciles clean) and the provisional EW-zapped view
+        self._n = 0
+        self._cap = 0
+        self._cube = None        # (cap, nchan, nbin) float64
+        self._weights = None     # (cap, nchan) as ingested
+        self._pweights = None    # (cap, nchan) provisional mask
+        self._pscores = None     # (cap, nchan)
+        # device-side EW state + the one fixed-shape step program
+        self._template = None
+        self._count = 0
+        self._step = None
+        # accounting (the bench/CI contract keys)
+        self.warmup_compiles = 0
+        self.recompiles_steady = 0
+        self.reconciles = 0
+        self.mask_drift = 0
+        self.latencies_s: List[float] = []
+        self.reconciled_caps = set()
+
+    # ------------------------------------------------------------- views
+    @property
+    def n_subints(self) -> int:
+        return self._n
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    @property
+    def provisional_weights(self) -> np.ndarray:
+        return self._pweights[:self._n].copy()
+
+    @property
+    def provisional_scores(self) -> np.ndarray:
+        return self._pscores[:self._n].copy()
+
+    def raw_weights(self) -> np.ndarray:
+        return self._weights[:self._n].copy()
+
+    def assembled(self):
+        """The accumulated stream as a regular Archive (raw weights —
+        the batch cleaner's input, not the provisional mask)."""
+        return assemble_archive(self.meta, self._cube[:self._n],
+                                self._weights[:self._n])
+
+    # ------------------------------------------------------------ ingest
+    def _grow(self, needed: int) -> None:
+        from iterative_cleaner_tpu.parallel.fleet import quantize_geometry
+
+        cap = quantize_geometry(needed, self.meta.nchan,
+                                (self.nsub_step, 0))[0]
+        cube = np.zeros((cap, self.meta.nchan, self.meta.nbin), np.float64)
+        weights = np.zeros((cap, self.meta.nchan), np.float64)
+        pweights = np.zeros((cap, self.meta.nchan), np.float64)
+        pscores = np.zeros((cap, self.meta.nchan), np.float64)
+        if self._n:
+            cube[:self._n] = self._cube[:self._n]
+            weights[:self._n] = self._weights[:self._n]
+            pweights[:self._n] = self._pweights[:self._n]
+            pscores[:self._n] = self._pscores[:self._n]
+        self._cube, self._weights = cube, weights
+        self._pweights, self._pscores = pweights, pscores
+        self._cap = cap
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        from iterative_cleaner_tpu.backends.jax_backend import (
+            resolve_fft_mode,
+            resolve_median_impl,
+        )
+        from iterative_cleaner_tpu.engine.loop import (
+            diagnostics_given_template,
+            prepare_cube_jax,
+        )
+        from iterative_cleaner_tpu.online.ewt import ew_update, subint_profile
+        from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
+
+        cfg = self.config
+        meta = self.meta
+        dtype = jnp.dtype(cfg.dtype)
+        fft_mode = resolve_fft_mode(cfg.fft_mode, dtype)
+        median_impl = resolve_median_impl(cfg.median_impl, dtype)
+        alpha = float(self.alpha)
+        freqs = np.asarray(meta.freqs_mhz, dtype=dtype)
+
+        def step(tile, w_row, template, count):
+            # cell-local preamble; always baseline_mode="profile" — the
+            # integration-mode consensus window needs the whole archive,
+            # which is exactly what a per-subint step cannot see.  The
+            # reconciles run the configured mode; only the provisional
+            # zap uses the per-profile window.
+            ded, _ = prepare_cube_jax(
+                tile, freqs, jnp.asarray(meta.dm, dtype),
+                jnp.asarray(meta.centre_freq_mhz, dtype),
+                jnp.asarray(meta.period_s, dtype),
+                baseline_duty=cfg.baseline_duty, rotation=cfg.rotation,
+                dedispersed=meta.dedispersed, baseline_mode="profile")
+            profile = subint_profile(ded, w_row, jnp)
+            wsum = jnp.sum(w_row)
+            updated = wsum > 0
+            new_template = jnp.where(
+                updated, ew_update(template, count, profile, alpha, jnp),
+                template)
+            cell_mask = w_row == 0
+            diags = diagnostics_given_template(
+                ded, None, new_template, w_row, cell_mask, None,
+                pulse_slice=cfg.pulse_slice, pulse_scale=cfg.pulse_scale,
+                pulse_active=cfg.pulse_region_active, rotation=cfg.rotation,
+                fft_mode=fft_mode, stats_impl="xla",
+                stats_frame="dedispersed")
+            scores = scale_and_combine(diags, cell_mask, cfg.chanthresh,
+                                       cfg.subintthresh, median_impl)
+            new_w = jnp.where(scores >= 1.0, 0.0, w_row)
+            return new_w, scores, new_template, updated
+
+        self._dtype = dtype
+        self._template = jnp.zeros((meta.nbin,), dtype)
+        return jax.jit(step)
+
+    def ingest(self, data, weights=None, *, label: str = "") -> int:
+        """Feed one chunk: ``(nchan, nbin)`` or ``(k, nchan, nbin)`` total
+        intensity (+ optional ``(k, nchan)`` weights, default all-live).
+        Returns the stream's new subint count."""
+        if self.closed:
+            raise RuntimeError("stream session is closed")
+        data = np.asarray(data, dtype=np.float64)
+        if data.ndim == 2:
+            data = data[None]
+        if data.ndim != 3 or data.shape[1:] != (self.meta.nchan,
+                                                self.meta.nbin):
+            raise ValueError(
+                f"chunk shape {data.shape} does not match stream geometry "
+                f"(*, {self.meta.nchan}, {self.meta.nbin})")
+        if weights is None:
+            weights = np.ones(data.shape[:2], dtype=np.float64)
+        weights = np.asarray(weights, dtype=np.float64)
+        if weights.ndim == 1:
+            weights = weights[None]
+        if weights.shape != data.shape[:2]:
+            raise ValueError(
+                f"chunk weights shape {weights.shape} does not match data "
+                f"{data.shape[:2]}")
+        for i in range(data.shape[0]):
+            self._ingest_one(data[i], weights[i], label=label)
+        return self._n
+
+    def _ingest_one(self, tile, w_row, *, label: str = "") -> None:
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start(
+                "subint", trace_id=self.trace_id,
+                parent_id=self.parent_span_id, subsystem="online",
+                subint=self._n, label=label)
+        if self._n >= self._cap:
+            self._grow(self._n + 1)
+        self._cube[self._n] = tile
+        self._weights[self._n] = w_row
+        if self._step is None:
+            self._step = self._build_step()
+        before = _jit_cache_size(self._step)
+        new_w, scores, new_template, updated = self._step(
+            jnp.asarray(tile[None], self._dtype),
+            jnp.asarray(w_row[None], self._dtype),
+            self._template, jnp.asarray(self._count, jnp.int32))
+        self._record_compiles(_jit_cache_size(self._step) - before,
+                              warmup=self._n == 0)
+        self._template = new_template
+        self._count += int(updated)
+        self._pweights[self._n] = np.asarray(new_w[0], np.float64)
+        self._pscores[self._n] = np.asarray(scores[0], np.float64)
+        self._n += 1
+        dt = time.perf_counter() - t0
+        self.latencies_s.append(dt)
+        if self.registry is not None:
+            from iterative_cleaner_tpu.telemetry.registry import SECONDS
+
+            self.registry.counter_inc("online_subints")
+            self.registry.gauge_set("online_nsub", self._n)
+            self.registry.histogram_observe("online_subint_s", dt,
+                                            buckets=SECONDS)
+        if span is not None:
+            span.set("nsub", self._n)
+            span.set("zapped", int(np.sum(self._pweights[self._n - 1] == 0)))
+            span.end()
+        if self.reconcile_every > 0 and self._n % self.reconcile_every == 0:
+            self.reconcile()
+
+    def _record_compiles(self, delta: int, *, warmup: bool) -> None:
+        if delta <= 0:
+            return
+        if warmup:
+            self.warmup_compiles += delta
+            if self.registry is not None:
+                self.registry.counter_inc("online_warmup_compiles", delta)
+        else:
+            self.recompiles_steady += delta
+            if self.registry is not None:
+                self.registry.counter_inc("online_recompiles_steady", delta)
+
+    # --------------------------------------------------------- reconcile
+    def reconcile(self) -> int:
+        """Mid-stream reconciliation (see :mod:`.reconcile`); returns the
+        number of drifted provisional cells repaired."""
+        from iterative_cleaner_tpu.online.reconcile import reconcile_session
+
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start(
+                "reconcile", trace_id=self.trace_id,
+                parent_id=self.parent_span_id, subsystem="online",
+                nsub=self._n, capacity=self._cap)
+        drift = reconcile_session(self)
+        self.reconciles += 1
+        if self.registry is not None:
+            self.registry.counter_inc("online_reconciles")
+            if drift:
+                self.registry.counter_inc("online_mask_drift", drift)
+        if span is not None:
+            span.set("drift", drift)
+            span.end()
+        return drift
+
+    def close(self) -> OnlineResult:
+        """End the stream: final full reconciliation over the assembled
+        archive through the offline batch path (bit-equality is by
+        construction — it IS that path), returning the cleaned archive
+        and the session's accounting."""
+        if self.closed:
+            raise RuntimeError("stream session already closed")
+        if self._n == 0:
+            raise ValueError("cannot close an empty stream")
+        from iterative_cleaner_tpu.backends import clean_archive
+
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start(
+                "close_reconcile", trace_id=self.trace_id,
+                parent_id=self.parent_span_id, subsystem="online",
+                nsub=self._n)
+        self.closed = True
+        ar = self.assembled()
+        result = clean_archive(ar, self.config)
+        final_w = np.asarray(result.final_weights, dtype=np.float64)
+        final_drift = int(np.sum(
+            (final_w == 0) != (self._pweights[:self._n] == 0)))
+        self._pweights[:self._n] = final_w
+        self._pscores[:self._n] = np.asarray(result.scores, np.float64)
+        cleaned = dataclasses.replace(ar, weights=final_w)
+        if span is not None:
+            span.set("final_drift", final_drift)
+            span.end()
+        return OnlineResult(
+            archive=cleaned, result=result, n_subints=self._n,
+            mask_drift=self.mask_drift, final_drift=final_drift,
+            warmup_compiles=self.warmup_compiles,
+            recompiles_steady=self.recompiles_steady,
+            reconciles=self.reconciles,
+            latencies_s=list(self.latencies_s))

@@ -37,8 +37,9 @@ import json
 import os
 import signal
 import sys
+import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from iterative_cleaner_tpu.config import CleanConfig, ServeConfig
 from iterative_cleaner_tpu.serve.request import (
@@ -53,7 +54,25 @@ FORCE_EXIT_CODE = 70  # second signal mid-drain: EX_SOFTWARE-ish, non-zero
 # journal/request fields safe to echo back over GET /requests/<id>
 _STATUS_FIELDS = ("state", "tenant", "priority", "deadline_ts",
                   "submitted_ts", "paths", "error", "n_cleaned",
-                  "n_skipped", "n_failed", "duration_s", "trace_id")
+                  "n_skipped", "n_failed", "duration_s", "trace_id",
+                  "kind", "chunks", "n_ingested", "closed", "n_subints",
+                  "out", "mask_drift", "reconciles", "recompiles_steady",
+                  "subint_p99_ms")
+
+
+@dataclasses.dataclass
+class _StreamState:
+    """One open ``kind: "stream"`` request: its in-memory session plus
+    the chunk/dedup bookkeeping mirrored into the journal.  ``lock``
+    serializes the HTTP intake threads per stream (chunks within one
+    stream are ordered; different streams ingest concurrently)."""
+
+    req: ServeRequest
+    session: object = None          # OnlineSession, built on first chunk
+    chunks: List[str] = dataclasses.field(default_factory=list)
+    keys: set = dataclasses.field(default_factory=set)
+    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+    closed: bool = False
 
 
 def default_out_path(p: str) -> str:
@@ -137,6 +156,9 @@ class ServeDaemon:
         self._signals = 0
         self._started_ts = time.time()
         self._running_id: Optional[str] = None
+        # open online streams by request id (kind: "stream"); entries
+        # leave at finalize (worker pop after close) or terminal failure
+        self._streams: Dict[str, _StreamState] = {}
 
     # ------------------------------------------------------------- intake
     def admit(self, req: ServeRequest, source: str) -> None:
@@ -148,15 +170,23 @@ class ServeDaemon:
         returns) — so the submitter's retry is correct."""
         self._open_root_span(req, source=source)
         try:
-            self.scheduler.submit(req)
+            # a stream is admitted (slot taken, backpressure counted) but
+            # not queued: the worker only runs it once it closes
+            self.scheduler.submit(req, enqueue=(req.kind != "stream"))
         except Rejection:
             self._root_spans.pop(req.request_id, None)  # never admitted
             raise
+        if req.kind == "stream":
+            self._streams[req.request_id] = _StreamState(req=req)
         self.journal.record_request(req.request_id, "accepted",
                                     source=source, **req.journal_fields())
-        self._say("serve: accepted %s (%s, tenant=%s, %d path%s)"
-                  % (req.request_id, source, req.tenant, len(req.paths),
-                     "" if len(req.paths) == 1 else "s"))
+        if req.kind == "stream":
+            self._say("serve: opened stream %s (%s, tenant=%s)"
+                      % (req.request_id, source, req.tenant))
+        else:
+            self._say("serve: accepted %s (%s, tenant=%s, %d path%s)"
+                      % (req.request_id, source, req.tenant, len(req.paths),
+                         "" if len(req.paths) == 1 else "s"))
 
     def recover(self) -> int:
         """Re-enqueue every journaled request whose last state is
@@ -169,6 +199,9 @@ class ServeDaemon:
                 continue
             try:
                 req = ServeRequest.from_journal_entry(rid, view)
+                if req.kind == "stream":
+                    n += self._recover_stream(rid, req, view)
+                    continue
                 self._open_root_span(req, source="recover")
                 self.scheduler.submit(req, already_journaled=True)
             except (RequestError, Rejection) as exc:
@@ -212,6 +245,7 @@ class ServeDaemon:
             "uptime_s": round(time.time() - self._started_ts, 3),
             "queued": self.scheduler.depth(),
             "running": self._running_id,
+            "streams": len(self._streams),
             "accepted": int(counters.get("serve_accepted", 0)),
             "completed": int(counters.get("serve_completed", 0)),
             "failed": int(counters.get("serve_failed", 0)),
@@ -273,6 +307,9 @@ class ServeDaemon:
         from iterative_cleaner_tpu.parallel.fleet import clean_fleet
         from iterative_cleaner_tpu.resilience import ResiliencePlan
 
+        if req.kind == "stream":
+            self._execute_stream(req)
+            return
         self._running_id = req.request_id
         self.journal.record_request(req.request_id, "running")
         mark = self.registry.counters_mark()
@@ -342,6 +379,229 @@ class ServeDaemon:
             self._say("serve: failed %s (%d of %d archives)"
                       % (req.request_id, len(report.failures),
                          len(req.paths)))
+
+    # ------------------------------------------------------------ streams
+    def stream_ingest(self, request_id: str, chunk_path: str,
+                      seq=None) -> dict:
+        """One subint chunk into an open stream (POST /stream/<id>/subint).
+
+        Dedup key = ``seq`` (client sequence number) when given, else the
+        chunk path.  A key already journaled answers ``duplicate: true``
+        WITHOUT re-ingesting — so a client blindly re-POSTing after a
+        daemon restart is idempotent, and the SIGKILL-resume test can
+        assert zero duplicate ingests.  The journal 'running' entry
+        carries the CUMULATIVE chunk list: compaction keeps one merged
+        line per request, so state must never ride deltas."""
+        st = self._streams.get(request_id)
+        if st is None:
+            raise RequestError(
+                f"no open stream {request_id!r} (not opened, already "
+                f"closed, or finished)")
+        with st.lock:
+            if st.closed:
+                raise RequestError(
+                    f"stream {request_id!r} is closed; no further subints")
+            if self.scheduler.draining:
+                raise Rejection("draining",
+                                "daemon is draining; resubmit later")
+            key = str(seq) if seq is not None else str(chunk_path)
+            if key in st.keys:
+                self.registry.counter_inc("online_duplicate_subints")
+                return {"duplicate": True, "id": request_id, "seq": seq,
+                        "n_ingested": len(st.chunks)}
+            n = self._ingest_chunk(st, str(chunk_path))
+            st.chunks.append(str(chunk_path))
+            st.keys.add(key)
+            self.journal.record_request(
+                request_id, "running", chunks=list(st.chunks),
+                keys=sorted(st.keys), n_ingested=len(st.chunks))
+            return {"ingested": True, "id": request_id, "seq": seq,
+                    "n_ingested": len(st.chunks), "n_subints": n}
+
+    def stream_close(self, request_id: str) -> dict:
+        """End an open stream (POST /stream/<id>/close): the request now
+        queues for the worker, whose pop runs the close reconciliation
+        and writes the cleaned archive.  Idempotent — a repeat close
+        answers ``duplicate: true``."""
+        st = self._streams.get(request_id)
+        if st is None:
+            raise RequestError(
+                f"no open stream {request_id!r} (not opened, already "
+                f"closed, or finished)")
+        with st.lock:
+            if st.closed:
+                return {"closed": True, "duplicate": True,
+                        "id": request_id, "n_ingested": len(st.chunks)}
+            if not st.chunks:
+                raise RequestError(
+                    f"stream {request_id!r} has no ingested subints; "
+                    f"POST at least one chunk before closing")
+            st.closed = True
+            self.journal.record_request(
+                request_id, "running", closed=True,
+                chunks=list(st.chunks), keys=sorted(st.keys),
+                n_ingested=len(st.chunks))
+        self.scheduler.enqueue_admitted(st.req)
+        self._say("serve: closed stream %s (%d subints), queued for "
+                  "reconcile" % (request_id, len(st.chunks)))
+        return {"closed": True, "id": request_id,
+                "n_ingested": len(st.chunks)}
+
+    def _ingest_chunk(self, st: _StreamState, chunk_path: str) -> int:
+        """Load one chunk file and feed it to the stream's session
+        (created lazily on the first chunk, with the request's effective
+        config).  IO and geometry errors become RequestError — a bad
+        chunk 400s, it never kills the daemon."""
+        from iterative_cleaner_tpu.online.chunks import StreamMeta, load_chunk
+        from iterative_cleaner_tpu.online.session import OnlineSession
+
+        meta = None
+        if st.session is not None:
+            meta = st.session.meta
+        elif st.req.meta:
+            meta = StreamMeta.from_dict(st.req.meta)
+        try:
+            data, weights, meta = load_chunk(chunk_path, meta)
+        except (OSError, ValueError) as exc:
+            raise RequestError(
+                f"chunk {os.path.basename(chunk_path)!r}: {exc}") from exc
+        if st.session is None:
+            cfg = st.req.effective_config(self.base_config)
+            st.session = OnlineSession(
+                meta, cfg, registry=self.registry, tracer=self.tracer,
+                trace_id=st.req.trace_id,
+                parent_span_id=st.req.root_span_id)
+        return st.session.ingest(
+            data, weights, label=os.path.basename(chunk_path))
+
+    def _stream_out_path(self, req: ServeRequest, st: _StreamState) -> str:
+        """Cleaned-stream output: next to the first chunk, named by the
+        request id (chunk names are per-subint, so the batch naming rule
+        would label the output after one arbitrary subint)."""
+        base = os.path.dirname(os.path.abspath(st.chunks[0]))
+        return os.path.join(base, req.request_id + "_cleaned.npz")
+
+    def _execute_stream(self, req: ServeRequest) -> None:
+        """Finalize a closed stream: close-reconcile the session (the
+        offline batch clean over the full assembled cube — bit-equal with
+        batch by construction) and write the cleaned archive."""
+        from iterative_cleaner_tpu import io as ar_io
+
+        st = self._streams.pop(req.request_id, None)
+        self._running_id = req.request_id
+        self.journal.record_request(req.request_id, "running")
+        t0 = time.perf_counter()
+        span = self.tracer.start(
+            "execute", trace_id=req.trace_id,
+            parent_id=req.root_span_id, subsystem="serve", lane="serve",
+            request_id=req.request_id, tenant=req.tenant, kind="stream")
+        try:
+            if st is None or st.session is None or not st.chunks:
+                raise RequestError(
+                    f"stream {req.request_id!r} reached the worker with "
+                    f"no ingested subints")
+            result = st.session.close()
+            out = self._stream_out_path(req, st)
+            ar_io.save_archive(result.archive, out)
+        except Exception as exc:
+            dt = time.perf_counter() - t0
+            span.event("error", type=type(exc).__name__,
+                       message=str(exc)[:200])
+            span.end(status="error")
+            self.journal.record_request(
+                req.request_id, "failed",
+                error=f"{type(exc).__name__}: {exc}",
+                duration_s=round(dt, 6))
+            self.registry.counter_inc("serve_failed")
+            self._observe_latency(req, dt)
+            self._close_root_span(req, "failed")
+            self._say("serve: failed stream %s: %s" % (req.request_id, exc))
+            return
+        finally:
+            self._running_id = None
+        dt = time.perf_counter() - t0
+        fields = {
+            "n_subints": result.n_subints,
+            "out": out,
+            "mask_drift": int(result.mask_drift + result.final_drift),
+            "reconciles": int(result.reconciles),
+            "recompiles_steady": int(result.recompiles_steady),
+            "subint_p99_ms": round(result.p99_ms(), 3),
+            "duration_s": round(dt, 6),
+        }
+        span.set("n_subints", result.n_subints)
+        span.set("recompiles_steady", int(result.recompiles_steady))
+        span.end(status="ok")
+        self._observe_latency(req, dt)
+        self.journal.record_request(req.request_id, "done", **fields)
+        self.registry.counter_inc("serve_completed")
+        self._close_root_span(req, "ok")
+        self._say("serve: done stream %s (%d subints, %.2fs, p99 %.1fms, "
+                  "%d steady recompiles)"
+                  % (req.request_id, result.n_subints, dt,
+                     fields["subint_p99_ms"], fields["recompiles_steady"]))
+
+    def _recover_stream(self, rid: str, req: ServeRequest,
+                        view: dict) -> int:
+        """Restart path for a journaled open stream: re-admit (no queue),
+        replay its journaled chunk files from disk into a fresh session —
+        counted ``online_replayed_subints``, never as new ingests — and
+        restore the dedup keys so a client's re-POST of an already-
+        journaled subint answers ``duplicate``.  A stream journaled
+        closed re-queues for the worker immediately."""
+        self._open_root_span(req, source="recover")
+        try:
+            self.scheduler.submit(req, already_journaled=True,
+                                  enqueue=False)
+        except Rejection as exc:
+            self._root_spans.pop(rid, None)
+            self.journal.record_request(rid, "failed",
+                                        error=f"unrecoverable: {exc}")
+            self.registry.counter_inc("serve_failed")
+            return 0
+        st = _StreamState(req=req)
+        self._streams[rid] = st
+        chunks = [str(c) for c in (view.get("chunks") or [])]
+        try:
+            for chunk in chunks:
+                self._ingest_chunk(st, chunk)
+                st.chunks.append(chunk)
+        except (RequestError, Rejection) as exc:
+            self._streams.pop(rid, None)
+            self.scheduler.mark_done(req)
+            self._close_root_span(req, "failed")
+            self.journal.record_request(
+                rid, "failed", error=f"unrecoverable stream: {exc}")
+            self.registry.counter_inc("serve_failed")
+            return 0
+        st.keys = set(str(k) for k in (view.get("keys") or [])) \
+            or set(st.chunks)
+        if st.session is not None:
+            self.registry.counter_inc("online_replayed_subints",
+                                      st.session.n_subints)
+        if view.get("closed"):
+            st.closed = True
+            self.scheduler.enqueue_admitted(req)
+        self._say("serve: recovered stream %s (%d chunk%s replayed%s)"
+                  % (rid, len(chunks), "" if len(chunks) == 1 else "s",
+                     ", closed" if st.closed else ""))
+        return 1
+
+    def request_index(self) -> dict:
+        """GET /requests: every journaled request's id/state/kind/tenant
+        (the journal is the source of truth, so the index survives
+        restarts and includes terminal requests)."""
+        states = self.journal.request_states()
+        return {
+            "n": len(states),
+            "requests": [
+                {"id": rid,
+                 "state": view.get("state"),
+                 "kind": view.get("kind") or "clean",
+                 "tenant": view.get("tenant") or "default"}
+                for rid, view in sorted(states.items())
+            ],
+        }
 
     def _observe_latency(self, req: ServeRequest, run_s: float) -> None:
         """The SLO signals: run duration, plus end-to-end (submit →

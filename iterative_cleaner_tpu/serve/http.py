@@ -7,7 +7,14 @@ proxy owns TLS/authn, exactly like node_exporter's model).  Endpoints::
     POST /submit        JSON request body -> 200 {"accepted": true, ...}
                         429 on backpressure (queue full / tenant cap),
                         503 while draining, 400 malformed
+    POST /stream/<id>/subint  {"path": "/data/chunk0.npy", "seq": 0}
+                        -> 200 {"ingested": true} | {"duplicate": true};
+                        404 unknown stream, 400 bad chunk
+    POST /stream/<id>/close   -> 200 {"closed": true}; the stream queues
+                        for close reconciliation + output write
     GET  /healthz       200 {"status": "ok" | "draining", ...counts}
+    GET  /requests      200 {"n": ..., "requests": [{id, state, kind,
+                        tenant}, ...]} — the journaled request index
     GET  /requests/<id> 200 {"state": ...} from the journaled lifecycle
     GET  /metrics       Prometheus text exposition of the LIVE registry
                         (the PR 1 exporter, served instead of
@@ -87,6 +94,8 @@ class _Handler(BaseHTTPRequestHandler):
             text = metrics_to_prometheus(daemon.registry.snapshot())
             self._send(200, text.encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/requests":
+            self._send_json(200, daemon.request_index())
         elif path.startswith("/requests/"):
             rid = path[len("/requests/"):]
             state = daemon.request_state(rid)
@@ -109,6 +118,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802
         daemon = self.server.daemon
         path = self.path.split("?", 1)[0].rstrip("/")
+        if path.startswith("/stream/"):
+            self._post_stream(daemon, path)
+            return
         if path != "/submit":
             self._send_json(404, {"error": f"no route {path!r}"})
             return
@@ -148,6 +160,64 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200, {"accepted": True, "id": req.request_id,
                               "tenant": req.tenant})
+
+    def _post_stream(self, daemon, path: str) -> None:
+        """POST /stream/<id>/subint and /stream/<id>/close — the online
+        ingest surface.  Chunk DATA never crosses HTTP: the body names a
+        file ('path') the daemon reads itself, keeping the intake within
+        MAX_BODY_BYTES and the data path zero-copy on the host."""
+        parts = path.split("/")  # ["", "stream", "<id>", "<verb>"]
+        if len(parts) != 4 or not parts[2] \
+                or parts[3] not in ("subint", "close"):
+            self._send_json(404, {"error": f"no route {path!r}"})
+            return
+        rid, verb = parts[2], parts[3]
+        doc = {}
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            length = -1
+        if length < 0 or length > MAX_BODY_BYTES:
+            self._send_json(400, {"error": "Content-Length required and "
+                                           "<= %d" % MAX_BODY_BYTES})
+            return
+        if length:
+            try:
+                doc = json.loads(self.rfile.read(length).decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as exc:
+                self._send_json(400, {"error": f"body is not JSON: {exc}"})
+                return
+            if not isinstance(doc, dict):
+                self._send_json(400, {"error": "body must be a JSON "
+                                               "object"})
+                return
+        try:
+            if verb == "close":
+                self._send_json(200, daemon.stream_close(rid))
+                return
+            chunk = doc.get("path")
+            if not isinstance(chunk, str) or not chunk:
+                self._send_json(400, {"error": "'path' (chunk file path "
+                                               "string) is required"})
+                return
+            seq = doc.get("seq")
+            if seq is not None:
+                try:
+                    seq = int(seq)
+                except (TypeError, ValueError):
+                    self._send_json(400, {"error": "'seq' must be an "
+                                                   "integer"})
+                    return
+            self._send_json(200, daemon.stream_ingest(rid, chunk, seq=seq))
+        except RequestError as exc:
+            status = 404 if "no open stream" in str(exc) else 400
+            self._send_json(status, {"error": str(exc)})
+        except Rejection as exc:
+            status = _REJECTION_STATUS.get(exc.reason, 429)
+            headers = (("Retry-After", "1"),) if status in (429, 503) else ()
+            self._send_json(status, {"rejected": True, "reason": exc.reason,
+                                     "error": exc.detail},
+                            extra_headers=headers)
 
 
 def make_server(daemon, port: int,

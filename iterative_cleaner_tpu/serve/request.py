@@ -13,6 +13,14 @@ HTTP POST body::
      "trace": "req-7f3a"}             # optional client trace id (minted
                                       # at intake when absent)
 
+A second request kind serves live streams (the online/ subsystem)::
+
+    {"kind": "stream", "id": "obs-42",
+     "meta": {...StreamMeta.to_dict()...}}  # needed for bare .npy chunks
+
+A stream opens with no paths; per-subint chunk files arrive through
+``POST /stream/<id>/subint`` and ``POST /stream/<id>/close`` ends it.
+
 ``overrides`` may only name whitelisted :class:`CleanConfig` fields — the
 mask-relevant per-request knobs.  Output/IO/resilience knobs stay
 daemon-level: a request must not redirect outputs or disable the journal.
@@ -39,7 +47,13 @@ OVERRIDABLE = (
     "chanthresh", "subintthresh", "max_iter", "pulse_region",
     "bad_chan", "bad_subint", "backend", "rotation", "fft_mode",
     "median_impl", "stats_impl", "stats_frame", "baseline_mode",
+    "stream_reconcile_every", "stream_ew_alpha",
 )
+
+# request kinds: a batch "clean" (paths known up front) or an online
+# "stream" (kind: "stream"; subints arrive via POST /stream/<id>/subint
+# and the payload grows until /close)
+KINDS = ("clean", "stream")
 
 
 class RequestError(ValueError):
@@ -53,6 +67,12 @@ class ServeRequest:
 
     request_id: str
     paths: List[str]
+    # "clean" (batch, the default) or "stream" (online/: paths start
+    # empty and chunk files accumulate through the stream endpoints)
+    kind: str = "clean"
+    # stream metadata (online/chunks.py StreamMeta.to_dict()) for bare
+    # .npy chunks; empty for "clean" requests and archive-container chunks
+    meta: Dict[str, object] = dataclasses.field(default_factory=dict)
     tenant: str = "default"
     priority: int = 0
     deadline_ts: Optional[float] = None
@@ -88,6 +108,8 @@ class ServeRequest:
         to re-run this request after a daemon restart."""
         return {
             "paths": list(self.paths),
+            "kind": self.kind,
+            "meta": dict(self.meta),
             "tenant": self.tenant,
             "priority": self.priority,
             "deadline_ts": self.deadline_ts,
@@ -103,16 +125,29 @@ class ServeRequest:
         restart path).  Overrides re-validate: a journal edited into an
         invalid state raises :class:`RequestError` and the daemon fails
         that request instead of crashing."""
+        kind = str(entry.get("kind") or "clean")
+        if kind not in KINDS:
+            raise RequestError(
+                f"journaled request {request_id!r} has unknown kind "
+                f"{kind!r}")
         paths = entry.get("paths")
-        if not isinstance(paths, list) or not paths:
+        if paths is None and kind == "stream":
+            paths = []  # a stream's paths are its journaled chunks
+        if not isinstance(paths, list) or (not paths and kind != "stream"):
             raise RequestError(
                 f"journaled request {request_id!r} carries no paths "
                 f"(compacted away or foreign entry)")
         overrides = entry.get("overrides") or {}
         _check_overrides(overrides)
+        meta = entry.get("meta") or {}
+        if not isinstance(meta, dict):
+            raise RequestError(
+                f"journaled request {request_id!r} has non-object meta")
         return cls(
             request_id=request_id,
             paths=[str(p) for p in paths],
+            kind=kind,
+            meta=meta,
             tenant=str(entry.get("tenant") or "default"),
             priority=int(entry.get("priority") or 0),
             deadline_ts=(float(entry["deadline_ts"])
@@ -166,13 +201,39 @@ def parse_request(payload, *, request_id: Optional[str] = None,
     if not isinstance(payload, dict):
         raise RequestError("request must be a JSON object")
 
+    kind = payload.get("kind", "clean")
+    if kind not in KINDS:
+        raise RequestError(
+            f"'kind' must be one of {', '.join(KINDS)}, got {kind!r}")
+
     paths = payload.get("paths")
     if isinstance(paths, str):
         paths = [paths]
-    if not isinstance(paths, list) or not paths \
+    if kind == "stream":
+        # a stream opens empty: chunk paths arrive via the stream
+        # endpoints, never in the opening submission
+        if paths:
+            raise RequestError(
+                "a stream request opens with no 'paths'; POST chunks to "
+                "/stream/<id>/subint instead")
+        paths = []
+    elif not isinstance(paths, list) or not paths \
             or not all(isinstance(p, str) and p for p in paths):
         raise RequestError("'paths' must be a non-empty list of archive "
                            "path strings")
+
+    meta = payload.get("meta") or {}
+    if not isinstance(meta, dict):
+        raise RequestError("'meta' must be a JSON object")
+    if meta and kind != "stream":
+        raise RequestError("'meta' only applies to stream requests")
+    if meta:
+        from iterative_cleaner_tpu.online.chunks import StreamMeta
+
+        try:
+            StreamMeta.from_dict(meta)  # validate at intake, not mid-ingest
+        except ValueError as exc:
+            raise RequestError(str(exc)) from None
 
     rid = request_id or payload.get("id") or uuid.uuid4().hex[:12]
     rid = str(rid)
@@ -205,12 +266,13 @@ def parse_request(payload, *, request_id: Optional[str] = None,
         raise RequestError("'trace' must be a short alphanumeric trace id")
 
     known = {"paths", "id", "priority", "tenant", "deadline_s", "overrides",
-             "trace"}
+             "trace", "kind", "meta"}
     unknown = sorted(set(payload) - known)
     if unknown:
         raise RequestError(f"unknown request fields: {', '.join(unknown)}")
 
-    req = ServeRequest(request_id=rid, paths=list(paths), tenant=tenant,
+    req = ServeRequest(request_id=rid, paths=list(paths), kind=kind,
+                       meta=dict(meta), tenant=tenant,
                        priority=priority, deadline_ts=deadline_ts,
                        overrides=overrides,
                        trace_id=(str(trace_id) if trace_id

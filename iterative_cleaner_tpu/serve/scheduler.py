@@ -116,10 +116,18 @@ class ServeScheduler:
 
     # ---------------------------------------------------------- admission
     def submit(self, req: ServeRequest,
-               already_journaled: bool = False) -> None:
+               already_journaled: bool = False,
+               enqueue: bool = True) -> None:
         """Admit or raise :class:`Rejection`.  ``already_journaled``
         (restart re-enqueue) bypasses the duplicate check — the id is
-        known precisely because the journal recorded it."""
+        known precisely because the journal recorded it.
+
+        ``enqueue=False`` admits WITHOUT queueing for the worker: the
+        request passes every admission check and takes its tenant
+        in-flight slot, but stays out of the heap.  This is the open
+        phase of a stream request — it must count against backpressure
+        from acceptance (an open stream is real admitted work), yet the
+        single worker only runs it at close (:meth:`enqueue_admitted`)."""
         with self._lock:
             if self._draining:
                 self._count("serve_rejected")
@@ -142,11 +150,24 @@ class ServeScheduler:
                     "tenant_limit",
                     f"tenant {req.tenant!r} at its in-flight cap "
                     f"({self.max_inflight})")
-            self._seq += 1
-            heapq.heappush(self._heap, (request_key(req, self._seq), req))
             self._known_ids.add(req.request_id)
             self._inflight[req.tenant] = inflight + 1
             self._count("serve_accepted")
+            if enqueue:
+                self._seq += 1
+                heapq.heappush(self._heap,
+                               (request_key(req, self._seq), req))
+                self._open_queue_span(req)
+                self._not_empty.notify()
+            self._gauges()
+
+    def enqueue_admitted(self, req: ServeRequest) -> None:
+        """Queue a request previously admitted with ``enqueue=False`` (a
+        stream reaching close).  No admission re-checks and no second
+        accounting: the slot was taken at open."""
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(self._heap, (request_key(req, self._seq), req))
             self._open_queue_span(req)
             self._gauges()
             self._not_empty.notify()

@@ -15,12 +15,16 @@ watches the same spool.  Outcomes are visible in the directory itself::
 
 Producers should write-then-rename into the spool themselves (write
 ``.tmp``, rename to ``.json``) so the watcher never claims a
-half-written file — a file that does not parse is rejected, not
-retried (rejection is visible and debuggable; a silent retry loop on a
-truly malformed file would spin forever).  The ``intake`` fault
-site fires per scanned file: an injected transient skips the file this
-scan (``serve_retries``) and the next scan retries it — intake faults
-never wedge or kill the daemon.
+half-written file.  For producers that don't, the watcher tells the two
+failure shapes apart: a file whose JSON breaks mid-document is truly
+malformed and is rejected (rejection is visible and debuggable; a silent
+retry loop on it would spin forever), while a file that is empty or
+whose JSON simply STOPS — truncated at end-of-buffer, the signature of a
+write still in flight — is unclaimed back to ``.json`` for the next scan
+(``serve_spool_torn``) so a slow writer's request is never lost.  The
+``intake`` fault site fires per scanned file: an injected transient
+skips the file this scan (``serve_retries``) and the next scan retries
+it — intake faults never wedge or kill the daemon.
 """
 
 from __future__ import annotations
@@ -36,6 +40,28 @@ from iterative_cleaner_tpu.serve.request import (
 
 ACCEPTED_SUFFIX = ".accepted"
 REJECTED_SUFFIX = ".rejected"
+
+
+def _json_truncated(raw: bytes) -> bool:
+    """Does ``raw`` look like a JSON document cut off mid-write?  True
+    for empty/whitespace-only content and for JSON whose parse error sits
+    at the end of the buffer (the document just STOPS — ``{"paths": ["/a``)
+    rather than at a syntax error mid-document (``{"paths": [}`` — that
+    file will never become valid, so it must reject, not retry)."""
+    import json
+
+    text = raw.decode("utf-8", errors="replace")
+    if not text.strip():
+        return True
+    try:
+        json.loads(text)
+    except json.JSONDecodeError as exc:
+        if exc.pos >= len(text.rstrip()):
+            return True
+        # an unterminated string always runs to end-of-input: the error
+        # anchors at its opening quote, but the tear is at EOF
+        return exc.msg.startswith("Unterminated string")
+    return False  # valid JSON that failed request validation: malformed
 
 
 class SpoolWatcher:
@@ -100,13 +126,25 @@ class SpoolWatcher:
         stem = os.path.basename(path)[:-len(".json")]
         try:
             with open(claimed, "rb") as f:
-                req = parse_request(f.read(), request_id=stem,
-                                    base_config=self.base_config)
-        except RequestError as exc:
-            self._reject(claimed, f"malformed: {exc}")
-            return 0
+                raw = f.read()
         except OSError as exc:
             self._reject(claimed, f"unreadable: {exc}")
+            return 0
+        try:
+            req = parse_request(raw, request_id=stem,
+                                base_config=self.base_config)
+        except RequestError as exc:
+            if _json_truncated(raw):
+                # torn write: the producer is mid-rename-less write (or
+                # crashed mid-write); unclaim so the next scan retries
+                # once the file is whole — never reject a partial file
+                self._count("serve_spool_torn")
+                try:
+                    os.rename(claimed, path)
+                except OSError:
+                    pass
+                return 0
+            self._reject(claimed, f"malformed: {exc}")
             return 0
         try:
             self.on_request(req, claimed)

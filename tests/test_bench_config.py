@@ -129,6 +129,19 @@ def test_bench_small_end_to_end_json_schema():
     assert out["serve_span_execute_ms"] > 0
     assert out["serve_span_queue_ms"] >= 0
     assert out["serve_span_compile_ms"] >= 0
+    # online row (online/session.py): bounded per-subint latency, the
+    # zero-steady-recompile contract, and close-reconciliation parity
+    # with the batch clean (asserted rc-7-fatal inside the stage)
+    for key in ("online_n", "online_subint_p50_ms", "online_subint_p99_ms",
+                "online_warmup_compiles", "online_recompiles_steady",
+                "online_reconciles", "online_mask_drift",
+                "online_vs_batch_masks"):
+        assert key in out, (key, err)
+    assert out["online_n"] >= 8
+    assert out["online_subint_p99_ms"] > 0
+    assert out["online_recompiles_steady"] == 0
+    assert out["online_warmup_compiles"] >= 1
+    assert out["online_vs_batch_masks"] == "identical"
 
 
 @pytest.mark.slow

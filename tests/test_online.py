@@ -1,0 +1,281 @@
+"""Online mode (iterative_cleaner_tpu/online): chunk protocol, the
+ring-buffered session's parity/latency/recompile contracts, the model
+registry entry, and the --stream CLI driver.
+
+The central promise under test: after close-reconciliation, the online
+path's mask is bit-equal with the offline batch clean of the same
+subints — live-mode triage never changes the archived science product.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.backends import clean_archive
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io import (
+    load_archive,
+    make_synthetic_archive,
+    save_archive,
+)
+from iterative_cleaner_tpu.online import (
+    CLOSE_SENTINEL,
+    OnlineSession,
+    StreamMeta,
+    assemble_archive,
+    is_chunk_name,
+    load_chunk,
+    load_stream_meta,
+    save_stream_meta,
+)
+from tests.conftest import repo_subprocess_env
+
+
+def _jax_cfg(**kw):
+    kw.setdefault("backend", "jax")
+    kw.setdefault("max_iter", 2)
+    return CleanConfig(**kw)
+
+
+def _stream_fixture(nsub=6, nchan=8, nbin=16, seed=21):
+    ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                   seed=seed)
+    cube = np.asarray(ar.total_intensity(), dtype=np.float64)
+    return ar, cube, StreamMeta.from_archive(ar)
+
+
+# --------------------------------------------------------- chunk protocol
+
+def test_stream_meta_round_trip_and_validation(tmp_path):
+    ar, _, meta = _stream_fixture()
+    save_stream_meta(str(tmp_path), meta)
+    back = load_stream_meta(str(tmp_path))
+    assert back == meta
+    assert load_stream_meta(str(tmp_path / "empty")) is None
+    # dict round trip survives JSON (tuples become lists)
+    assert StreamMeta.from_dict(
+        json.loads(json.dumps(meta.to_dict()))) == meta
+    with pytest.raises(ValueError, match="frequencies"):
+        StreamMeta(nchan=4, nbin=8, freqs_mhz=(1.0,), period_s=1.0,
+                   dm=0.0, centre_freq_mhz=1.0)
+    with pytest.raises(ValueError, match="bad stream meta"):
+        StreamMeta.from_dict({"nchan": 4})
+    # a torn header must raise, not silently start a meta-less stream
+    (tmp_path / "torn").mkdir()
+    (tmp_path / "torn" / "stream.json").write_text("")
+    with pytest.raises(ValueError, match="unreadable"):
+        load_stream_meta(str(tmp_path / "torn"))
+
+
+def test_is_chunk_name_filters_protocol_files():
+    assert is_chunk_name("000001.npy")
+    assert is_chunk_name("subint.NPZ")
+    assert is_chunk_name("obs.ar")
+    assert not is_chunk_name("stream.json")       # metadata header
+    assert not is_chunk_name(CLOSE_SENTINEL)      # close sentinel
+    assert not is_chunk_name(".000001.npy")       # in-progress write
+    assert not is_chunk_name("stream_cleaned.npz")  # our own output
+    assert not is_chunk_name("notes.txt")
+
+
+def test_load_chunk_npy_requires_meta_and_checks_geometry(tmp_path):
+    _, cube, meta = _stream_fixture()
+    p = str(tmp_path / "c0.npy")
+    np.save(p, cube[0])
+    with pytest.raises(ValueError, match="needs stream metadata"):
+        load_chunk(p)
+    data, weights, got = load_chunk(p, meta)
+    assert got is meta
+    assert data.shape == (1, meta.nchan, meta.nbin)
+    assert weights.shape == (1, meta.nchan)
+    assert np.all(weights == 1.0)
+    np.testing.assert_array_equal(data[0], cube[0])
+    bad = str(tmp_path / "bad.npy")
+    np.save(bad, cube[0][:, :4])
+    with pytest.raises(ValueError, match="shape"):
+        load_chunk(bad, meta)
+
+
+def test_load_chunk_archive_container_carries_own_meta(tmp_path):
+    ar, cube, meta = _stream_fixture(nsub=2)
+    p = str(tmp_path / "chunk.npz")
+    save_archive(ar, p)
+    data, weights, got = load_chunk(p)
+    assert (got.nchan, got.nbin) == (meta.nchan, meta.nbin)
+    assert data.shape == (2, meta.nchan, meta.nbin)
+    np.testing.assert_array_equal(data, cube)
+    # a geometry mismatch against the stream's meta is refused
+    other = StreamMeta(nchan=4, nbin=8, freqs_mhz=(1.0, 2.0, 3.0, 4.0),
+                       period_s=1.0, dm=0.0, centre_freq_mhz=2.0)
+    with pytest.raises(ValueError, match="does not match the stream"):
+        load_chunk(p, other)
+
+
+def test_assemble_archive_round_trips_cube_and_weights():
+    ar, cube, meta = _stream_fixture()
+    w = np.ones((cube.shape[0], meta.nchan))
+    w[2, 3] = 0.0
+    back = assemble_archive(meta, cube, w)
+    np.testing.assert_array_equal(
+        np.asarray(back.total_intensity(), np.float64), cube)
+    np.testing.assert_array_equal(back.weights, w)
+    assert tuple(back.freqs_mhz) == meta.freqs_mhz
+    assert back.period_s == meta.period_s
+
+
+# ------------------------------------------------------- session contracts
+
+def test_session_close_mask_bit_equal_with_batch():
+    ar, cube, meta = _stream_fixture(nsub=6, seed=33)
+    cfg = _jax_cfg(fleet_bucket_pad=(4, 0), stream_reconcile_every=0)
+    s = OnlineSession(meta, cfg)
+    for i in range(cube.shape[0]):
+        assert s.ingest(cube[i]) == i + 1
+    # capacity quantizes up the bucket grid: 6 subints -> cap 8 (step 4)
+    assert s.capacity == 8 and s.n_subints == 6
+    result = s.close()
+    ref = clean_archive(ar, cfg)
+    np.testing.assert_array_equal(result.archive.weights == 0,
+                                  np.asarray(ref.final_weights) == 0)
+    # one warm-up compile for the fixed-shape step, then never again —
+    # even across the capacity growth at subint 5
+    assert result.warmup_compiles >= 1
+    assert result.recompiles_steady == 0
+    assert result.n_subints == 6
+    assert len(result.latencies_s) == 6
+    assert result.p99_ms() > 0
+    with pytest.raises(RuntimeError, match="closed"):
+        s.ingest(cube[0])
+    with pytest.raises(RuntimeError, match="closed"):
+        s.close()
+
+
+def test_session_reconcile_repairs_drift_and_close_stays_bit_equal():
+    # plant hot RFI so the provisional per-subint zap and the full-archive
+    # consensus genuinely disagree somewhere — the reconcile must repair it
+    ar, cube, meta = _stream_fixture(nsub=8, seed=5)
+    cube = cube.copy()
+    cube[1, 2] += 40.0
+    cube[5, 6] += 25.0
+    ar2 = assemble_archive(meta, cube,
+                           np.ones((cube.shape[0], meta.nchan)))
+    cfg = _jax_cfg(max_iter=3)
+    s = OnlineSession(meta, cfg, reconcile_every=3)
+    for i in range(cube.shape[0]):
+        s.ingest(cube[i])
+    assert s.reconciles == 2           # at subints 3 and 6
+    # after a reconcile the provisional mask agrees with the batch clean
+    # of the prefix — that's what "repaired" means
+    result = s.close()
+    assert result.reconciles == 2
+    assert result.recompiles_steady == 0
+    ref = clean_archive(ar2, cfg)
+    np.testing.assert_array_equal(result.archive.weights == 0,
+                                  np.asarray(ref.final_weights) == 0)
+    # drift accounting is total cells repaired (mid-stream + close)
+    assert result.mask_drift >= 0 and result.final_drift >= 0
+
+
+def test_session_manual_reconcile_matches_batch_prefix():
+    _, cube, meta = _stream_fixture(nsub=5, seed=11)
+    cfg = _jax_cfg()
+    s = OnlineSession(meta, cfg, reconcile_every=0)
+    for i in range(4):
+        s.ingest(cube[i])
+    s.reconcile()
+    ref = clean_archive(s.assembled(), cfg)
+    np.testing.assert_array_equal(s.provisional_weights == 0,
+                                  np.asarray(ref.final_weights) == 0)
+    assert s.reconciles == 1
+
+
+def test_session_rejects_empty_close_and_bad_geometry():
+    _, cube, meta = _stream_fixture()
+    s = OnlineSession(meta, _jax_cfg())
+    with pytest.raises(ValueError, match="empty stream"):
+        s.close()
+    with pytest.raises(ValueError, match="geometry"):
+        s.ingest(cube[0][:, :4])
+    with pytest.raises(ValueError, match="weights"):
+        s.ingest(cube[0], np.ones(3))
+
+
+# --------------------------------------------------------- model registry
+
+def test_registry_lists_online_ewt_next_to_quicklook():
+    from iterative_cleaner_tpu import models
+
+    assert sorted(models.REGISTRY) == [
+        "online_ewt", "quicklook", "surgical_scrub"]
+    assert models.ONLINE_EWT == "online_ewt"
+    assert models.get_model("online_ewt") is models.REGISTRY["online_ewt"]
+    with pytest.raises(ValueError, match="online_ewt"):
+        models.get_model("nope")
+
+
+def test_online_ewt_model_runs_and_matches_session_provisional():
+    from iterative_cleaner_tpu.models import get_model
+
+    ar, cube, meta = _stream_fixture(nsub=5, seed=9)
+    cfg = _jax_cfg(stream_reconcile_every=0)
+    result = get_model("online_ewt")(ar, cfg)
+    assert np.asarray(result.final_weights).shape == (5, meta.nchan)
+    s = OnlineSession(meta, cfg, reconcile_every=0)
+    for i in range(cube.shape[0]):
+        s.ingest(cube[i])
+    np.testing.assert_array_equal(
+        np.asarray(result.final_weights) == 0, s.provisional_weights == 0)
+
+
+# ------------------------------------------------------------- CLI driver
+
+def test_cli_stream_directory_end_to_end(tmp_path):
+    """The --stream DIR driver against a pre-populated directory (chunks +
+    stream.json + close sentinel already present — the tail loop drains
+    them in sorted order, then the sentinel closes): rc 0, a cleaned
+    output next to the chunks, and the mask bit-equal with the batch
+    clean of the same subints."""
+    ar, cube, meta = _stream_fixture(nsub=4, seed=17)
+    d = tmp_path / "live"
+    d.mkdir()
+    save_stream_meta(str(d), meta)
+    for i in range(4):
+        np.save(str(d / ("s%03d.npy" % i)), cube[i])
+    (d / CLOSE_SENTINEL).touch()
+    r = subprocess.run(
+        [sys.executable, "-m", "iterative_cleaner_tpu",
+         "--stream", str(d), "--max_iter", "2",
+         "--stream-reconcile-every", "2", "-l"],
+        env=repo_subprocess_env(ICLEAN_PROBE_TIMEOUT="0",
+                               ICLEAN_STREAM_IDLE_S="60"),
+        cwd="/root/repo", capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:] + r.stdout[-2000:]
+    assert "stream: closed (sentinel) after 4 subints" in r.stdout
+    out = str(d / "stream_cleaned.npz")
+    assert os.path.exists(out)
+    cleaned = load_archive(out)
+    ref = clean_archive(ar, _jax_cfg(max_iter=2))
+    np.testing.assert_array_equal(cleaned.weights == 0,
+                                  np.asarray(ref.final_weights) == 0)
+
+
+def test_cli_stream_rejects_archive_args_and_bad_dir(tmp_path):
+    env = repo_subprocess_env(ICLEAN_PROBE_TIMEOUT="0")
+    r = subprocess.run(
+        [sys.executable, "-m", "iterative_cleaner_tpu",
+         "--stream", str(tmp_path), "some.npz"],
+        env=env, cwd="/root/repo", capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode != 0
+    assert "takes no archive arguments" in r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "iterative_cleaner_tpu",
+         "--stream", str(tmp_path / "missing")],
+        env=env, cwd="/root/repo", capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode != 0
+    assert "does not exist" in r.stderr + r.stdout

@@ -18,6 +18,7 @@ import urllib.request
 
 import pytest
 
+from iterative_cleaner_tpu.backends import clean_archive
 from iterative_cleaner_tpu.config import CleanConfig, ServeConfig
 from iterative_cleaner_tpu.io import (
     load_archive,
@@ -881,3 +882,221 @@ def test_serve_warm_repeat_geometry_zero_new_cache_entries(tmp_path):
     assert sorted(os.listdir(cache)) == entries, \
         "warm repeat-geometry request wrote new compile-cache entries"
     assert _sigterm_and_wait(proc) == 0
+
+
+# --------------------------------------------------------- online streams
+
+def test_spool_torn_json_left_for_retry(tmp_path):
+    """A truncated submission (producer caught mid-write without an
+    atomic rename) must stay ``.json`` for the next scan — NOT be
+    renamed ``.rejected`` — and be accepted once the writer finishes.
+    Genuinely malformed JSON still rejects."""
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    reg = MetricsRegistry()
+    seen = []
+    w = SpoolWatcher(spool, on_request=lambda r, _p: seen.append(r),
+                     registry=reg)
+    for name, half in (("torn", '{"paths": ["/d/a'),
+                       ("empty", ""),
+                       ("open_list", '{"paths": [')):
+        with open(os.path.join(spool, name + ".json"), "w") as f:
+            f.write(half)
+    assert w.scan_once() == 0
+    assert sorted(os.listdir(spool)) == [
+        "empty.json", "open_list.json", "torn.json"]   # all left in place
+    assert reg.counters["serve_spool_torn"] == 3
+    assert "serve_rejected_spool" not in reg.counters
+    # the writer finishes: the same file now parses and is accepted
+    _spool_submit(spool, "torn", {"paths": ["/d/a.npz"]})
+    assert w.scan_once() == 1
+    assert [r.request_id for r in seen] == ["torn"]
+    assert "torn.json.accepted" in os.listdir(spool)
+    # mid-document garbage is malformed, not torn: rejected as before
+    with open(os.path.join(spool, "garbage.json"), "w") as f:
+        f.write("{half a json")
+    w.scan_once()
+    assert "garbage.json.rejected" in os.listdir(spool)
+    assert reg.counters["serve_rejected_spool"] == 1
+
+
+def test_requests_index_endpoint(tmp_path):
+    """GET /requests: every journaled request (terminal ones included)
+    with id/state/kind/tenant, journal-backed so it survives restarts."""
+    from iterative_cleaner_tpu.serve.http import make_server
+
+    d = _daemon(tmp_path, max_inflight=4)
+    server = make_server(d, 0)
+    thr = threading.Thread(target=server.serve_forever,
+                           kwargs={"poll_interval": 0.05}, daemon=True)
+    thr.start()
+    url = "http://127.0.0.1:%d" % server.server_address[1]
+    try:
+        assert _get(url + "/requests") == {"n": 0, "requests": []}
+        _post(url + "/submit", {"paths": ["/d/a.npz"], "id": "r1"})
+        _post(url + "/submit", {"paths": ["/d/b.npz"], "id": "r2",
+                                "tenant": "vlbi"})
+        idx = _get(url + "/requests")
+        assert idx["n"] == 2
+        assert [r["id"] for r in idx["requests"]] == ["r1", "r2"]
+        for row in idx["requests"]:
+            assert row["kind"] == "clean"
+            assert row["state"] in ("accepted", "queued")
+        assert idx["requests"][1]["tenant"] == "vlbi"
+    finally:
+        server.shutdown()
+        server.server_close()
+    # a fresh daemon over the same journal serves the same index
+    d2 = _daemon(tmp_path)
+    idx2 = d2.request_index()
+    assert {r["id"] for r in idx2["requests"]} == {"r1", "r2"}
+
+
+def test_daemon_stream_http_flow_and_parity(tmp_path):
+    """The in-process stream lifecycle: open (kind: "stream"), per-subint
+    POSTs with seq-dedup, close, worker finalization — and the cleaned
+    output's mask bit-equal with the batch clean of the same subints."""
+    from iterative_cleaner_tpu.online import StreamMeta
+
+    ar, _ = make_synthetic_archive(nsub=5, nchan=8, nbin=16, seed=41)
+    cube = ar.total_intensity()
+    chunks = tmp_path / "chunks"
+    chunks.mkdir()
+    paths = []
+    for i in range(5):
+        p = str(chunks / ("c%03d.npy" % i))
+        __import__("numpy").save(p, cube[i])
+        paths.append(p)
+    meta = StreamMeta.from_archive(ar)
+    d = _daemon(tmp_path)
+    t, url = _start(d)
+    try:
+        got = _post(url + "/submit", {"kind": "stream", "id": "obs",
+                                      "meta": meta.to_dict()})
+        assert got["accepted"] is True
+        for i, p in enumerate(paths):
+            got = _post(url + "/stream/obs/subint", {"path": p, "seq": i})
+            assert got["ingested"] is True and got["n_subints"] == i + 1
+        # a blind client retry of a journaled seq must NOT re-ingest
+        got = _post(url + "/stream/obs/subint", {"path": paths[2],
+                                                 "seq": 2})
+        assert got == {"duplicate": True, "id": "obs", "seq": 2,
+                       "n_ingested": 5}
+        idx = _get(url + "/requests")
+        assert {"id": "obs", "state": "running", "kind": "stream",
+                "tenant": "default"} in idx["requests"]
+        # unknown stream ids 404; a chunk the daemon cannot load 400s
+        assert _get(url + "/stream/ghost/close", expect=404)
+        _post(url + "/stream/ghost/close", {}, expect=404)
+        _post(url + "/stream/obs/subint",
+              {"path": str(chunks / "missing.npy"), "seq": 99},
+              expect=400)
+        got = _post(url + "/stream/obs/close", {})
+        assert got["closed"] is True and got["n_ingested"] == 5
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            state = _get(url + "/requests/obs")
+            if state["state"] in ("done", "failed"):
+                break
+            time.sleep(0.05)
+        assert state["state"] == "done", state
+        assert state["n_subints"] == 5
+        assert state["recompiles_steady"] == 0
+        out = state["out"]
+        assert out == str(chunks / "obs_cleaned.npz")
+        # bit-equality with the offline batch path over the same cube
+        cleaned = load_archive(out)
+        ref = clean_archive(ar, NUMPY_BASE)
+        import numpy as np
+
+        np.testing.assert_array_equal(
+            cleaned.weights == 0, np.asarray(ref.final_weights) == 0)
+        h = _get(url + "/healthz")
+        assert h["streams"] == 0      # finalized streams leave the table
+        # further subints answer 404: the stream is finished, not open
+        _post(url + "/stream/obs/subint", {"path": paths[0], "seq": 0},
+              expect=404)
+    finally:
+        d._on_signal(signal.SIGTERM, None)
+        t.join(30)
+    assert not t.is_alive()
+
+
+def test_serve_stream_kill9_resume_zero_duplicate_ingests(tmp_path):
+    """The stream crash contract: SIGKILL a daemon holding an open stream
+    mid-ingest, restart it in the same cwd — the journaled chunks replay
+    from disk (counted as replays, not ingests), a client re-POST of an
+    already-journaled seq answers duplicate, and the resumed stream
+    closes with exactly one ingest per subint, mask bit-equal with
+    batch."""
+    import numpy as np
+
+    from iterative_cleaner_tpu.online import StreamMeta, assemble_archive
+
+    ar, _ = make_synthetic_archive(nsub=6, nchan=16, nbin=32, seed=47)
+    cube = np.asarray(ar.total_intensity(), dtype=np.float64)
+    meta = StreamMeta.from_archive(ar)
+    chunks = tmp_path / "chunks"
+    chunks.mkdir()
+    paths = []
+    for i in range(6):
+        p = str(chunks / ("c%03d.npy" % i))
+        np.save(p, cube[i])
+        paths.append(p)
+    jpath = str(tmp_path / "serve.journal.jsonl")
+
+    proc, out = _start_daemon(tmp_path)
+    port = _daemon_port(proc, out)
+    url = "http://127.0.0.1:%d" % port
+    _post(url + "/submit", {"kind": "stream", "id": "s1",
+                            "meta": meta.to_dict()})
+    for i in range(3):
+        got = _post(url + "/stream/s1/subint",
+                    {"path": paths[i], "seq": i})
+        assert got["ingested"] is True
+    os.kill(proc.pid, signal.SIGKILL)
+    assert proc.wait(timeout=60) == -signal.SIGKILL
+
+    proc2, out2 = _start_daemon(tmp_path)
+    port2 = _daemon_port(proc2, out2)
+    url2 = "http://127.0.0.1:%d" % port2
+    try:
+        assert "serve: recovered stream s1 (3 chunks replayed)" \
+            in open(out2).read()
+        # blind client retries of everything already sent: all duplicates
+        for i in range(3):
+            got = _post(url2 + "/stream/s1/subint",
+                        {"path": paths[i], "seq": i})
+            assert got["duplicate"] is True, got
+        for i in range(3, 6):
+            got = _post(url2 + "/stream/s1/subint",
+                        {"path": paths[i], "seq": i})
+            assert got["ingested"] is True
+            assert got["n_ingested"] == i + 1
+        got = _post(url2 + "/stream/s1/close", {})
+        assert got["closed"] is True and got["n_ingested"] == 6
+        assert _wait_request_done(jpath, "s1", proc2) == "done"
+        from iterative_cleaner_tpu.telemetry import parse_prometheus_text
+
+        text = urllib.request.urlopen(url2 + "/metrics",
+                                      timeout=10).read().decode()
+        parsed = parse_prometheus_text(text)
+        # replays are replays, retries are duplicates, and every subint
+        # was ingested exactly once across both daemon lives
+        assert parsed["icln_online_replayed_subints_total"] == 3.0
+        assert parsed["icln_online_duplicate_subints_total"] == 3.0
+        assert parsed["icln_online_subints_total"] == 6.0
+    finally:
+        if proc2.poll() is None:
+            assert _sigterm_and_wait(proc2) == 0
+    view = FleetJournal(jpath).request_states()["s1"]
+    assert view["state"] == "done"
+    assert view["n_subints"] == 6
+    assert view["recompiles_steady"] == 0
+    cleaned = load_archive(str(chunks / "s1_cleaned.npz"))
+    ref_cfg = CleanConfig(backend="jax", max_iter=3, rotation="roll",
+                          fft_mode="dft")
+    ref = clean_archive(
+        assemble_archive(meta, cube, np.ones((6, 16))), ref_cfg)
+    np.testing.assert_array_equal(
+        cleaned.weights == 0, np.asarray(ref.final_weights) == 0)
