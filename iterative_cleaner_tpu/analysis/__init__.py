@@ -1,0 +1,29 @@
+"""icln-lint: project-invariant static analysis + jaxpr contract checks.
+
+Two halves:
+
+* An AST lint engine (:mod:`.core`) with project-specific rules
+  (:mod:`.rules_io`, :mod:`.rules_jit`, :mod:`.rules_project`) that turn
+  the codebase's conventions — atomic writes through ``io/atomic.py``,
+  flock'd appends through ``utils/logging.py``, donation safety, jit
+  purity, registry-counted exception handling, config-identity
+  exhaustiveness, env/flag/doc drift — into machine-checked invariants.
+* A jaxpr contract verifier (:mod:`.jaxpr_contracts`) that lowers the
+  registered hot programs on the CPU backend and asserts structural
+  contracts (no host callbacks, no float64 promotion, donation aliasing
+  realized, bounded equation count).
+
+Entry points: the ``icln-lint`` console script and
+``python -m iterative_cleaner_tpu --selfcheck`` (:mod:`.cli`).
+"""
+
+from iterative_cleaner_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintReport,
+    Rule,
+    RepoRule,
+    default_rules,
+    lint_paths,
+    lint_source,
+    record_findings,
+)

@@ -1,0 +1,132 @@
+"""`icln-lint` console entry point and the --selfcheck driver.
+
+Exit codes: 0 clean (suppressed findings allowed), 1 unsuppressed
+findings or jaxpr contract violations, 2 usage/internal error — so CI
+can gate on the bare exit status.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from iterative_cleaner_tpu.analysis.core import (
+    LintReport,
+    lint_paths,
+    record_findings,
+    report_json,
+)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="icln-lint",
+        description="Project-invariant static analyzer for "
+                    "iterative_cleaner_tpu (AST rules + jaxpr contract "
+                    "verifier). Zero unsuppressed findings = exit 0.")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to lint (default: the "
+                        "installed iterative_cleaner_tpu package)")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="finding output format (default: text)")
+    p.add_argument("--no-jaxpr", action="store_true",
+                   help="skip the jaxpr contract verifier (AST rules "
+                        "only; the default when explicit paths are "
+                        "given)")
+    p.add_argument("--jaxpr", action="store_true",
+                   help="force the jaxpr contract verifier even with "
+                        "explicit paths")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="include suppressed findings in text output")
+    return p
+
+
+def run_selfcheck(*, paths: Optional[Sequence[str]] = None,
+                  fmt: str = "text", jaxpr: bool = True,
+                  show_suppressed: bool = False,
+                  registry=None, stream=None) -> int:
+    """Lint + (optionally) verify the jaxpr contracts; render a report.
+
+    ``registry`` receives ``lint_findings{rule=...}`` counters when
+    given, so the serve daemon and the --precompile session export
+    analyzer results alongside their run metrics."""
+    out = stream if stream is not None else sys.stdout
+    report = lint_paths(paths)
+    program_reports = []
+    if jaxpr:
+        from iterative_cleaner_tpu.analysis.jaxpr_contracts import (
+            verify_hot_programs,
+        )
+
+        program_reports = verify_hot_programs()
+    violations = [v for r in program_reports for v in r.violations]
+    if registry is not None:
+        record_findings(registry, report)
+        from iterative_cleaner_tpu.telemetry.registry import labeled
+
+        for v in violations:
+            registry.counter_inc(labeled("lint_findings",
+                                         rule="jaxpr-" + v.contract))
+        if jaxpr:
+            registry.gauge_set("jaxpr_contract_violations",
+                               len(violations))
+    ok = report.ok and not violations
+    if fmt == "json":
+        print(report_json(report, {
+            "jaxpr": [r.to_dict() for r in program_reports],
+            "ok": ok,
+        }), file=out)
+    else:
+        text = report.render_text(show_suppressed=show_suppressed)
+        if text:
+            print(text, file=out)
+        for r in program_reports:
+            status = "ok" if r.ok else "FAIL"
+            print(f"jaxpr {r.program}: {status} "
+                  f"({r.eqn_count} eqns, alias {r.alias_bytes} B)",
+                  file=out)
+            for v in r.violations:
+                print("  " + v.render(), file=out)
+    return 0 if ok else 1
+
+
+def record_package_lint(registry, *, quiet: bool = True):
+    """AST-lint the installed package straight into a registry — no jaxpr
+    pass, so it costs ~a second at daemon/precompile startup.  Serve's
+    live ``/metrics`` and the --precompile session's exporters then carry
+    ``lint_findings{rule=...}`` / ``lint_ok`` for the build that is
+    actually running.  Never raises: an analyzer crash must not take the
+    daemon down (it is counted as ``lint_run_errors``)."""
+    try:
+        report = lint_paths()
+        record_findings(registry, report)
+        if not report.ok and not quiet:
+            print("WARNING: icln-lint: %d unsuppressed finding(s) in the "
+                  "running build; run --selfcheck for details"
+                  % len(report.unsuppressed), file=sys.stderr)
+        return report
+    except Exception as exc:  # icln: ignore[broad-except] -- startup analyzer pass is advisory: counted, warned, never fatal to the daemon
+        registry.counter_inc("lint_run_errors")
+        if not quiet:
+            print(f"WARNING: icln-lint startup pass failed: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+        return None
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    jaxpr = not args.no_jaxpr if not args.paths else args.jaxpr
+    if args.jaxpr and args.no_jaxpr:
+        build_arg_parser().error("--jaxpr and --no-jaxpr conflict")
+    try:
+        return run_selfcheck(paths=args.paths or None, fmt=args.format,
+                             jaxpr=jaxpr,
+                             show_suppressed=args.show_suppressed)
+    except (OSError, SyntaxError) as exc:
+        print(f"icln-lint: error: {exc}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,276 @@
+"""Structural contracts on the registered hot jit programs.
+
+The dynamic suites prove the programs *compute* the right masks; these
+checks pin what the programs *are*, so the planned hot-surface rewrites
+(fused Pallas sweep kernel, bf16 mixed precision, operator-graph
+refactor — ROADMAP.md) inherit an executable spec instead of a
+reviewer's memory:
+
+* **no-host-callbacks** — a `pure_callback`/`io_callback`/debug print
+  on the compiled path serialises every dispatch through Python and
+  breaks multi-host SPMD;
+* **no-f64** — a silent float64 promotion doubles HBM traffic and
+  detonates on TPU (which emulates f64 in software);
+* **donation-realized** — `donate_argnums=(0, 1)` is only a request;
+  if a rewrite breaks the aliasing (shape change, copy inserted), the
+  engine silently double-buffers its largest arrays again;
+* **dispatch-bound** — total jaxpr equation count stays under a pinned
+  ceiling per program, so an accidental `while`→unroll or a
+  per-iteration re-trace shows up as a count explosion, not a slow
+  production bench three weeks later.
+
+Everything lowers on the CPU backend (`JAX_PLATFORMS=cpu` in CI): the
+contracts are structural, not numerical, and identical across backends
+except where noted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Tuple
+
+#: primitive-name fragments that mean "the host is on the hot path"
+CALLBACK_TOKENS = ("callback", "outside_call", "infeed", "outfeed",
+                   "debug_print")
+
+#: dtypes banned on the compiled path (no-f64 contract)
+WIDE_DTYPES = ("float64", "complex128")
+
+#: geometry every program is verified at — small enough to trace in
+#: milliseconds, large enough that nothing degenerates to scalars
+NSUB, NCHAN, NBIN, BATCH = 4, 8, 32, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class ContractViolation:
+    program: str
+    contract: str
+    detail: str
+
+    def render(self) -> str:
+        return f"{self.program}: {self.contract}: {self.detail}"
+
+
+@dataclasses.dataclass
+class ProgramReport:
+    program: str
+    eqn_count: int
+    alias_bytes: int
+    violations: List[ContractViolation]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "program": self.program,
+            "eqn_count": self.eqn_count,
+            "alias_bytes": self.alias_bytes,
+            "violations": [dataclasses.asdict(v) for v in self.violations],
+        }
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """Every equation, descending into sub-jaxprs (while/cond/pjit/scan
+    bodies) — the callback and dtype contracts must see the whole
+    program, not the top-level wrapper's single pjit equation."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for value in eqn.params.values():
+            items = value if isinstance(value, (list, tuple)) else [value]
+            for item in items:
+                sub = getattr(item, "jaxpr", None)
+                if sub is not None and hasattr(sub, "eqns"):
+                    yield from iter_eqns(sub)
+                elif hasattr(item, "eqns"):
+                    yield from iter_eqns(item)
+
+
+def check_jaxpr(program: str, closed_jaxpr, *, max_eqns: int,
+                allow_f64: bool = False) -> Tuple[int,
+                                                  List[ContractViolation]]:
+    """Callback / dtype / equation-count contracts on one traced jaxpr."""
+    violations: List[ContractViolation] = []
+    count = 0
+    wide_seen = set()
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        count += 1
+        name = eqn.primitive.name
+        if any(tok in name for tok in CALLBACK_TOKENS):
+            violations.append(ContractViolation(
+                program, "no-host-callbacks",
+                f"primitive {name!r} puts the host on the compiled "
+                "path"))
+        if allow_f64:
+            continue
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            dtype = str(getattr(aval, "dtype", ""))
+            if dtype in WIDE_DTYPES and (name, dtype) not in wide_seen:
+                wide_seen.add((name, dtype))
+                violations.append(ContractViolation(
+                    program, "no-f64",
+                    f"{dtype} value flows through primitive {name!r}: "
+                    "the hot path promised single precision"))
+    if count > max_eqns:
+        violations.append(ContractViolation(
+            program, "dispatch-bound",
+            f"{count} equations exceeds the pinned ceiling {max_eqns}: "
+            "a loop unrolled or a stage re-traced; re-pin deliberately "
+            "if the growth is intended"))
+    return count, violations
+
+
+def check_donation(program: str, lowered, compiled, *,
+                   min_alias_bytes: int) -> Tuple[int,
+                                                  List[ContractViolation]]:
+    """Donation must be *realized*: the compiled artifact actually
+    aliases at least the donated weights' bytes input→output (the cube
+    half is backend-dependent — CPU refuses the cube alias — so the pin
+    is the always-aliasable half)."""
+    alias = 0
+    try:
+        ma = compiled.memory_analysis()
+        alias = int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+    except Exception:  # icln: ignore[broad-except] -- memory_analysis is optional on some backends; fall through to the lowering-text probe
+        alias = 0
+    if alias >= min_alias_bytes:
+        return alias, []
+    # backend lacks memory_analysis (or reports zero): fall back to the
+    # StableHLO donation attribute, which the lowering carries even when
+    # the runtime analysis is unavailable
+    try:
+        text = lowered.as_text()
+    except Exception:  # icln: ignore[broad-except] -- no text form either; report against the analysis numbers
+        text = ""
+    if "tf.aliasing_output" in text or "jax.buffer_donor" in text:
+        return alias, []
+    return alias, [ContractViolation(
+        program, "donation-realized",
+        f"compiled artifact aliases {alias} bytes (< {min_alias_bytes}): "
+        "donate_argnums=(0, 1) no longer takes effect; the engine is "
+        "double-buffering its largest arrays")]
+
+
+def verify_fn(program: str, fn, avals, *, max_eqns: int,
+              min_alias_bytes: int = 0,
+              allow_f64: bool = False) -> ProgramReport:
+    """Trace + lower one jitted callable and run every contract."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*avals)
+    count, violations = check_jaxpr(program, closed, max_eqns=max_eqns,
+                                    allow_f64=allow_f64)
+    alias = 0
+    if min_alias_bytes > 0:
+        lowered = fn.lower(*avals)
+        compiled = lowered.compile()
+        alias, dviol = check_donation(program, lowered, compiled,
+                                      min_alias_bytes=min_alias_bytes)
+        violations.extend(dviol)
+    return ProgramReport(program, count, alias, violations)
+
+
+def _default_config():
+    from iterative_cleaner_tpu.config import CleanConfig
+
+    return CleanConfig()
+
+
+def _clean_fn_program() -> ProgramReport:
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        build_clean_fn,
+        resolve_fft_mode,
+        resolve_median_impl,
+        resolve_stats_frame,
+        resolve_stats_impl,
+    )
+
+    c = _default_config()
+    dtype = jnp.dtype(c.dtype)
+    fft_mode = resolve_fft_mode(c.fft_mode, dtype)
+    fn = build_clean_fn(
+        c.max_iter, c.chanthresh, c.subintthresh, c.pulse_slice,
+        c.pulse_scale, c.pulse_region_active, c.rotation, c.baseline_duty,
+        c.unload_res, fft_mode, resolve_median_impl(c.median_impl, dtype),
+        resolve_stats_impl(c.stats_impl, dtype, NBIN, fft_mode),
+        resolve_stats_frame(c.stats_frame, dtype), False, c.baseline_mode,
+        donate=True)
+    f32 = jnp.float32
+    avals = (jax.ShapeDtypeStruct((NSUB, NCHAN, NBIN), f32),
+             jax.ShapeDtypeStruct((NSUB, NCHAN), f32),
+             jax.ShapeDtypeStruct((NCHAN,), f32),
+             jax.ShapeDtypeStruct((), f32),
+             jax.ShapeDtypeStruct((), f32),
+             jax.ShapeDtypeStruct((), f32))
+    weights_bytes = NSUB * NCHAN * 4
+    return verify_fn("build_clean_fn", fn, avals, max_eqns=4000,
+                     min_alias_bytes=weights_bytes)
+
+
+def _batched_fn_program() -> ProgramReport:
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.parallel.batch import (
+        batch_abstract_inputs,
+        build_batched_clean_fn,
+        resolve_batch_build_args,
+    )
+
+    c = _default_config()
+    build_args, _ = resolve_batch_build_args(c, NBIN, False)
+    fn = build_batched_clean_fn(*build_args, donate=True)
+    avals = batch_abstract_inputs(BATCH, NSUB, NCHAN, NBIN, jnp.float32)
+    weights_bytes = BATCH * NSUB * NCHAN * 4
+    return verify_fn("build_batched_clean_fn", fn, avals, max_eqns=6000,
+                     min_alias_bytes=weights_bytes)
+
+
+def _online_step_program() -> ProgramReport:
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.online.chunks import StreamMeta
+    from iterative_cleaner_tpu.online.session import OnlineSession
+
+    meta = StreamMeta(nchan=NCHAN, nbin=NBIN,
+                      freqs_mhz=tuple(1400.0 + i for i in range(NCHAN)),
+                      period_s=0.5, dm=10.0, centre_freq_mhz=1400.0)
+    session = OnlineSession(meta, _default_config())
+    step = session._build_step()
+    f32 = jnp.float32
+    avals = (jax.ShapeDtypeStruct((1, NCHAN, NBIN), f32),
+             jax.ShapeDtypeStruct((1, NCHAN), f32),
+             jax.ShapeDtypeStruct((NBIN,), f32),
+             jax.ShapeDtypeStruct((), jnp.int32))
+    return verify_fn("online_step", step, avals, max_eqns=2500)
+
+
+#: the registered hot programs — every builder whose output owns a
+#: steady-state dispatch loop must appear here (the shardmap builder is
+#: covered through build_batched_clean_fn, which it jit-wraps 1:1)
+HOT_PROGRAMS = (
+    ("build_clean_fn", _clean_fn_program),
+    ("build_batched_clean_fn", _batched_fn_program),
+    ("online_step", _online_step_program),
+)
+
+
+def verify_hot_programs(names: Optional[List[str]] = None) \
+        -> List[ProgramReport]:
+    reports = []
+    for name, make in HOT_PROGRAMS:
+        if names and name not in names:
+            continue
+        try:
+            reports.append(make())
+        except Exception as exc:
+            reports.append(ProgramReport(name, 0, 0, [ContractViolation(
+                name, "verifier-error",
+                f"{type(exc).__name__}: {exc}")]))
+    return reports

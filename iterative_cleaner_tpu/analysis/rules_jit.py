@@ -1,0 +1,239 @@
+"""jit-surface rules: purity, static-arg hashability, donation safety.
+
+The zero-steady-state-recompile and bit-equal-mask guarantees only hold
+if the traced functions are pure (tracing bakes host state in at compile
+time and silently never re-reads it), the lru-cached builder keys stay
+hashable (an unhashable key raises; a fresh-per-call key recompiles
+every dispatch), and donated buffers are never touched again by the
+caller (XLA reuses the memory; reads return garbage or raise).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from iterative_cleaner_tpu.analysis.core import FileContext, Rule
+
+#: dotted-prefix -> why it is impure inside a traced body
+IMPURE_PREFIXES = (
+    ("time.", "host clock reads trace to a constant"),
+    ("datetime.", "host clock reads trace to a constant"),
+    ("np.random", "host RNG traces to a constant; use jax.random"),
+    ("numpy.random", "host RNG traces to a constant; use jax.random"),
+    ("random.", "host RNG traces to a constant; use jax.random"),
+    ("os.environ", "env reads trace to a constant"),
+    ("os.getenv", "env reads trace to a constant"),
+)
+
+#: call leaves that are host callbacks / side effects in a traced body
+IMPURE_LEAVES = {
+    "print": "print() inside a jitted body becomes a host callback (or "
+             "traces silently); use jax.debug.print only behind a debug "
+             "flag, outside the hot programs",
+    "pure_callback": "host callback on the hot path breaks the "
+                     "no-host-callback contract",
+    "io_callback": "host callback on the hot path breaks the "
+                   "no-host-callback contract",
+    "open": "filesystem I/O inside a traced body",
+}
+
+#: lru_cache'd builders whose arguments form the cache key: every
+#: argument must be hashable or the call raises / recompiles
+CACHED_BUILDERS = frozenset({
+    "build_clean_fn", "build_batched_clean_fn", "build_batch_shardmap_fn",
+})
+
+#: files allowed to introduce donate_argnums sites (each audited here)
+DONATION_FILES = (
+    "backends/jax_backend.py",
+    "parallel/batch.py",
+)
+
+
+def _attr_chain(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _collect_fn_names(node: ast.AST, out: Set[str]) -> None:
+    """Names referenced by a jit(...) argument expression, descending
+    through wrapper calls (vmap(one), shard_map(f, ...), partial(f))."""
+    if isinstance(node, ast.Name):
+        out.add(node.id)
+    elif isinstance(node, ast.Call):
+        for arg in node.args:
+            _collect_fn_names(arg, out)
+    elif isinstance(node, ast.Attribute):
+        # jitting a bound method / module attr: flag by its leaf name
+        out.add(node.attr)
+
+
+def _jitted_names(tree: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain.split(".")[-1] == "jit" and node.args:
+                _collect_fn_names(node.args[0], names)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                chain = _attr_chain(target)
+                if chain.split(".")[-1] == "jit":
+                    names.add(node.name)
+                if chain.endswith("partial") and isinstance(dec, ast.Call):
+                    for arg in dec.args:
+                        if _attr_chain(arg).split(".")[-1] == "jit":
+                            names.add(node.name)
+    return names
+
+
+class JitPurityRule(Rule):
+    """No host state or side effects inside a traced body."""
+
+    id = "jit-purity"
+    severity = "error"
+    description = ("jitted bodies must be pure: no clocks, host RNG, "
+                   "env/file/stdout access, callbacks, or global "
+                   "mutation")
+
+    def check(self, ctx: FileContext) -> Iterable[Tuple[int, str]]:
+        jitted = _jitted_names(ctx.tree)
+        if not jitted:
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name not in jitted:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    yield (node.lineno,
+                           f"global mutation inside jitted {fn.name}(): "
+                           "traced once, never re-run per dispatch")
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _attr_chain(node.func)
+                leaf = chain.split(".")[-1]
+                if leaf in IMPURE_LEAVES and (chain == leaf
+                                              or "." in chain):
+                    yield (node.lineno,
+                           f"{chain}() inside jitted {fn.name}(): "
+                           + IMPURE_LEAVES[leaf])
+                    continue
+                for prefix, why in IMPURE_PREFIXES:
+                    if chain.startswith(prefix) or chain == prefix[:-1]:
+                        yield (node.lineno,
+                               f"{chain}() inside jitted {fn.name}(): "
+                               + why)
+                        break
+
+
+class StaticHashableRule(Rule):
+    """Arguments to the lru-cached builders must be hashable literals."""
+
+    id = "static-hashable"
+    severity = "error"
+    description = ("list/dict/set arguments to an lru_cache'd builder "
+                   "raise TypeError (or defeat the cache): pass tuples "
+                   "or scalars")
+
+    UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                  ast.SetComp)
+
+    def check(self, ctx: FileContext) -> Iterable[Tuple[int, str]]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _attr_chain(node.func).split(".")[-1]
+            if leaf not in CACHED_BUILDERS:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, self.UNHASHABLE):
+                    yield (arg.lineno,
+                           f"unhashable {type(arg).__name__.lower()} "
+                           f"argument to {leaf}(): the lru_cache key "
+                           "raises TypeError; pass a tuple/frozenset")
+
+
+class DonationSafetyRule(Rule):
+    """Donated buffers must not be reused, and new donation sites must
+    be deliberate.
+
+    (a) any ``donate_argnums=`` outside the audited builder files is
+    flagged — donation silently invalidates caller buffers, so each new
+    site needs review (add the file to DONATION_FILES once audited);
+    (b) a call through a builder handle constructed with ``donate=True``
+    must not reuse the Name it passed as cube/weights afterwards — the
+    backing buffer is gone."""
+
+    id = "donation-safety"
+    severity = "error"
+    description = ("donate_argnums sites live in the audited builder "
+                   "files; arrays passed to a donate=True program are "
+                   "dead after the call")
+
+    def check(self, ctx: FileContext) -> Iterable[Tuple[int, str]]:
+        in_builder_file = any(ctx.rel.endswith(s) for s in DONATION_FILES)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not in_builder_file:
+                for kw in node.keywords:
+                    if kw.arg == "donate_argnums":
+                        yield (node.lineno,
+                               "new donate_argnums site outside the "
+                               "audited builder files: donation "
+                               "invalidates caller buffers; build "
+                               "through backends/jax_backend.py or "
+                               "parallel/batch.py (or audit this file "
+                               "into the analyzer's DONATION_FILES)")
+        yield from self._reuse_after_donation(ctx)
+
+    def _reuse_after_donation(self, ctx: FileContext):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            donating: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    leaf = _attr_chain(node.value.func).split(".")[-1]
+                    if leaf in CACHED_BUILDERS and any(
+                            kw.arg == "donate"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value is True
+                            for kw in node.value.keywords):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                donating.add(t.id)
+            if not donating:
+                continue
+            calls = [n for n in ast.walk(fn) if isinstance(n, ast.Call)
+                     and isinstance(n.func, ast.Name)
+                     and n.func.id in donating]
+            loads: Dict[str, List[int]] = {}
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                    loads.setdefault(n.id, []).append(n.lineno)
+            for call in calls:
+                for arg in call.args[:2]:  # donate_argnums=(0, 1)
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    end = getattr(call, "end_lineno", call.lineno)
+                    later = [ln for ln in loads.get(arg.id, ())
+                             if ln > end]
+                    if later:
+                        yield (later[0],
+                               f"{arg.id!r} was donated into "
+                               f"{call.func.id}() on line {call.lineno} "
+                               "and read again here: the buffer is "
+                               "invalidated by donation")

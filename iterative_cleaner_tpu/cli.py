@@ -158,6 +158,22 @@ def build_parser() -> argparse.ArgumentParser:
                              "cache, so later serving runs start warm. "
                              "Honours --batch (group size), --bucket-pad "
                              "and --mesh batch.")
+    parser.add_argument("--selfcheck", action="store_true",
+                        help="Run the icln-lint static analyzer (project "
+                             "invariants: atomic writes, flock "
+                             "discipline, donation safety, jit purity, "
+                             "config identity, env/flag drift) plus the "
+                             "jaxpr contract verifier on the hot "
+                             "programs, then exit: 0 when clean, 1 on "
+                             "any unsuppressed finding. Takes no "
+                             "archives. Same engine as the icln-lint "
+                             "console script.")
+    parser.add_argument("--selfcheck-format", "--format",
+                        choices=("text", "json"), default=None,
+                        dest="selfcheck_format",
+                        help="--selfcheck output format (default text; "
+                             "json prints one machine-readable report "
+                             "document for CI).")
     parser.add_argument("--no-donate", "--no_donate", action="store_true",
                         dest="no_donate",
                         help="Disable buffer donation on the jax hot "
@@ -1001,6 +1017,12 @@ def _run_serve(args, telemetry=None) -> int:
         build_parser().error(f"--serve: {exc}")
     faults = (FaultInjector(args.faults, seed=args.fault_seed)
               if args.faults else FaultInjector.from_env())
+    if telemetry is not None:
+        from iterative_cleaner_tpu.analysis.cli import record_package_lint
+
+        # the daemon's live /metrics carries the analyzer verdict for the
+        # build it is actually running (lint_findings{rule=...}, lint_ok)
+        record_package_lint(telemetry.registry, quiet=args.quiet)
     return run_serve(
         serve_cfg, cfg,
         registry=(telemetry.registry if telemetry is not None else None),
@@ -1190,6 +1212,24 @@ def main(argv=None) -> int:
     else:
         args.stream_dir = raw_stream
         args.stream = 0
+
+    # --selfcheck runs the analyzer and exits: no archives, no device,
+    # no session — it must work on a box with no accelerator at all
+    if args.selfcheck:
+        if (args.archive or args.serve or args.fleet or args.stream_dir
+                or args.precompile or args.stream > 0):
+            build_parser().error(
+                "--selfcheck analyzes the installed package and takes "
+                "no archives or run modes")
+        from iterative_cleaner_tpu.analysis.cli import run_selfcheck
+
+        return run_selfcheck(fmt=args.selfcheck_format or "text")
+    if args.selfcheck_format is not None:
+        # a silently ignored flag would mislead (same contract as
+        # --bucket-pad)
+        build_parser().error(
+            "--format/--selfcheck-format only applies to --selfcheck; "
+            "pass --selfcheck")
 
     # pure-argument validation first: never make a bad invocation wait
     # out the device probe below before erroring
@@ -1437,7 +1477,16 @@ def main(argv=None) -> int:
     apply_platform_override()
     configure_compilation_cache(args.compile_cache)
     if args.precompile:
-        return _run_precompile(args)
+        with run_session(args) as telemetry:
+            from iterative_cleaner_tpu.analysis.cli import (
+                record_package_lint,
+            )
+
+            # the analyzer verdict rides the warmup: a fleet warmed from
+            # a lint-dirty build says so in the exported metrics
+            # (lint_findings{rule=...} via --metrics-json/--prom-textfile)
+            record_package_lint(telemetry.registry, quiet=args.quiet)
+            return _run_precompile(args)
 
     failed = []
     serve_rc = 0

@@ -89,11 +89,12 @@ class StreamMeta:
 def save_stream_meta(directory: str, meta: StreamMeta) -> str:
     """Write the directory-protocol metadata header (atomically: a tailer
     must never read a torn header)."""
+    from iterative_cleaner_tpu.io.atomic import atomic_output
+
     path = os.path.join(directory, STREAM_META_NAME)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(meta.to_dict(), fh)
-    os.replace(tmp, path)
+    with atomic_output(path) as tmp:
+        with open(tmp, "w") as fh:
+            json.dump(meta.to_dict(), fh)
     return path
 
 

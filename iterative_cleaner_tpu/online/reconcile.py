@@ -65,7 +65,7 @@ def reconcile_fn_probe(config, nbin: int, dedispersed: bool):
 def _probe_size(fn) -> int:
     try:
         return int(fn._cache_size())
-    except Exception:
+    except Exception:  # icln: ignore[broad-except] -- probing a private jax API: where it is absent the recompile counters just read 0
         return 0
 
 

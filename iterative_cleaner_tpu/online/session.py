@@ -92,7 +92,7 @@ def _jit_cache_size(fn) -> int:
     where the runtime hides it (counters then just stay at 0)."""
     try:
         return int(fn._cache_size())
-    except Exception:
+    except Exception:  # icln: ignore[broad-except] -- probing a private jax API: where it is absent the recompile counters just read 0
         return 0
 
 

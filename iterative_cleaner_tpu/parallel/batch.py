@@ -51,7 +51,7 @@ def _jit_cache_size(fn) -> Optional[int]:
     cache), or None where the runtime does not expose it."""
     try:
         return int(fn._cache_size())
-    except Exception:
+    except Exception:  # icln: ignore[broad-except] -- probing a private jax API: None tells the caller the probe (not the cache) is missing
         return None
 
 
@@ -337,7 +337,10 @@ def precompile_batched_executable(config: CleanConfig, nsub: int, nchan: int,
             registry.gauge_set("batch_exec_peak_bytes", peak)
             registry.gauge_set("batch_exec_alias_bytes", alias)
         except Exception:
-            pass  # memory analysis is advisory; not every runtime has it
+            # memory analysis is advisory (not every runtime has it), but
+            # its absence should be visible: the bench's HBM columns read
+            # 0 and this counter says why
+            registry.counter_inc("batch_memory_analysis_errors")
     if stats_out is not None:
         stats_out["fresh"] = True
     with _AOT_MEMO_LOCK:

@@ -331,16 +331,14 @@ class BucketPrecompiler:
         if fut.done():
             try:
                 return fut.result(), True, 0.0
-            except Exception:
-                # includes CancelledError: an earlier obtain() cancelled
-                # this bucket and the inline path has been serving it since
+            except Exception:  # icln: ignore[broad-except] -- includes CancelledError (an earlier obtain() cancelled this bucket); the miss is accounted by the caller's precompile hit/miss counters
                 return None, False, 0.0
         if fut.cancel():
             return None, False, 0.0
         t0 = time.perf_counter()
         try:
             exe = fut.result()
-        except Exception:
+        except Exception:  # icln: ignore[broad-except] -- a failed background compile degrades to the inline path, whose own compile will surface the same error loudly
             exe = None
         return exe, False, time.perf_counter() - t0
 
@@ -720,7 +718,7 @@ class _ClaimHeartbeat:
     never stolen from — only a dead one, whose heartbeats stop."""
 
     def __init__(self, journal, work: str, host: int, nonce: str,
-                 ttl_s: float) -> None:
+                 ttl_s: float, registry=None) -> None:
         import threading
 
         self._stop = threading.Event()
@@ -732,8 +730,10 @@ class _ClaimHeartbeat:
                                       ttl_s=ttl_s)
                 except Exception:
                     # a missed heartbeat only risks an early steal, and
-                    # steals are idempotent — never kill the serve thread
-                    pass
+                    # steals are idempotent — never kill the serve
+                    # thread; the counter keeps the misses visible
+                    if registry is not None:
+                        registry.counter_inc("fleet_heartbeat_errors")
 
         self._thread = threading.Thread(target=beat, daemon=True,
                                         name="icln-claim-hb")
@@ -859,7 +859,7 @@ def _serve_multihost(plan, topo, config, mesh, reg, report, fail,
                                   batch_dim=bucket.batch_dim)
                 sub_groups = [(sub, chunk) for chunk in sub.groups()]
                 hb = _ClaimHeartbeat(journal, work, topo.host_id, nonce,
-                                     ttl)
+                                     ttl, registry=reg)
                 try:
                     _serve_groups(sub_groups, config, mesh, reg, report,
                                   fail, precompiler, io_workers, load_fn,

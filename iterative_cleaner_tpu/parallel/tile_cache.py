@@ -91,7 +91,7 @@ def resolve_budget_bytes(config_mb: Optional[float] = None,
         limit = int(stats.get("bytes_limit", 0))
         if limit > 0:
             return int(limit * DEFAULT_BUDGET_FRACTION)
-    except Exception:
+    except Exception:  # icln: ignore[broad-except] -- device budget probe (memory_stats is optional); the conservative fallback below is the accounted outcome
         pass
     return FALLBACK_BUDGET_BYTES
 

@@ -12,9 +12,7 @@ from __future__ import annotations
 
 import json
 import math
-import os
 import re
-import threading
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -62,15 +60,12 @@ def metrics_to_json(snapshot: dict, extra: dict = None) -> str:
 
 
 def write_metrics_json(path: str, snapshot: dict, extra: dict = None) -> None:
-    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
-    try:
+    from iterative_cleaner_tpu.io.atomic import atomic_output
+
+    with atomic_output(path) as tmp:
         with open(tmp, "w") as f:
             f.write(metrics_to_json(snapshot, extra))
             f.write("\n")
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
 
 
 def metrics_to_prometheus(snapshot: dict, prefix: str = "icln") -> str:
@@ -127,14 +122,11 @@ def metrics_to_prometheus(snapshot: dict, prefix: str = "icln") -> str:
 
 def write_prometheus_textfile(path: str, snapshot: dict,
                               prefix: str = "icln") -> None:
-    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
-    try:
+    from iterative_cleaner_tpu.io.atomic import atomic_output
+
+    with atomic_output(path) as tmp:
         with open(tmp, "w") as f:
             f.write(metrics_to_prometheus(snapshot, prefix))
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
 
 
 def parse_prometheus_text(text: str) -> dict:

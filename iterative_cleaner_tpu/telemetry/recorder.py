@@ -101,14 +101,15 @@ class FlightRecorder:
             base, ext = os.path.splitext(target)
             target = "%s.%d%s" % (base, n, ext or "")
         try:
+            from iterative_cleaner_tpu.io.atomic import atomic_output
+
             doc = self.snapshot(reason)
-            tmp = "%s.%d.tmp" % (target, os.getpid())
-            with open(tmp, "w") as f:
-                json.dump(doc, f, sort_keys=True, indent=1)
-                f.write("\n")
-            os.replace(tmp, target)
+            with atomic_output(target) as tmp:
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, sort_keys=True, indent=1)
+                    f.write("\n")
             return target
-        except Exception:
+        except Exception:  # icln: ignore[broad-except] -- the recorder dumps from crash/watchdog paths and must never make a bad situation worse; None tells the caller no file landed
             return None
 
 

@@ -73,7 +73,7 @@ def _trace_annotation(name: str) -> Iterator[None]:
         return
     try:
         ann = jax.profiler.TraceAnnotation(name)
-    except Exception:
+    except Exception:  # icln: ignore[broad-except] -- profiler annotations are cosmetic; timing must proceed unannotated on runtimes without them
         yield
         return
     with ann:

@@ -19,7 +19,7 @@ def _is_checkpoint(path: str) -> bool:
     try:
         with np.load(path, allow_pickle=False) as z:
             return "version" in z.files and "final_weights" in z.files
-    except Exception:
+    except Exception:  # icln: ignore[broad-except] -- file-type sniff: any unreadable/foreign file is by definition not a checkpoint
         return False  # not an npz at all (e.g. .icar) -> archive
 
 

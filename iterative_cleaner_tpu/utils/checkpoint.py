@@ -41,6 +41,28 @@ _IDENTITY_EXCLUDE = {"unload_res", "record_history",
                      # satisfy the original host's journal entries
                      "fleet_hosts", "fleet_host_id", "fleet_claim_ttl_s"}
 
+# The identity half, spelled out: every field here participates in
+# config_identity/config_hash, so adding a CleanConfig field forces an
+# explicit decision (the icln-lint config-identity rule and the assert
+# below both fail until the new name lands in exactly one of the two
+# sets).  Implementation-only knobs (median_impl, compile_cache_dir,
+# donate_buffers, bucket planning) stay in the hash on purpose: the
+# checkpoint also backs bit-parity bookkeeping across kernel routes.
+_IDENTITY_FIELDS = frozenset({
+    "chanthresh", "subintthresh", "max_iter", "pulse_region",
+    "bad_chan", "bad_subint", "backend", "rotation", "fft_mode",
+    "median_impl", "stats_impl", "stats_frame", "baseline_duty",
+    "baseline_mode", "dtype", "stream_hbm_mb", "stream_reconcile_every",
+    "stream_ew_alpha", "fleet_bucket_pad", "fleet_group_size",
+    "compile_cache_dir", "donate_buffers",
+})
+
+assert _IDENTITY_FIELDS.isdisjoint(_IDENTITY_EXCLUDE), \
+    "a CleanConfig field is classified both identity and excluded"
+assert _IDENTITY_FIELDS | _IDENTITY_EXCLUDE == \
+    {f.name for f in dataclasses.fields(CleanConfig)}, \
+    "CleanConfig fields and the identity partition drifted apart"
+
 
 def config_identity(config: CleanConfig) -> str:
     d = dataclasses.asdict(config)

@@ -1,0 +1,670 @@
+"""Tests for the icln-lint static analyzer and jaxpr contract verifier.
+
+Each AST rule gets three fixtures — one violation, one clean, one
+suppressed — driven through :func:`lint_source`.  The repo-wide rules
+(config-identity, env-drift, flag-docs) run against synthetic mini-repos
+in tmp_path.  The repo itself must pass ``--selfcheck`` with zero
+unsuppressed findings; that gate runs the CLI in a fresh subprocess so
+it sees the deployment config (x64 off), not this suite's conftest.
+"""
+
+import io
+import json
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from iterative_cleaner_tpu.analysis import lint_paths, lint_source
+from iterative_cleaner_tpu.analysis.core import (
+    find_repo_root,
+    parse_suppressions,
+    record_findings,
+)
+from iterative_cleaner_tpu.analysis import cli as analysis_cli
+from iterative_cleaner_tpu.analysis.jaxpr_contracts import (
+    check_jaxpr,
+    verify_fn,
+    verify_hot_programs,
+)
+from iterative_cleaner_tpu.telemetry.registry import MetricsRegistry
+
+
+def rule_findings(src, rule_id, rel="snippet.py"):
+    report = lint_source(textwrap.dedent(src), rel=rel)
+    return [f for f in report.findings if f.rule == rule_id]
+
+
+def assert_flagged(src, rule_id, rel="snippet.py"):
+    found = rule_findings(src, rule_id, rel=rel)
+    assert found and not any(f.suppressed for f in found), \
+        f"expected an unsuppressed {rule_id} finding"
+    return found
+
+
+def assert_clean(src, rule_id, rel="snippet.py"):
+    assert rule_findings(src, rule_id, rel=rel) == []
+
+
+def assert_suppressed(src, rule_id, rel="snippet.py"):
+    found = rule_findings(src, rule_id, rel=rel)
+    assert found and all(f.suppressed for f in found), \
+        f"expected a suppressed {rule_id} finding"
+    report = lint_source(textwrap.dedent(src), rel=rel)
+    assert report.ok
+    return found
+
+
+# ---------------------------------------------------------------- engine
+
+def test_parse_suppressions_rules_and_reason():
+    sup = parse_suppressions(
+        "x = 1  # icln: ignore[foo, bar] -- because reasons\n"
+        "y = 2\n"
+        "z = 3  # icln: ignore[baz]\n")
+    assert sup[1][0] == {"foo", "bar"}
+    assert sup[1][1] == "because reasons"
+    assert 2 not in sup
+    assert sup[3][0] == {"baz"}
+
+
+def test_suppression_on_line_above_applies():
+    src = """\
+        import os
+        # icln: ignore[atomic-write] -- rename between existing files
+        os.replace("a", "b")
+        """
+    assert_suppressed(src, "atomic-write")
+
+
+def test_suppression_for_other_rule_does_not_apply():
+    src = """\
+        import os
+        os.replace("a", "b")  # icln: ignore[broad-except]
+        """
+    assert_flagged(src, "atomic-write")
+
+
+def test_syntax_error_fails_report():
+    report = lint_source("def broken(:\n")
+    assert report.parse_errors
+    assert not report.ok
+
+
+def test_report_render_text_summary_line():
+    report = lint_source("import os\nos.replace('a', 'b')\n")
+    text = report.render_text()
+    assert "1 file scanned" in text
+    assert "atomic-write" in text
+
+
+# ----------------------------------------------------------- atomic-write
+
+def test_atomic_write_flags_os_replace():
+    assert_flagged("import os\nos.replace('a', 'b')\n", "atomic-write")
+
+
+def test_atomic_write_flags_write_mode_open():
+    assert_flagged("f = open('out.txt', 'w')\n", "atomic-write")
+
+
+def test_atomic_write_allows_atomic_output_block():
+    src = """\
+        from iterative_cleaner_tpu.io.atomic import atomic_output
+
+        def dump(path, data):
+            with atomic_output(path) as tmp:
+                with open(tmp, "w") as f:
+                    f.write(data)
+        """
+    assert_clean(src, "atomic-write")
+
+
+def test_atomic_write_exempts_impl_file():
+    assert_clean("import os\nos.replace('a', 'b')\n", "atomic-write",
+                 rel="iterative_cleaner_tpu/io/atomic.py")
+
+
+def test_atomic_write_suppressed_with_reason():
+    found = assert_suppressed(
+        "import os\n"
+        "os.replace('a', 'b')  # icln: ignore[atomic-write] -- state rename\n",
+        "atomic-write")
+    assert found[0].reason == "state rename"
+
+
+# ------------------------------------------------------- flock-discipline
+
+def test_flock_flags_fcntl_import():
+    assert_flagged("import fcntl\n", "flock-discipline")
+    assert_flagged("from fcntl import flock\n", "flock-discipline")
+
+
+def test_flock_flags_append_open():
+    assert_flagged("f = open('log.txt', 'a')\n", "flock-discipline")
+
+
+def test_flock_allows_read_open_and_impl_file():
+    assert_clean("f = open('log.txt')\n", "flock-discipline")
+    assert_clean("import fcntl\n", "flock-discipline",
+                 rel="iterative_cleaner_tpu/utils/logging.py")
+
+
+def test_flock_suppressed():
+    assert_suppressed(
+        "import fcntl  # icln: ignore[flock-discipline] -- test harness\n",
+        "flock-discipline")
+
+
+# ------------------------------------------------------------- lock-order
+
+LOCK_NEST = """\
+    import fcntl
+    from iterative_cleaner_tpu.utils.logging import locked_append
+
+    def bad(path, f):
+        fcntl.flock(f, fcntl.LOCK_EX)
+        locked_append(path, "entry")
+    """
+
+
+def test_lock_order_flags_nested_acquisition():
+    assert_flagged(LOCK_NEST, "lock-order")
+
+
+def test_lock_order_flags_locking_rewrite_callback():
+    src = """\
+        from iterative_cleaner_tpu.utils.logging import (
+            compact_under_lock, locked_append)
+
+        def compact(path):
+            def rewrite(lines):
+                locked_append(path, "x")
+                return lines
+            compact_under_lock(path, rewrite)
+        """
+    assert_flagged(src, "lock-order")
+
+
+def test_lock_order_allows_plain_helper_use():
+    src = """\
+        from iterative_cleaner_tpu.utils.logging import locked_append
+
+        def good(path):
+            locked_append(path, "entry")
+        """
+    assert_clean(src, "lock-order")
+
+
+def test_lock_order_suppressed():
+    src = LOCK_NEST.replace(
+        "import fcntl",
+        "import fcntl  # icln: ignore[flock-discipline] -- fixture"
+    ).replace(
+        'locked_append(path, "entry")',
+        'locked_append(path, "entry")  '
+        '# icln: ignore[lock-order] -- different file')
+    assert_suppressed(src, "lock-order")
+
+
+# ------------------------------------------------------------- jit-purity
+
+def test_jit_purity_flags_clock_read():
+    src = """\
+        import time
+        import jax
+
+        def step(x):
+            return x + time.time()
+
+        step_j = jax.jit(step)
+        """
+    assert_flagged(src, "jit-purity")
+
+
+def test_jit_purity_flags_print_and_global():
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            global _count
+            print(x)
+            return x * 2
+        """
+    found = rule_findings(src, "jit-purity")
+    messages = " ".join(f.message for f in found)
+    assert "global" in messages and "print" in messages
+
+
+def test_jit_purity_ignores_pure_and_unjitted_functions():
+    src = """\
+        import time
+        import jax
+
+        def helper(x):
+            return x + time.time()  # not jitted: fine
+
+        def step(x):
+            return x * 2
+
+        step_j = jax.jit(step)
+        """
+    assert_clean(src, "jit-purity")
+
+
+def test_jit_purity_suppressed():
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            print(x)  # icln: ignore[jit-purity] -- debug build only
+            return x
+        """
+    assert_suppressed(src, "jit-purity")
+
+
+# -------------------------------------------------------- static-hashable
+
+def test_static_hashable_flags_list_argument():
+    src = """\
+        from iterative_cleaner_tpu.backends.jax_backend import build_clean_fn
+        fn = build_clean_fn(3, [0.5, 1.0])
+        """
+    assert_flagged(src, "static-hashable")
+
+
+def test_static_hashable_allows_tuple_argument():
+    src = """\
+        from iterative_cleaner_tpu.backends.jax_backend import build_clean_fn
+        fn = build_clean_fn(3, (0.5, 1.0))
+        """
+    assert_clean(src, "static-hashable")
+
+
+def test_static_hashable_suppressed():
+    src = """\
+        from iterative_cleaner_tpu.backends.jax_backend import build_clean_fn
+        fn = build_clean_fn(3, [0.5])  # icln: ignore[static-hashable] -- x
+        """
+    assert_suppressed(src, "static-hashable")
+
+
+# -------------------------------------------------------- donation-safety
+
+def test_donation_flags_new_donate_argnums_site():
+    src = """\
+        import jax
+        fn = jax.jit(lambda x: x, donate_argnums=(0,))
+        """
+    assert_flagged(src, "donation-safety")
+
+
+def test_donation_allows_audited_builder_files():
+    src = """\
+        import jax
+        fn = jax.jit(lambda x: x, donate_argnums=(0,))
+        """
+    assert_clean(src, "donation-safety",
+                 rel="iterative_cleaner_tpu/parallel/batch.py")
+
+
+def test_donation_flags_reuse_after_donating_call():
+    src = """\
+        from iterative_cleaner_tpu.backends.jax_backend import build_clean_fn
+
+        def run(cube, weights):
+            fn = build_clean_fn(1, 2.0, donate=True)
+            out = fn(cube, weights)
+            return out, cube.sum()
+        """
+    found = assert_flagged(src, "donation-safety")
+    assert "donated" in found[0].message
+
+
+def test_donation_allows_donating_call_without_reuse():
+    src = """\
+        from iterative_cleaner_tpu.backends.jax_backend import build_clean_fn
+
+        def run(cube, weights):
+            fn = build_clean_fn(1, 2.0, donate=True)
+            return fn(cube, weights)
+        """
+    assert_clean(src, "donation-safety")
+
+
+def test_donation_suppressed():
+    src = """\
+        import jax
+        fn = jax.jit(lambda x: x, donate_argnums=(0,))  # icln: ignore[donation-safety] -- audited
+        """
+    assert_suppressed(src, "donation-safety")
+
+
+# ----------------------------------------------------------- broad-except
+
+def test_broad_except_flags_silent_swallow():
+    src = """\
+        def f():
+            try:
+                risky()
+            except Exception:
+                pass
+        """
+    assert_flagged(src, "broad-except")
+
+
+def test_broad_except_allows_counted_or_raising_handlers():
+    src = """\
+        def f(registry):
+            try:
+                risky()
+            except Exception:
+                registry.counter_inc("f_errors")
+            try:
+                risky()
+            except Exception:
+                raise
+        """
+    assert_clean(src, "broad-except")
+
+
+def test_broad_except_suppressed_with_reason():
+    src = """\
+        def f():
+            try:
+                risky()
+            except Exception:  # icln: ignore[broad-except] -- crash path must not raise
+                pass
+        """
+    found = assert_suppressed(src, "broad-except")
+    assert found[0].reason == "crash path must not raise"
+
+
+# ------------------------------------------------------- repo-wide rules
+
+CONFIG_SRC = """\
+class CleanConfig:
+    a: int = 1
+    b: float = 2.0
+{extra}
+"""
+
+CHECKPOINT_SRC = """\
+_IDENTITY_FIELDS = frozenset({include})
+_IDENTITY_EXCLUDE = frozenset({exclude})
+"""
+
+
+def make_repo(tmp_path, *, config=None, checkpoint=None, cli_src="",
+              migration="", readme="", extra_module=""):
+    pkg = tmp_path / "iterative_cleaner_tpu"
+    (pkg / "utils").mkdir(parents=True)
+    (pkg / "config.py").write_text(
+        config if config is not None else CONFIG_SRC.format(extra=""))
+    (pkg / "utils" / "checkpoint.py").write_text(
+        checkpoint if checkpoint is not None
+        else CHECKPOINT_SRC.format(include="{'a'}", exclude="{'b'}"))
+    (pkg / "cli.py").write_text(cli_src)
+    if extra_module:
+        (pkg / "extra.py").write_text(extra_module)
+    (tmp_path / "MIGRATION.md").write_text(migration)
+    (tmp_path / "README.md").write_text(readme)
+    return pkg
+
+
+def repo_rule_findings(tmp_path, rule_id, **kwargs):
+    pkg = make_repo(tmp_path, **kwargs)
+    report = lint_paths([str(pkg)], root=str(tmp_path))
+    return [f for f in report.findings if f.rule == rule_id]
+
+
+def test_config_identity_partition_complete(tmp_path):
+    assert repo_rule_findings(tmp_path, "config-identity") == []
+
+
+def test_config_identity_flags_unclassified_field(tmp_path):
+    found = repo_rule_findings(
+        tmp_path, "config-identity",
+        config=CONFIG_SRC.format(extra="    c: str = 'x'"))
+    assert found and "CleanConfig.c" in found[0].message
+
+
+def test_config_identity_flags_stale_entry(tmp_path):
+    found = repo_rule_findings(
+        tmp_path, "config-identity",
+        checkpoint=CHECKPOINT_SRC.format(include="{'a', 'zombie'}",
+                                         exclude="{'b'}"))
+    assert found and "zombie" in found[0].message
+
+
+def test_config_identity_flags_double_classification(tmp_path):
+    found = repo_rule_findings(
+        tmp_path, "config-identity",
+        checkpoint=CHECKPOINT_SRC.format(include="{'a', 'b'}",
+                                         exclude="{'b'}"))
+    assert found and "both" in found[0].message
+
+
+def test_env_drift_flags_undocumented_env(tmp_path):
+    found = repo_rule_findings(
+        tmp_path, "env-drift",
+        extra_module="import os\nv = os.environ.get('ICLEAN_ZAP')\n",
+        migration="nothing here\n")
+    messages = " ".join(f.message for f in found)
+    assert "ICLEAN_ZAP" in messages and "MIGRATION.md" in messages
+
+
+def test_env_drift_satisfied_by_doc_row_and_mirror_flag(tmp_path):
+    assert repo_rule_findings(
+        tmp_path, "env-drift",
+        extra_module="import os\nv = os.environ.get('ICLEAN_ZAP')\n",
+        cli_src="p.add_argument('--zap')\n",
+        migration="| ICLEAN_ZAP | --zap | zaps |\n") == []
+
+
+def test_env_drift_env_only_allowlist_needs_no_mirror(tmp_path):
+    assert repo_rule_findings(
+        tmp_path, "env-drift",
+        extra_module="import os\nv = os.environ.get('ICLEAN_PLATFORM')\n",
+        migration="ICLEAN_PLATFORM pins the backend\n") == []
+
+
+def test_flag_docs_flags_undocumented_flag(tmp_path):
+    found = repo_rule_findings(
+        tmp_path, "flag-docs",
+        cli_src="p.add_argument('--zap')\n",
+        readme="usage\n", migration="notes\n")
+    assert found and "--zap" in found[0].message
+
+
+def test_flag_docs_satisfied_by_readme_mention(tmp_path):
+    assert repo_rule_findings(
+        tmp_path, "flag-docs",
+        cli_src="p.add_argument('--zap')\n",
+        readme="pass `--zap` to zap\n") == []
+
+
+def test_flag_docs_skips_when_docs_absent(tmp_path):
+    assert repo_rule_findings(
+        tmp_path, "flag-docs",
+        cli_src="p.add_argument('--zap')\n") == []
+
+
+# --------------------------------------------------------- metrics wiring
+
+def test_record_findings_exports_labeled_counters():
+    report = lint_source("import os\nos.replace('a', 'b')\n")
+    reg = MetricsRegistry()
+    record_findings(reg, report)
+    snap = reg.snapshot()
+    assert snap["counters"]["lint_findings{rule=atomic-write}"] == 1
+    assert snap["gauges"]["lint_files_scanned"] == 1
+    assert snap["gauges"]["lint_ok"] == 0
+
+
+def test_record_package_lint_populates_registry():
+    reg = MetricsRegistry()
+    report = analysis_cli.record_package_lint(reg)
+    assert report is not None
+    snap = reg.snapshot()
+    assert snap["gauges"]["lint_files_scanned"] > 50
+    assert snap["gauges"]["lint_ok"] == 1
+    assert any(k.startswith("lint_suppressed{rule=")
+               for k in snap["counters"])
+
+
+def test_run_selfcheck_records_findings_and_fails(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nos.replace('a', 'b')\n")
+    reg = MetricsRegistry()
+    out = io.StringIO()
+    rc = analysis_cli.run_selfcheck(paths=[str(bad)], jaxpr=False,
+                                    registry=reg, stream=out)
+    assert rc == 1
+    assert reg.snapshot()["counters"]["lint_findings{rule=atomic-write}"] == 1
+    assert "atomic-write" in out.getvalue()
+
+
+# ------------------------------------------------------------- lint CLI
+
+def test_lint_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nos.replace('a', 'b')\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert analysis_cli.main([str(bad)]) == 1
+    assert analysis_cli.main([str(good)]) == 0
+    assert analysis_cli.main([str(tmp_path / "missing.py")]) == 2
+
+
+def test_lint_cli_json_output(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import fcntl\n")
+    rc = analysis_cli.main([str(bad), "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["ok"] is False
+    assert doc["findings"][0]["rule"] == "flock-discipline"
+
+
+def test_main_cli_selfcheck_rejects_run_arguments(tmp_path):
+    from iterative_cleaner_tpu import cli as main_cli
+    with pytest.raises(SystemExit):
+        main_cli.main(["--selfcheck", str(tmp_path / "obs.npz")])
+    with pytest.raises(SystemExit):
+        main_cli.main(["--selfcheck-format", "json", str(tmp_path / "x.npz"),
+                       "out"])
+
+
+# ---------------------------------------------------- jaxpr contracts
+
+def test_check_jaxpr_catches_host_callback():
+    def impure(x):
+        jax.debug.print("x = {}", x)
+        return x * 2
+
+    closed = jax.make_jaxpr(impure)(jnp.float32(1.0))
+    _, violations = check_jaxpr("t", closed, max_eqns=100)
+    assert any(v.contract == "no-host-callbacks" for v in violations)
+
+
+def test_check_jaxpr_catches_f64_promotion():
+    def widen(x):
+        return x.astype(jnp.float64) + 1.0
+
+    closed = jax.make_jaxpr(widen)(jnp.ones((4,), jnp.float32))
+    _, violations = check_jaxpr("t", closed, max_eqns=100)
+    assert any(v.contract == "no-f64" for v in violations)
+    _, allowed = check_jaxpr("t", closed, max_eqns=100, allow_f64=True)
+    assert allowed == []
+
+
+def test_check_jaxpr_enforces_eqn_ceiling():
+    def chain(x):
+        for _ in range(5):
+            x = x * 2.0 + 1.0
+        return x
+
+    closed = jax.make_jaxpr(chain)(jnp.ones((4,), jnp.float32))
+    count, violations = check_jaxpr("t", closed, max_eqns=1)
+    assert count > 1
+    assert any(v.contract == "dispatch-bound" for v in violations)
+
+
+def test_verify_fn_clean_program_passes():
+    fn = jax.jit(lambda x: x * 2.0)
+    aval = jax.ShapeDtypeStruct((8,), jnp.float32)
+    report = verify_fn("clean", fn, (aval,), max_eqns=50)
+    assert report.ok
+    assert report.eqn_count >= 1
+
+
+def test_verify_fn_catches_injected_impurity():
+    def impure(x):
+        jax.debug.print("x = {}", x)
+        return x + 1.0
+
+    fn = jax.jit(impure)
+    aval = jax.ShapeDtypeStruct((8,), jnp.float32)
+    report = verify_fn("impure", fn, (aval,), max_eqns=50)
+    assert not report.ok
+    assert any(v.contract == "no-host-callbacks" for v in report.violations)
+
+
+def test_verify_fn_donation_realized_and_missing():
+    aval = jax.ShapeDtypeStruct((8,), jnp.float32)
+    donating = jax.jit(lambda x: x + 1.0, donate_argnums=(0,))
+    report = verify_fn("donating", donating, (aval,), max_eqns=50,
+                       min_alias_bytes=32)
+    assert report.ok, [v.render() for v in report.violations]
+
+    plain = jax.jit(lambda x: x + 1.0)
+    report = verify_fn("plain", plain, (aval,), max_eqns=50,
+                       min_alias_bytes=32)
+    assert any(v.contract == "donation-realized"
+               for v in report.violations)
+
+
+def test_verify_hot_programs_unknown_name_errors():
+    reports = verify_hot_programs(["no_such_program"])
+    assert reports == []
+
+
+# ------------------------------------------------------ repo-wide gate
+
+def test_repo_ast_lint_is_clean():
+    report = lint_paths()
+    assert report.unsuppressed == [], \
+        "\n".join(f.render() for f in report.unsuppressed)
+    assert not report.parse_errors
+    assert report.files_scanned > 50
+
+
+def test_selfcheck_cli_repo_wide_gate():
+    """The shipped gate: ``python -m iterative_cleaner_tpu --selfcheck``
+    in a fresh interpreter (deployment config: x64 off) must exit 0 with
+    every jaxpr contract green."""
+    from tests.conftest import repo_subprocess_env
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "iterative_cleaner_tpu", "--selfcheck",
+         "--format", "json"],
+        cwd=find_repo_root(), env=repo_subprocess_env(),
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True
+    assert doc["findings"] == []
+    programs = {r["program"]: r for r in doc["jaxpr"]}
+    assert set(programs) == {"build_clean_fn", "build_batched_clean_fn",
+                             "online_step"}
+    for rep in programs.values():
+        assert rep["violations"] == []
+    # donation is realized on the CPU lowering for both donating builders
+    assert programs["build_clean_fn"]["alias_bytes"] >= 128
+    assert programs["build_batched_clean_fn"]["alias_bytes"] >= 256
