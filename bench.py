@@ -1137,6 +1137,251 @@ def bench_multihost(n_archives, geometries, max_iter=2, claim_ttl=5.0):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_elastic(geometries, max_iter=3, member_ttl=2.0):
+    """Elastic-pool row: two ``--join`` daemons sharing one journal, then
+    ``kill -9`` on the front door mid-burst — the drill ISSUE/ROADMAP call
+    the pool's crash contract, measured instead of merely asserted.
+
+    Sequencing (proven in tests/test_elastic.py's chaos drill): member A
+    is the front door with a ``load:hang@3`` fault, so request "big"
+    (4 archives, 2 geometry buckets) journals its first bucket and
+    wedges while "extra" waits behind it.  Member B joins mid-wedge and
+    adopts "extra" from the shared journal (pool intake is shared even
+    while the acceptor lives); "big" stays with A, whose execution lease
+    is still heartbeating.  SIGKILL A: B observes the lapsed membership
+    lease, evicts A, steals "big"'s claim and finishes it — resuming
+    A's journaled bucket rather than re-cleaning it.
+
+    Reported figures:
+
+    * ``serve_failover_s`` — B's ``icln_serve_last_failover_s`` gauge:
+      time from A's last heartbeat to the steal, the window a request
+      can sit orphaned (bounded by the membership ttl).
+    * ``cache_hit_vs_clean`` — a fresh-geometry request timed cold
+      (real clean, including its compile), then the identical payload
+      resubmitted and answered from the result cache (``n_cached`` == 1,
+      zero device work); the ratio is what the cache buys.
+
+    Fatal contracts (rc 7 via the *_ONLY branch): every accepted request
+    completes, each archive journals 'done' exactly once across both
+    members, and every mask is bit-equal to an in-process
+    ``clean_archive`` over the same inputs.
+    """
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    import jax
+
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io import load_archive, save_archive
+    from iterative_cleaner_tpu.io.synthetic import (
+        bench_rfi_density,
+        make_synthetic_archive,
+    )
+    from iterative_cleaner_tpu.resilience import FleetJournal
+    from iterative_cleaner_tpu.telemetry import parse_prometheus_text
+
+    # [g_a, g_a, g_b, g_b] -> request "big" spans two hash buckets (the
+    # hang@3 fault wedges A BETWEEN them); g_a again for "extra"; g_cold
+    # is a geometry nobody compiled, so the cold timing includes the
+    # compile a real first-encounter clean pays
+    g_a, g_b, g_cold = (tuple(g) for g in geometries[:3])
+    shapes = [g_a, g_a, g_b, g_b, g_a, g_cold]
+    tmp = tempfile.mkdtemp(prefix="bench_elastic_")
+    procs = []
+    try:
+        cfg = CleanConfig(backend="jax", max_iter=max_iter,
+                          rotation="roll", fft_mode="dft")
+        paths, want_masks = [], {}
+        for i, (nsub, nchan, nbin) in enumerate(shapes):
+            ar, _ = make_synthetic_archive(
+                nsub=nsub, nchan=nchan, nbin=nbin,
+                **bench_rfi_density(nsub, nchan), seed=100 + i,
+                dtype=np.float32)
+            p = os.path.join(tmp, "el_%03d.npz" % i)
+            save_archive(ar, p)
+            paths.append(p)
+            want_masks[p] = clean_archive(ar, cfg).final_weights == 0
+
+        jpath = os.path.join(tmp, "pool.journal.jsonl")
+        env = {**os.environ,
+               "ICLEAN_PLATFORM": jax.default_backend(),
+               "ICLEAN_PROBE_TIMEOUT": "0",
+               "PYTHONPATH": os.pathsep.join(
+                   [os.path.dirname(os.path.abspath(__file__))]
+                   + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+               ).rstrip(os.pathsep)}
+
+        def start_member(tag, extra=(), **env_extra):
+            out_path = os.path.join(tmp, "member_%s.out" % tag)
+            outf = open(out_path, "w")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "iterative_cleaner_tpu", "--serve",
+                 "--http-port", "0", "--rotation", "roll",
+                 "--fft_mode", "dft", "--max_iter", str(max_iter),
+                 "--io-workers", "1", "--join",
+                 "--member-ttl", str(member_ttl), "--result-cache",
+                 "--journal", jpath, "--spool", "spool_%s" % tag,
+                 "--flight-recorder", "fr_%s.json" % tag, *extra],
+                env={**env, **env_extra}, cwd=tmp,
+                stdout=outf, stderr=subprocess.STDOUT)
+            procs.append(proc)
+            return proc, out_path
+
+        def member_port(proc, out_path, timeout=120):
+            needle = "serve: http listening on 127.0.0.1:"
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                text = (open(out_path).read()
+                        if os.path.exists(out_path) else "")
+                for line in text.splitlines():
+                    if line.startswith(needle):
+                        return int(line[len(needle):])
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        "member exited before binding (rc %s):\n%s"
+                        % (proc.returncode, text[-2000:]))
+                time.sleep(0.05)
+            raise RuntimeError("member never printed its port")
+
+        def spool_submit(tag, name, payload):
+            spool = os.path.join(tmp, "spool_%s" % tag)
+            os.makedirs(spool, exist_ok=True)
+            tmp_name = os.path.join(spool, ".%s.tmp" % name)
+            with open(tmp_name, "w") as f:
+                f.write(json.dumps(payload))
+            os.replace(tmp_name, os.path.join(spool, name + ".json"))
+
+        def wait_request(rid, proc, timeout_s=300, tick=0.02):
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                if os.path.exists(jpath):
+                    state = FleetJournal(jpath).request_states().get(
+                        rid, {}).get("state")
+                    if state in ("done", "failed"):
+                        return state
+                assert proc.poll() is None, \
+                    f"member exited (rc {proc.returncode}) before {rid}"
+                time.sleep(tick)
+            raise RuntimeError(f"request {rid} never reached terminal")
+
+        def done_paths():
+            if not os.path.exists(jpath):
+                return []
+            out = []
+            for ln in open(jpath).read().splitlines():
+                try:
+                    e = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(e, dict) and e.get("event") == "done":
+                    out.append(e["path"])
+            return out
+
+        # member A (front door): 3rd archive load hangs 600s -> "big"
+        # journals its first bucket (2 archives), then wedges; "extra"
+        # stays journaled 'accepted' behind it
+        proc_a, out_a = start_member(
+            "a", extra=["--faults", "load:hang@3"],
+            ICLEAN_FAULT_HANG_S="600")
+        member_port(proc_a, out_a)
+        spool_submit("a", "big", {"paths": paths[:4]})
+        spool_submit("a", "extra", {"paths": [paths[4]]})
+        deadline = time.time() + 300
+        while len(set(done_paths()) & set(paths[:4])) < 2:
+            assert proc_a.poll() is None, \
+                "front door exited before wedging:\n" \
+                + open(out_a).read()[-2000:]
+            assert time.time() < deadline, \
+                "journal never showed per-archive progress"
+            time.sleep(0.2)
+
+        # member B joins mid-wedge and adopts the queued intake ("extra"
+        # holds no execution lease; "big" does, and A is still live)
+        proc_b, out_b = start_member("b")
+        port_b = member_port(proc_b, out_b)
+        assert wait_request("extra", proc_b) == "done", "adopted failed"
+
+        # kill -9 the front door; the survivor evicts, steals, finishes
+        t_kill = time.perf_counter()
+        os.kill(proc_a.pid, signal.SIGKILL)
+        proc_a.wait(timeout=60)
+        assert wait_request("big", proc_b) == "done", "stolen failed"
+        takeover_s = time.perf_counter() - t_kill
+
+        url_b = "http://127.0.0.1:%d" % port_b
+        parsed = parse_prometheus_text(urllib.request.urlopen(
+            url_b + "/metrics", timeout=10).read().decode())
+        evicted = int(parsed["icln_serve_members_evicted_total"])
+        stolen = int(parsed["icln_serve_requests_stolen_total"])
+        failover_s = float(parsed["icln_serve_last_failover_s"])
+        assert evicted >= 1 and stolen >= 1 and failover_s > 0.0, parsed
+        _log(f"elastic stage: survivor evicted {evicted} member(s), "
+             f"stole {stolen} request(s); failover {failover_s:.2f}s "
+             f"(kill -> big done {takeover_s:.2f}s)")
+
+        # cache hit vs a real clean: a never-seen geometry timed cold
+        # (compile + clean), then the identical payload again -> served
+        # from the result cache with zero device work
+        t0 = time.perf_counter()
+        spool_submit("b", "cold", {"paths": [paths[5]]})
+        assert wait_request("cold", proc_b) == "done", "cold clean failed"
+        clean_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        spool_submit("b", "rerun", {"paths": [paths[5]]})
+        assert wait_request("rerun", proc_b) == "done", "rerun failed"
+        cached_s = time.perf_counter() - t0
+        parsed = parse_prometheus_text(urllib.request.urlopen(
+            url_b + "/metrics", timeout=10).read().decode())
+        cache_hits = int(parsed.get("icln_serve_cache_hits_total", 0))
+        assert cache_hits >= 1, "resubmission drew no cache hit"
+        ratio = clean_s / max(cached_s, 1e-3)
+        _log(f"elastic stage: cold clean {clean_s:.2f}s vs cached "
+             f"{cached_s:.2f}s ({ratio:.1f}x)")
+
+        proc_b.send_signal(signal.SIGTERM)
+        rc = proc_b.wait(timeout=120)
+        assert rc == 0, \
+            f"drain exited {rc}:\n{open(out_b).read()[-2000:]}"
+
+        # exactly-once + parity: one 'done' line per archive across both
+        # members' lifetimes, every mask bit-equal to in-process cleans
+        done = done_paths()
+        assert len(done) == len(paths) and len(set(done)) == len(paths), \
+            f"{len(done)} done lines over {len(set(done))} archives; " \
+            "duplicate or missing cleans"
+        states = FleetJournal(jpath).request_states()
+        assert states["big"]["n_skipped"] == 2, states["big"]
+        assert states["big"]["n_cleaned"] == 2, states["big"]
+        assert states["rerun"].get("n_cached") == 1, states["rerun"]
+        for i, p in enumerate(paths):
+            got = load_archive(p + "_cleaned.npz")
+            assert np.array_equal(want_masks[p], got.weights == 0), \
+                f"elastic mask diverged from in-process clean (archive {i})"
+
+        return {
+            "elastic_members": 2,
+            "elastic_platform": jax.default_backend(),
+            "serve_failover_s": round(failover_s, 2),
+            "members_evicted": evicted,
+            "requests_stolen": stolen,
+            "elastic_takeover_s": round(takeover_s, 2),
+            "cache_hits": cache_hits,
+            "cache_hit_vs_clean": round(ratio, 1),
+            "cache_clean_s": round(clean_s, 2),
+            "cache_served_s": round(cached_s, 2),
+        }
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_online(n_subints, nchan, nbin, reconcile_every=4, bucket_pad=8,
                  max_iter=3):
     """Online-mode row (online/session.py): per-subint zap latency for a
@@ -1286,7 +1531,8 @@ def main():
                            ("BENCH_FLEET_ONLY", bench_fleet),
                            ("BENCH_SERVE_ONLY", bench_serve),
                            ("BENCH_ONLINE_ONLY", bench_online),
-                           ("BENCH_MULTIHOST_ONLY", bench_multihost)):
+                           ("BENCH_MULTIHOST_ONLY", bench_multihost),
+                           ("BENCH_ELASTIC_ONLY", bench_elastic)):
         if os.environ.get(env_key):
             geom = json.loads(os.environ[env_key])
             fallback_to_cpu_if_unreachable(
@@ -1434,6 +1680,24 @@ def main():
             {"n_archives": m_n, "geometries": m_geoms},
             timeout=float(os.environ.get("BENCH_MULTIHOST_TIMEOUT", "900")),
             label="multihost")
+        if row:
+            extras = {**(extras or {}), **row}
+
+    # elastic-pool row (serve/membership.py + serve/result_cache.py):
+    # two --join daemons on one journal; kill -9 the front door mid-burst
+    # and measure the survivor's failover plus the result-cache hit vs a
+    # real clean.  Geometries stay tiny regardless of BENCH_SMALL — the
+    # row measures failover/caching latency, not throughput.
+    # BENCH_SKIP_ELASTIC=1 opts out for the same reason as multihost: the
+    # stage launches daemon subprocesses the tier-1 bench-schema test
+    # cannot afford (tests/test_bench_config.py pins the row's keys in a
+    # dedicated slow test instead).
+    if os.environ.get("BENCH_SKIP_ELASTIC") != "1":
+        row = _bench_row_subprocess(
+            "BENCH_ELASTIC_ONLY",
+            {"geometries": [[6, 16, 32], [8, 16, 32], [10, 16, 32]]},
+            timeout=float(os.environ.get("BENCH_ELASTIC_TIMEOUT", "900")),
+            label="elastic")
         if row:
             extras = {**(extras or {}), **row}
 

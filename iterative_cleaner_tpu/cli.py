@@ -377,6 +377,33 @@ def build_parser() -> argparse.ArgumentParser:
                              "mirrors ICLEAN_MAX_INFLIGHT; the global "
                              "queue bound is ICLEAN_SERVE_QUEUE, default "
                              "64).")
+    parser.add_argument("--join", action="store_true",
+                        help="--serve: join the elastic pool sharing this "
+                             "daemon's --journal — announce membership "
+                             "with journaled heartbeats, adopt accepted "
+                             "requests from any member's front door, and "
+                             "steal a dead member's leased requests after "
+                             "--member-ttl (exactly-once via the shared "
+                             "journal; run every member with the same "
+                             "--journal on common storage). Mirrors "
+                             "ICLEAN_JOIN.")
+    parser.add_argument("--member-ttl", "--member_ttl", type=float,
+                        default=None, dest="member_ttl", metavar="S",
+                        help="--join membership/request lease duration in "
+                             "seconds: members heartbeat at S/3; a "
+                             "SIGKILLed member is evicted and its requests "
+                             "become stealable after at most S (default "
+                             "15; mirrors ICLEAN_MEMBER_TTL).")
+    parser.add_argument("--result-cache", "--result_cache",
+                        action="store_true", dest="result_cache",
+                        help="--serve: content-addressed result cache — "
+                             "index each completed request's outputs in "
+                             "the journal under (input signature x config "
+                             "hash) and answer identical resubmissions "
+                             "from the verified index with zero device "
+                             "work; a stale or corrupted entry falls "
+                             "through to a real clean. Mirrors "
+                             "ICLEAN_RESULT_CACHE.")
     parser.add_argument("--trace-out", "--trace_out", type=str, default="",
                         dest="trace_out", metavar="PATH",
                         help="Export a Chrome/Perfetto trace_events JSON "
@@ -679,7 +706,13 @@ def clean_one(in_path: str, args: argparse.Namespace,
     if not args.no_log:
         from iterative_cleaner_tpu.utils.logging import append_clean_log
 
-        append_clean_log(ar_name, args, result.loops)
+        # the run log lands next to the cleaned output, never in
+        # whatever directory the process happened to be started from —
+        # running the suite (or a clean from the repo root) must not
+        # strew clean.log files around the tree
+        append_clean_log(ar_name, args, result.loops,
+                         log_path=os.path.join(
+                             os.path.dirname(o_name) or ".", "clean.log"))
 
     if telemetry is not None:
         telemetry.record_archive(in_path, result)
@@ -1010,6 +1043,10 @@ def _run_serve(args, telemetry=None) -> int:
             max_inflight=args.max_inflight,
             journal_path=args.journal or None,
             trace_out=args.trace_out or None,
+            # store_true flags: absent means "defer to the env mirror"
+            join=args.join or None,
+            member_ttl_s=args.member_ttl,
+            result_cache=args.result_cache or None,
             # None = not passed (env/default applies); '' disables
             flight_recorder=args.flight_recorder,
         )
@@ -1261,13 +1298,25 @@ def main(argv=None) -> int:
                 "--serve needs at least one intake: --spool DIR and/or "
                 "--http-port PORT (or their ICLEAN_SPOOL/"
                 "ICLEAN_HTTP_PORT mirrors)")
+        if args.join and not args.journal:
+            build_parser().error(
+                "--join needs an explicit --journal PATH on storage "
+                "every pool member shares: the journal IS the pool "
+                "(an implicit per-cwd default would give each member "
+                "a private pool of one)")
+        if args.member_ttl is not None and not args.join \
+                and not os.environ.get("ICLEAN_JOIN"):
+            build_parser().error(
+                "--member-ttl tunes the --join membership lease; "
+                "pass --join")
     elif args.spool or args.http_port is not None \
-            or args.max_inflight is not None:
+            or args.max_inflight is not None or args.join \
+            or args.member_ttl is not None or args.result_cache:
         # intake knobs only exist in the daemon — a silently ignored flag
         # would mislead (same contract as --bucket-pad)
         build_parser().error(
-            "--spool/--http-port/--max-inflight configure the --serve "
-            "daemon; pass --serve")
+            "--spool/--http-port/--max-inflight/--join/--member-ttl/"
+            "--result-cache configure the --serve daemon; pass --serve")
     elif not args.archive and not args.stream_dir:
         build_parser().error(
             "at least one archive path is required (or pass --serve, "

@@ -265,8 +265,10 @@ class ServeConfig:
     checkpoint/journal config identity — a request served under a
     different queue bound still matches its journal entries.  The CLI
     builds one from the ``--spool``/``--http-port``/``--max-inflight``
-    flags; the env mirrors (``ICLEAN_SPOOL``, ``ICLEAN_HTTP_PORT``,
-    ``ICLEAN_MAX_INFLIGHT``, ``ICLEAN_SERVE_QUEUE``) cover container
+    (and elastic ``--join``/``--member-ttl``/``--result-cache``) flags;
+    the env mirrors (``ICLEAN_SPOOL``, ``ICLEAN_HTTP_PORT``,
+    ``ICLEAN_MAX_INFLIGHT``, ``ICLEAN_SERVE_QUEUE``, ``ICLEAN_JOIN``,
+    ``ICLEAN_MEMBER_TTL``, ``ICLEAN_RESULT_CACHE``) cover container
     deployments where flags are awkward (explicit flags win).
     """
 
@@ -300,6 +302,21 @@ class ServeConfig:
     # unhandled daemon exceptions, SIGQUIT and second-signal force-exit);
     # ON by default for a long-lived daemon — "" disables
     flight_recorder: str = "serve.flight.json"
+    # elastic pool membership (``--join`` / ``ICLEAN_JOIN``): announce
+    # this daemon in the shared journal, adopt journaled requests from
+    # other members, evict members whose heartbeat lapses and steal
+    # their claimed requests.  Requires every member to share one
+    # journal_path (and usually one spool) on common storage.
+    join: bool = False
+    # membership + request-claim lease duration: a SIGKILLed member's
+    # requests become stealable this many seconds after its last
+    # heartbeat (``--member-ttl`` / ``ICLEAN_MEMBER_TTL``)
+    member_ttl_s: float = 15.0
+    # content-addressed result cache (``--result-cache`` /
+    # ``ICLEAN_RESULT_CACHE``): serve repeat archive+config submissions
+    # from journaled 'cache' lines with zero device work (entries are
+    # signature-verified before reuse; failures fall through to a clean)
+    result_cache: bool = False
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -308,12 +325,18 @@ class ServeConfig:
             raw = os.environ.get(name, "")
             return cast(raw) if raw else default
 
+        def flag(raw):
+            return str(raw).strip().lower() in ("1", "true", "yes", "on")
+
         fields = {
             "spool_dir": env("ICLEAN_SPOOL", str, None),
             "http_port": env("ICLEAN_HTTP_PORT", int, None),
             "max_inflight": env("ICLEAN_MAX_INFLIGHT", int, 8),
             "queue_limit": env("ICLEAN_SERVE_QUEUE", int, 64),
             "trace_out": env("ICLEAN_TRACE_OUT", str, None),
+            "join": env("ICLEAN_JOIN", flag, False),
+            "member_ttl_s": env("ICLEAN_MEMBER_TTL", float, 15.0),
+            "result_cache": env("ICLEAN_RESULT_CACHE", flag, False),
         }
         # "" is a meaningful override here (recorder OFF), so resolve it
         # outside the none-filtered update below
@@ -349,3 +372,7 @@ class ServeConfig:
                              "crash-safe queue state lives there)")
         if self.journal_max_mb <= 0 or self.log_max_mb <= 0:
             raise ValueError("journal_max_mb/log_max_mb must be > 0")
+        if self.member_ttl_s <= 0:
+            raise ValueError(
+                f"member_ttl_s must be > 0 (the membership lease "
+                f"duration), got {self.member_ttl_s}")

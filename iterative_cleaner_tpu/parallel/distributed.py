@@ -93,6 +93,19 @@ def stable_shard(key: str, n_shards: int) -> int:
     return int.from_bytes(digest, "big") % n
 
 
+def shard_owner(key: str, members) -> Optional[str]:
+    """Deterministic key -> member affinity over a DYNAMIC member set
+    (the elastic pool's analogue of ``bucket_host`` over a fixed host
+    count): every process sorting the same live-member ids picks the
+    same owner, so pool members adopting journaled work agree on who
+    goes first without coordinating — non-owners still take the work
+    when the owner is gone, affinity only orders the race."""
+    members = sorted(str(m) for m in members)
+    if not members:
+        return None
+    return members[stable_shard(key, len(members))]
+
+
 @dataclasses.dataclass(frozen=True)
 class DistributedContext:
     """What this process knows about the job after bootstrap."""

@@ -712,13 +712,16 @@ def _journal_done(done: Dict[str, dict], path: str) -> bool:
     return entry is not None and entry_is_current(entry)
 
 
-class _ClaimHeartbeat:
-    """Background lease refresher for one claimed bucket: appends an
-    'hb' line every ttl/3 until stopped, so a live (even slow) host is
-    never stolen from — only a dead one, whose heartbeats stop."""
+class ClaimHeartbeat:
+    """Background lease refresher for one claimed work item: appends an
+    'hb' line every ttl/3 until stopped, so a live (even slow) owner is
+    never stolen from — only a dead one, whose heartbeats stop.  Used
+    for bucket leases here and for request leases by the elastic serve
+    pool (``counter`` names the per-layer miss counter)."""
 
     def __init__(self, journal, work: str, host: int, nonce: str,
-                 ttl_s: float, registry=None) -> None:
+                 ttl_s: float, registry=None,
+                 counter: str = "fleet_heartbeat_errors") -> None:
         import threading
 
         self._stop = threading.Event()
@@ -733,7 +736,7 @@ class _ClaimHeartbeat:
                     # steals are idempotent — never kill the serve
                     # thread; the counter keeps the misses visible
                     if registry is not None:
-                        registry.counter_inc("fleet_heartbeat_errors")
+                        registry.counter_inc(counter)
 
         self._thread = threading.Thread(target=beat, daemon=True,
                                         name="icln-claim-hb")
@@ -742,6 +745,10 @@ class _ClaimHeartbeat:
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5.0)
+
+
+# historical private name (pre-elastic-pool callers)
+_ClaimHeartbeat = ClaimHeartbeat
 
 
 def _serve_multihost(plan, topo, config, mesh, reg, report, fail,

@@ -40,6 +40,7 @@ from iterative_cleaner_tpu.resilience.faults import (  # noqa: F401
 )
 from iterative_cleaner_tpu.resilience.journal import (  # noqa: F401
     CLAIM_STATES,
+    MEMBER_STATES,
     FleetJournal,
     entry_is_current,
 )

@@ -68,14 +68,46 @@ deltas (``event: "stats"``) so any process — or a post-mortem reader —
 can aggregate whole-slice telemetry from the journal alone, without a
 collective that a dead host would hang.
 
+**Membership events** (the elastic serving pool's roster) reuse the
+claim-lease shape — a member IS a lease on pool membership::
+
+    {"schema": "icln-fleet-journal/1", "event": "member",
+     "member": "<unique member id>", "host": <pid>,
+     "state": "join" | "hb" | "leave", "t": <epoch s>, "ttl": <s>}
+
+'join' and 'hb' both (re)grant the membership lease until ``t + ttl``
+(so a compacted roster — where only a member's LAST line survives —
+folds identically), 'leave' ends it.  Membership is derived by folding
+the journal (:meth:`FleetJournal.member_table`); there is no
+coordinator.  A member whose heartbeat lapses simply expires out of
+the fold — eviction is an observation every surviving member makes
+independently, and the expired member's claimed requests become
+stealable through the ordinary claim-lease rules above.
+
+**Cache events** index completed work content-addressed: the key is
+the journal's existing resume identity, input ``file_signature`` ×
+``config_hash``::
+
+    {"schema": "icln-fleet-journal/1", "event": "cache",
+     "key": "<sig>|<config_hash>", "path": "/abs/in.npz",
+     "sig": "...", "config": "...", "out": "/abs/out.npz",
+     "out_sig": "...", "trace": {...}}   # trace optional
+
+A repeat submission of the same archive + config can short-circuit to
+the recorded output — but only after re-verifying BOTH signatures
+(:func:`entry_is_current`): a rewritten input or a corrupted output
+never serves from cache, it falls through to a real clean.
+
 **Compaction** (:meth:`FleetJournal.compact`): a long-lived daemon's
 journal grows one line per archive forever; compaction atomically
 rewrites it keeping only the live lines — the last 'done' entry per
 archive path, the last 'req' entry per request id (terminal request
 ids keep one line apiece so accepted-entry replay stays impossible),
 every claim line of works whose lease is still granted (the fold needs
-the history; released works drop all their lines) and the last 'stats'
-line per host.  The rewrite runs under the appenders' flock via
+the history; released works drop all their lines), the last 'stats'
+line per host, the last 'member' line of each member whose lease is
+still unexpired (left and evicted members drop entirely) and the last
+'cache' line per key.  The rewrite runs under the appenders' flock via
 :func:`~iterative_cleaner_tpu.utils.logging.compact_under_lock`, so
 compacting under live traffic loses no entries.
 """
@@ -95,6 +127,11 @@ REQUEST_TERMINAL = ("done", "failed")
 
 # claim lease states: grant / extend / end
 CLAIM_STATES = ("claim", "hb", "release")
+
+# membership lease states: announce / extend / depart.  "join" and "hb"
+# fold identically (both re-grant the lease) so a compacted roster —
+# which keeps only each member's last line, often an hb — stays whole.
+MEMBER_STATES = ("join", "hb", "leave")
 
 
 def entry_is_current(entry: dict) -> bool:
@@ -283,7 +320,7 @@ class FleetJournal:
                         or cur["expires"] <= t):
                     own = {"host": int(entry.get("host", -1)),
                            "nonce": str(entry.get("nonce", "")),
-                           "expires": t + ttl}
+                           "expires": t + ttl, "ttl": ttl}
                     # trace context survives the fold so a stealer can
                     # stitch its span under the dead owner's request
                     trace = entry.get("trace")
@@ -293,6 +330,7 @@ class FleetJournal:
             elif state == "hb":
                 if cur is not None and cur["nonce"] == entry.get("nonce"):
                     cur["expires"] = t + ttl
+                    cur["ttl"] = ttl
             elif state == "release":
                 if cur is not None and cur["nonce"] == entry.get("nonce"):
                     del owners[work]
@@ -368,23 +406,132 @@ class FleetJournal:
                     out[host] = counters
         return out
 
+    # ------------------------------------------------- pool membership
+
+    def record_member(self, member: str, state: str, *, host: int,
+                      ttl_s: float, now: Optional[float] = None) -> None:
+        """Append one membership-lease line.  ``member`` uniquely
+        identifies one daemon incarnation (a restarted process must
+        re-join under a fresh id, never inherit its dead predecessor's
+        lease — same rule as claim nonces)."""
+        if state not in MEMBER_STATES:
+            raise ValueError(f"unknown member state {state!r}")
+        self._append({
+            "schema": SCHEMA, "event": "member", "member": str(member),
+            "host": int(host), "state": state,
+            "t": float(time.time() if now is None else now),
+            "ttl": float(ttl_s),
+        })
+
+    @staticmethod
+    def _fold_members(entries) -> Dict[str, dict]:
+        """Fold member lines (file order) into member -> lease.  'join'
+        and 'hb' both (re)grant the lease until ``t + ttl`` — unlike
+        work claims there is nothing to steal, a member only ever
+        extends ITSELF — and 'leave' ends it."""
+        members: Dict[str, dict] = {}
+        for entry in entries:
+            if entry.get("event") != "member" or not entry.get("member"):
+                continue
+            member, state = entry["member"], entry.get("state")
+            t = float(entry.get("t", 0.0))
+            ttl = float(entry.get("ttl", 0.0))
+            if state in ("join", "hb"):
+                members[member] = {"host": int(entry.get("host", -1)),
+                                   "expires": t + ttl}
+            elif state == "leave":
+                members.pop(member, None)
+        return members
+
+    def member_table(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """member-id -> ``{"host", "expires", "live"}`` for every member
+        that joined and did not leave.  ``live`` is False once the
+        membership lease expired — the member is evictable and its
+        claimed requests stealable.  Torn tails and foreign lines are
+        skipped, never fatal."""
+        if now is None:
+            now = time.time()
+        if not os.path.exists(self.path):
+            return {}
+        with open(self.path, "r") as f:
+            members = self._fold_members(_parse_lines(f.read()))
+        for m in members.values():
+            m["live"] = m["expires"] > now
+        return members
+
+    # ------------------------------------------------------ result cache
+
+    @staticmethod
+    def cache_key(sig: str, config_hash: str) -> str:
+        """The content address of one cleaned archive: input signature
+        × config identity — the same pair a resume verifies, so "cache
+        hit" and "resume skip" trust exactly the same evidence."""
+        return f"{sig}|{config_hash}"
+
+    def record_cache(self, in_path: str, *, config_hash: str,
+                     out_path: str,
+                     trace: Optional[dict] = None) -> None:
+        """Append one result-cache index line; signatures are taken now,
+        i.e. after the (atomic) output write landed — like
+        :meth:`record_done`, "a cache entry exists" implies "the output
+        file was complete when indexed"."""
+        from iterative_cleaner_tpu.utils.checkpoint import file_signature
+
+        sig = file_signature(in_path)
+        entry = {
+            "schema": SCHEMA,
+            "event": "cache",
+            "key": self.cache_key(sig, config_hash),
+            "path": os.path.abspath(in_path),
+            "sig": sig,
+            "config": config_hash,
+            "out": os.path.abspath(out_path),
+            "out_sig": file_signature(out_path),
+        }
+        if trace:
+            entry["trace"] = dict(trace)
+        self._append(entry)
+
+    def cache_index(self) -> Dict[str, dict]:
+        """cache key -> last 'cache' entry.  Entries are an INDEX, not
+        proof: a reader must re-verify the recorded signatures
+        (:func:`entry_is_current`) before serving the recorded output."""
+        out: Dict[str, dict] = {}
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path, "r") as f:
+            for entry in _parse_lines(f.read()):
+                if entry.get("event") != "cache" or not entry.get("key"):
+                    continue
+                out[entry["key"]] = entry
+        return out
+
     # ----------------------------------------------------- compaction
 
-    def live_lines(self, text: str) -> List[str]:
+    def live_lines(self, text: str,
+                   now: Optional[float] = None) -> List[str]:
         """The keep-set of a compaction pass over ``text``: the last
         'done' line per archive path, the last 'req' line per request
         id, every claim line of works still under a granted lease (the
         lease fold needs the full history; released works drop all
-        their claim lines) and the last 'stats' line per host, in
+        their claim lines), the last 'stats' line per host, the last
+        'member' line of each member whose lease is unexpired at ``now``
+        (left and lapsed members drop entirely — a compacted roster
+        carries no ghosts) and the last 'cache' line per key, in
         last-seen order.  For a request the kept line is re-serialized
         from the MERGED lifecycle view, so the accepted entry's
         description survives even though only its final state line is
         kept."""
+        if now is None:
+            now = time.time()
         done: Dict[str, str] = {}
         reqs: Dict[str, dict] = {}
         claims: Dict[str, List[str]] = {}
         claim_entries: List[dict] = []
         stats: Dict[str, str] = {}
+        members: Dict[str, str] = {}
+        member_entries: List[dict] = []
+        cache: Dict[str, str] = {}
         order: List[str] = []
 
         def touch(key: str) -> None:
@@ -414,7 +561,16 @@ class FleetJournal:
                 hid = str(entry["host"])
                 stats[hid] = json.dumps(entry, sort_keys=True)
                 touch("stats:" + hid)
+            elif entry.get("event") == "member" and entry.get("member"):
+                mid = entry["member"]
+                members[mid] = json.dumps(entry, sort_keys=True)
+                member_entries.append(entry)
+                touch("member:" + mid)
+            elif entry.get("event") == "cache" and entry.get("key"):
+                cache[entry["key"]] = json.dumps(entry, sort_keys=True)
+                touch("cache:" + entry["key"])
         owned = self._fold_claims(claim_entries)
+        roster = self._fold_members(member_entries)
         lines = []
         for key in order:
             kind, _, ident = key.partition(":")
@@ -425,6 +581,15 @@ class FleetJournal:
             elif kind == "claim":
                 if ident in owned:      # released works drop entirely
                     lines.extend(claims[ident])
+            elif kind == "member":
+                # only unexpired members survive: a leave removed the
+                # member from the fold, a lapsed lease drops here —
+                # eviction IS compaction forgetting you
+                lease = roster.get(ident)
+                if lease is not None and lease["expires"] > now:
+                    lines.append(members[ident])
+            elif kind == "cache":
+                lines.append(cache[ident])
             else:
                 lines.append(stats[ident])
         return lines

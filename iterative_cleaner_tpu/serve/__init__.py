@@ -12,12 +12,19 @@ from iterative_cleaner_tpu.serve.daemon import (  # noqa: F401
     default_out_path,
     run_serve,
 )
+from iterative_cleaner_tpu.serve.membership import (  # noqa: F401
+    PoolMembership,
+)
 from iterative_cleaner_tpu.serve.request import (  # noqa: F401
     OVERRIDABLE,
     RequestError,
     ServeRequest,
     parse_request,
     request_key,
+    request_work_key,
+)
+from iterative_cleaner_tpu.serve.result_cache import (  # noqa: F401
+    ResultCache,
 )
 from iterative_cleaner_tpu.serve.scheduler import (  # noqa: F401
     Rejection,

@@ -28,6 +28,21 @@ Drain (SIGTERM/SIGINT): intake stops (HTTP 503, spool files untouched),
 the in-flight request finishes and journals, queued requests stay
 journaled 'accepted' for the next start, telemetry flushes, exit 0.
 A second signal force-exits non-zero immediately.
+
+**Elastic pool** (``--join``): daemons sharing one journal form a
+coordinator-free pool.  Each member announces itself with journaled
+membership leases (serve/membership.py), adopts journaled 'accepted'
+requests from the shared fold — so ANY member may run the HTTP/spool
+front door, and whichever healthy member pops a request first runs it —
+and leases each request's execution through the journal's claim grammar
+before running it, so two members popping the same request resolve to
+exactly one winner.  A SIGKILLed member stops heartbeating: survivors
+evict it (``serve_members_evicted``), steal its leased requests
+(``serve_requests_stolen``, latency in ``serve_failover_s``) and the
+fleet's per-archive journal entries keep the re-run exactly-once.  With
+``--result-cache`` a completed request also indexes its outputs under
+(input signature × config hash); an identical resubmission is answered
+from the verified index with zero device work (serve/result_cache.py).
 """
 
 from __future__ import annotations
@@ -45,6 +60,7 @@ from iterative_cleaner_tpu.config import CleanConfig, ServeConfig
 from iterative_cleaner_tpu.serve.request import (
     RequestError,
     ServeRequest,
+    request_work_key,
 )
 from iterative_cleaner_tpu.serve.scheduler import Rejection, ServeScheduler
 from iterative_cleaner_tpu.serve.spool import SpoolWatcher
@@ -54,10 +70,10 @@ FORCE_EXIT_CODE = 70  # second signal mid-drain: EX_SOFTWARE-ish, non-zero
 # journal/request fields safe to echo back over GET /requests/<id>
 _STATUS_FIELDS = ("state", "tenant", "priority", "deadline_ts",
                   "submitted_ts", "paths", "error", "n_cleaned",
-                  "n_skipped", "n_failed", "duration_s", "trace_id",
-                  "kind", "chunks", "n_ingested", "closed", "n_subints",
-                  "out", "mask_drift", "reconciles", "recompiles_steady",
-                  "subint_p99_ms")
+                  "n_skipped", "n_failed", "n_cached", "duration_s",
+                  "trace_id", "kind", "chunks", "n_ingested", "closed",
+                  "n_subints", "out", "mask_drift", "reconciles",
+                  "recompiles_steady", "subint_p99_ms", "member")
 
 
 @dataclasses.dataclass
@@ -140,11 +156,28 @@ class ServeDaemon:
                         if self.trace_out else None),
             events=events, recorder=self.recorder)
         self._root_spans: Dict[str, object] = {}
+        # elastic pool membership (--join): None for a standalone daemon
+        self.membership = None
+        if serve_config.join:
+            from iterative_cleaner_tpu.serve.membership import PoolMembership
+
+            self.membership = PoolMembership(
+                self.journal, ttl_s=serve_config.member_ttl_s,
+                registry=self.registry)
+        # content-addressed result cache (--result-cache)
+        self.result_cache = None
+        if serve_config.result_cache:
+            from iterative_cleaner_tpu.serve.result_cache import ResultCache
+
+            self.result_cache = ResultCache(self.journal,
+                                            registry=self.registry)
         self.scheduler = ServeScheduler(
             queue_limit=serve_config.queue_limit,
             max_inflight=serve_config.max_inflight,
             registry=self.registry, faults=self.faults,
-            tracer=self.tracer)
+            tracer=self.tracer,
+            pool_inflight=(self._pool_tenant_inflight
+                           if self.membership is not None else None))
         self.spool = (SpoolWatcher(
             serve_config.spool_dir,
             on_request=lambda req, _path: self.admit(req, source="spool"),
@@ -156,6 +189,16 @@ class ServeDaemon:
         self._signals = 0
         self._started_ts = time.time()
         self._running_id: Optional[str] = None
+        # when this process last derived state from the journal fold —
+        # /healthz reports the age as journal_lag_s (in a pool, the
+        # liveness of the eviction/adoption scanner)
+        self._journal_read_ts: Optional[float] = None
+        self._last_pool_scan = 0.0
+        # adoption/eviction scan cadence: a fraction of the membership
+        # ttl so a lapsed member is noticed well within one lease
+        self._pool_scan_s = min(1.0, serve_config.member_ttl_s / 3.0)
+        # the running request's execution-lease heartbeat (elastic only)
+        self._exec_hb = None
         # open online streams by request id (kind: "stream"); entries
         # leave at finalize (worker pop after close) or terminal failure
         self._streams: Dict[str, _StreamState] = {}
@@ -167,19 +210,35 @@ class ServeDaemon:
         crash after admission but before the journal append loses only a
         request its submitter never saw acknowledged (the HTTP 200 /
         spool ``.accepted`` rename both happen strictly after this
-        returns) — so the submitter's retry is correct."""
+        returns) — so the submitter's retry is correct.
+
+        The worker queue is fed strictly AFTER the 'accepted' line lands:
+        admission takes the slot without enqueueing, the journal append
+        happens, then the request becomes poppable.  A result-cache hit
+        finishes in microseconds, so enqueueing first would let the
+        worker's 'running'/'done' lines race ahead of this thread's
+        'accepted' line — and a journal whose last line says 'accepted'
+        reads as unfinished forever (and adoptable by pool peers)."""
         self._open_root_span(req, source=source)
         try:
             # a stream is admitted (slot taken, backpressure counted) but
-            # not queued: the worker only runs it once it closes
-            self.scheduler.submit(req, enqueue=(req.kind != "stream"))
+            # never queued here: the worker only runs it once it closes
+            self.scheduler.submit(req, enqueue=False)
         except Rejection:
             self._root_spans.pop(req.request_id, None)  # never admitted
             raise
         if req.kind == "stream":
             self._streams[req.request_id] = _StreamState(req=req)
+        extra = {}
+        if self.membership is not None:
+            # which member's front door accepted it — pool members use
+            # this to leave a LIVE acceptor's streams alone
+            extra["member"] = self.membership.member_id
         self.journal.record_request(req.request_id, "accepted",
-                                    source=source, **req.journal_fields())
+                                    source=source, **extra,
+                                    **req.journal_fields())
+        if req.kind != "stream":
+            self.scheduler.enqueue_admitted(req)
         if req.kind == "stream":
             self._say("serve: opened stream %s (%s, tenant=%s)"
                       % (req.request_id, source, req.tenant))
@@ -190,12 +249,28 @@ class ServeDaemon:
 
     def recover(self) -> int:
         """Re-enqueue every journaled request whose last state is
-        non-terminal (the crash-restart path).  Returns how many."""
+        non-terminal (the crash-restart path).  Returns how many.
+
+        In a pool the journal also holds OTHER members' work: requests
+        under a live member's execution lease, and streams whose
+        accepting member is alive, stay theirs (the adoption scan picks
+        them up later if that member lapses); everything else — a dead
+        member's requests included — re-enqueues here exactly like our
+        own."""
         from iterative_cleaner_tpu.resilience.journal import REQUEST_TERMINAL
 
         n = 0
+        roster: Dict[str, dict] = {}
+        claims: Dict[str, dict] = {}
+        if self.membership is not None:
+            now = time.time()
+            roster = self.membership.members(now=now)
+            claims = self.journal.claim_table(now=now)
+            self._journal_read_ts = now
         for rid, view in sorted(self.journal.request_states().items()):
             if view.get("state") in REQUEST_TERMINAL:
+                continue
+            if self._owned_elsewhere(rid, view, roster, claims):
                 continue
             try:
                 req = ServeRequest.from_journal_entry(rid, view)
@@ -219,6 +294,170 @@ class ServeDaemon:
                       % (n, "" if n == 1 else "s"))
         return n
 
+    # ------------------------------------------------------ elastic pool
+    def _owned_elsewhere(self, rid: str, view: dict, roster: dict,
+                         claims: dict) -> bool:
+        """Is this journaled request another LIVE member's to run?
+
+        A live execution lease held by a foreign nonce always wins.  A
+        stream additionally belongs to its accepting member while that
+        member lives (its session is in-memory there; chunks keep
+        POSTing to its front door) — but a dead acceptor's stream is
+        adoptable, replayed from its journaled chunk files."""
+        if self.membership is None:
+            return False
+        owner = claims.get(request_work_key(rid))
+        if (owner is not None and owner.get("live")
+                and owner.get("nonce") != self.membership.member_id):
+            return True
+        if (view.get("kind") or "clean") == "stream":
+            member = view.get("member")
+            if member and member != self.membership.member_id:
+                lease = roster.get(member)
+                if lease is not None and lease.get("live"):
+                    return True
+        return False
+
+    def _pool_tenant_inflight(self, tenant: str) -> int:
+        """The scheduler's pool-wide fair-share view: how many of this
+        tenant's requests are journaled non-terminal anywhere in the
+        pool (every member's front door folds the same journal)."""
+        from iterative_cleaner_tpu.resilience.journal import REQUEST_TERMINAL
+
+        states = self.journal.request_states()
+        self._journal_read_ts = time.time()
+        return sum(1 for view in states.values()
+                   if view.get("state") not in REQUEST_TERMINAL
+                   and str(view.get("tenant") or "default") == str(tenant))
+
+    def _elastic_tick(self) -> None:
+        """One pool-maintenance pass from the daemon loop: heartbeat our
+        membership lease (self-throttled), then — on the scan cadence —
+        observe evictions and adopt adoptable journaled requests."""
+        if self.membership is None:
+            return
+        now = time.time()
+        self.membership.heartbeat(now=now)
+        if now - self._last_pool_scan < self._pool_scan_s:
+            return
+        self._last_pool_scan = now
+        for member in self.membership.evict_lapsed(now=now):
+            self._say("serve: evicted member %s (heartbeat lapsed; "
+                      "its requests are now stealable)" % member)
+        self._poll_pool(now)
+
+    def _poll_pool(self, now: float) -> None:
+        """Adopt journaled 'accepted'/'running' requests this member can
+        run: anything non-terminal, not already known here, and not
+        another live member's (:meth:`_owned_elsewhere`).  This is both
+        halves of elasticity in one scan — load sharing (a healthy
+        peer's queued intake is claimed by whoever pops first) and
+        failover (a dead member's leases expired, so its requests stop
+        being owned elsewhere).  Hash affinity only ORDERS adoption
+        (members prefer their own shard of the id space, shrinking
+        claim races); any member takes any request once it is free."""
+        from iterative_cleaner_tpu.parallel.distributed import shard_owner
+        from iterative_cleaner_tpu.resilience.journal import REQUEST_TERMINAL
+
+        states = self.journal.request_states()
+        claims = self.journal.claim_table(now=now)
+        roster = self.membership.members(now=now)
+        self._journal_read_ts = now
+        live = [m for m, lease in roster.items() if lease["live"]]
+        candidates = []
+        for rid, view in states.items():
+            if view.get("state") in REQUEST_TERMINAL:
+                continue
+            if (view.get("kind") or "clean") == "stream":
+                # live stream failover is a restart concern (recover
+                # replays journaled chunks); the loop-time scan only
+                # adopts batch requests
+                continue
+            if self.scheduler.knows(rid):
+                continue
+            if self._owned_elsewhere(rid, view, roster, claims):
+                continue
+            candidates.append(rid)
+        candidates.sort(key=lambda rid: (
+            0 if shard_owner(rid, live) == self.membership.member_id else 1,
+            rid))
+        for rid in candidates:
+            try:
+                req = ServeRequest.from_journal_entry(rid, states[rid])
+                self._open_root_span(req, source="pool")
+                self.scheduler.submit(req, already_journaled=True)
+            except RequestError as exc:
+                self._root_spans.pop(rid, None)
+                self.journal.record_request(rid, "failed",
+                                            error=f"unrecoverable: {exc}")
+                self.registry.counter_inc("serve_failed")
+                continue
+            except Rejection:
+                # our queue is full right now; the request stays
+                # journaled and the next scan (or another member) takes it
+                self._root_spans.pop(rid, None)
+                break
+            self.registry.counter_inc("serve_pool_adopted")
+            self._say("serve: adopted %s from the pool" % rid)
+
+    def _claim_for_execute(self, req: ServeRequest) -> bool:
+        """Lease this request's execution through the journal before
+        running it (pool members only; streams are session-local and a
+        standalone daemon is its own pool).  Returns False when another
+        member holds the lease — the caller drops the request and lets
+        the winner run it.  Winning a lease a LAPSED member held is a
+        steal: counted, timed (``serve_failover_s`` measures now minus
+        the victim's last sign of life) and re-parented under the
+        originating trace exactly like stolen fleet buckets."""
+        if self.membership is None or req.kind == "stream":
+            return True
+        work = request_work_key(req.request_id)
+        now = time.time()
+        prev = self.journal.claim_table(now=now).get(work)
+        won = self.journal.try_claim(
+            work, host=self.membership.host,
+            nonce=self.membership.member_id,
+            ttl_s=self.serve_config.member_ttl_s, now=now,
+            trace={"trace_id": req.trace_id,
+                   "span_id": req.root_span_id})
+        if not won:
+            self.registry.counter_inc("serve_claim_lost")
+            return False
+        if (prev is not None
+                and prev.get("nonce") != self.membership.member_id
+                and prev.get("expires", 0.0) <= now):
+            from iterative_cleaner_tpu.telemetry.registry import SECONDS
+
+            failover = max(
+                now - (prev["expires"] - prev.get("ttl", 0.0)), 0.0)
+            self.registry.counter_inc("serve_requests_stolen")
+            self.registry.histogram_observe("serve_failover_s", failover,
+                                            buckets=SECONDS)
+            self.registry.gauge_set("serve_last_failover_s",
+                                    round(failover, 3))
+            self._say("serve: stole %s from lapsed member (%.1fs since "
+                      "its last heartbeat)" % (req.request_id, failover))
+        from iterative_cleaner_tpu.parallel.fleet import ClaimHeartbeat
+
+        self._exec_hb = ClaimHeartbeat(
+            self.journal, work, self.membership.host,
+            self.membership.member_id, self.serve_config.member_ttl_s,
+            registry=self.registry, counter="serve_heartbeat_errors")
+        return True
+
+    def _release_execute_claim(self, req: ServeRequest) -> None:
+        hb, self._exec_hb = self._exec_hb, None
+        if hb is not None:
+            hb.stop()
+        if self.membership is None or req.kind == "stream":
+            return
+        try:
+            self.journal.release(request_work_key(req.request_id),
+                                 host=self.membership.host,
+                                 nonce=self.membership.member_id)
+        except OSError:
+            pass  # an unreleased lease merely expires
+
     # ------------------------------------------------------ observability
     def _open_root_span(self, req: ServeRequest, *, source: str) -> None:
         """The request's root span: intake → terminal state.  Everything
@@ -237,15 +476,40 @@ class ServeDaemon:
             root.end(status=status)
 
     def health(self) -> dict:
+        """GET /healthz: one signal shared by the pool's eviction logic
+        and external load balancers — liveness, drain state, this
+        member's roster view and how stale its journal fold is."""
         snap = self.registry.snapshot()
         counters = snap.get("counters", {})
+        draining = self.scheduler.draining
+        now = time.time()
+        if self.membership is not None:
+            table = self.membership.members(now=now)
+            members = {
+                "n": sum(1 for lease in table.values() if lease["live"]),
+                "self": "draining" if draining else "member",
+                "id": self.membership.member_id,
+                "evicted": int(counters.get("serve_members_evicted", 0)),
+            }
+        else:
+            members = {"n": 1,
+                       "self": "draining" if draining else "standalone",
+                       "id": None, "evicted": 0}
         return {
-            "status": "draining" if self.scheduler.draining else "ok",
+            "status": "draining" if draining else "ok",
+            "draining": draining,
             "pid": os.getpid(),
-            "uptime_s": round(time.time() - self._started_ts, 3),
+            "uptime_s": round(now - self._started_ts, 3),
             "queued": self.scheduler.depth(),
             "running": self._running_id,
             "streams": len(self._streams),
+            "members": members,
+            # age of this process's last journal fold: None before the
+            # first fold, else how far behind the shared state the
+            # eviction/adoption scanner is running
+            "journal_lag_s": (round(now - self._journal_read_ts, 3)
+                              if self._journal_read_ts is not None
+                              else None),
             "accepted": int(counters.get("serve_accepted", 0)),
             "completed": int(counters.get("serve_completed", 0)),
             "failed": int(counters.get("serve_failed", 0)),
@@ -259,6 +523,7 @@ class ServeDaemon:
         /requests/<id>) — reading the journal means the answer survives
         restarts and never races the worker loop."""
         view = self.journal.request_states().get(request_id)
+        self._journal_read_ts = time.time()
         if view is None:
             return None
         doc = {k: view[k] for k in _STATUS_FIELDS if k in view}
@@ -318,8 +583,36 @@ class ServeDaemon:
             "execute", trace_id=req.trace_id,
             parent_id=req.root_span_id, subsystem="serve", lane="serve",
             request_id=req.request_id, tenant=req.tenant)
+        cfg_hash = None
         try:
             cfg = req.effective_config(self.base_config)
+            if self.result_cache is not None:
+                from iterative_cleaner_tpu.utils.checkpoint import (
+                    config_hash,
+                )
+
+                cfg_hash = config_hash(cfg)
+                hits = self.result_cache.lookup(req.paths, cfg_hash)
+                if hits is not None:
+                    # every path's output verified against its recorded
+                    # signatures: answer without touching the device —
+                    # no load, no compile, no execute, no fleet spans
+                    dt = time.perf_counter() - t0
+                    span.set("cached", True)
+                    span.set("n_cached", len(hits))
+                    span.end(status="ok")
+                    self.journal.record_request(
+                        req.request_id, "done", n_cached=len(hits),
+                        n_cleaned=0, n_skipped=0, n_failed=0,
+                        duration_s=round(dt, 6))
+                    self.registry.counter_inc("serve_completed")
+                    self._observe_latency(req, dt)
+                    self._close_root_span(req, "ok")
+                    self._say("serve: done %s from result cache "
+                              "(%d path%s, %.3fs, zero device work)"
+                              % (req.request_id, len(hits),
+                                 "" if len(hits) == 1 else "s", dt))
+                    return
             plan = ResiliencePlan(
                 faults=self.faults, retry=self.retry,
                 stage_timeout_s=self.stage_timeout_s,
@@ -361,6 +654,13 @@ class ServeDaemon:
         if report.ok:
             self.journal.record_request(req.request_id, "done", **fields)
             self.registry.counter_inc("serve_completed")
+            if self.result_cache is not None and cfg_hash is not None:
+                # index the finished outputs so an identical resubmission
+                # anywhere in the pool answers with zero device work
+                self.result_cache.publish(
+                    req.paths, cfg_hash, out_path_fn=default_out_path,
+                    trace={"trace_id": req.trace_id,
+                           "span_id": req.root_span_id})
             self._close_root_span(req, "ok")
             self._say("serve: done %s (%d cleaned, %d resumed, %.2fs, "
                       "%d precompile hits)"
@@ -592,6 +892,7 @@ class ServeDaemon:
         (the journal is the source of truth, so the index survives
         restarts and includes terminal requests)."""
         states = self.journal.request_states()
+        self._journal_read_ts = time.time()
         return {
             "n": len(states),
             "requests": [
@@ -693,6 +994,14 @@ class ServeDaemon:
             signal.signal(signal.SIGTERM, self._on_signal)
             signal.signal(signal.SIGINT, self._on_signal)
             install_sigquit()  # kill -QUIT: live black-box snapshot
+        if self.membership is not None:
+            self.membership.join()
+            # the loop executes requests inline, so a background beat
+            # keeps a busy member's lease alive (stopped by leave())
+            self.membership.start_auto_beat(registry=self.registry)
+            print("serve: joined pool as %s (member ttl %.1fs)"
+                  % (self.membership.member_id,
+                     self.serve_config.member_ttl_s), flush=True)
         self.recover()
         if self.serve_config.http_port is not None:
             from iterative_cleaner_tpu.serve.http import (
@@ -716,6 +1025,8 @@ class ServeDaemon:
                 draining = self.scheduler.draining
                 if self.spool is not None:
                     self.spool.scan_once(stop_intake=draining)
+                if not draining:
+                    self._elastic_tick()
                 req, expired = self.scheduler.pop(
                     timeout=self.serve_config.poll_s)
                 self._fail_expired(expired)
@@ -727,9 +1038,20 @@ class ServeDaemon:
                 if req is None:
                     self._maintain()
                     continue
+                if not self._claim_for_execute(req):
+                    # another member leased this request first: drop it
+                    # here (and forget the id so it is re-adoptable if
+                    # that member dies) — the winner journals its fate
+                    self.scheduler.mark_done(req)
+                    self.scheduler.forget(req.request_id)
+                    self._close_root_span(req, "lost")
+                    self._say("serve: %s is leased by another member, "
+                              "skipping" % req.request_id)
+                    continue
                 try:
                     self._execute(req)
                 finally:
+                    self._release_execute_claim(req)
                     self.scheduler.mark_done(req)
         except Exception:
             # an exception escaping the serve loop is exactly what the
@@ -745,6 +1067,13 @@ class ServeDaemon:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+        if self.membership is not None:
+            # leave BEFORE compacting: the roster forgets a drained
+            # member immediately (never "evicted") and the compaction
+            # below drops our membership lines with us
+            self.membership.leave()
+            self._say("serve: left pool (%s)"
+                      % self.membership.member_id)
         queued = self.scheduler.depth()
         self.journal.compact()
         if self.trace_out:

@@ -288,3 +288,11 @@ def request_key(req: ServeRequest, seq: int) -> Tuple:
     deterministic for a given intake sequence."""
     deadline = req.deadline_ts if req.deadline_ts is not None else float("inf")
     return (-req.priority, deadline, seq)
+
+
+def request_work_key(request_id: str) -> str:
+    """The journal claim-lease key under which an elastic pool member
+    leases one request's EXECUTION (the fleet's bucket keys play the
+    same role one layer down).  Namespaced so request leases and bucket
+    leases can never collide in a shared journal."""
+    return "req:" + str(request_id)

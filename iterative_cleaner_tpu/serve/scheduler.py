@@ -16,6 +16,14 @@ UNFINISHED requests (queued + running).  One greedy tenant saturates its
 own cap and starts drawing 429s while other tenants' requests keep
 flowing — the per-tenant fairness floor, without a full weighted-share
 scheduler.
+
+In an elastic pool the bound is POOL-wide: ``pool_inflight`` (a
+callable ``tenant -> count``, backed by the shared journal's request
+fold) lets admission see the tenant's unfinished requests across every
+member, so a greedy tenant cannot multiply its cap by spraying
+submissions at each member's front door.  A failing pool view falls
+back to the local count — admission degrades to per-host fairness,
+it never wedges intake.
 """
 
 from __future__ import annotations
@@ -47,12 +55,16 @@ class ServeScheduler:
     ``serve_*`` counters and queue-depth gauges."""
 
     def __init__(self, *, queue_limit: int, max_inflight: int,
-                 registry=None, faults=None, tracer=None) -> None:
+                 registry=None, faults=None, tracer=None,
+                 pool_inflight=None) -> None:
         self.queue_limit = int(queue_limit)
         self.max_inflight = int(max_inflight)
         self.registry = registry
         self.faults = faults
         self.tracer = tracer
+        # elastic pools: tenant -> unfinished count across ALL members
+        # (journal-backed); None keeps admission per-host
+        self.pool_inflight = pool_inflight
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._heap: List[Tuple[Tuple, ServeRequest]] = []
@@ -144,6 +156,18 @@ class ServeScheduler:
                     "queue_full",
                     f"queue at its bound ({self.queue_limit}); backpressure")
             inflight = self._inflight.get(req.tenant, 0)
+            if self.pool_inflight is not None and not already_journaled:
+                # fair-share across the POOL: the journal sees every
+                # member's unfinished requests; take the larger of the
+                # two views (the local one includes admitted-but-not-
+                # yet-journaled work the fold can't see yet)
+                try:
+                    inflight = max(inflight,
+                                   int(self.pool_inflight(req.tenant)))
+                except Exception:
+                    # a torn journal read must not wedge admission:
+                    # degrade to the per-host view
+                    self._count("serve_pool_view_errors")
             if inflight >= self.max_inflight:
                 self._count("serve_rejected")
                 raise Rejection(
@@ -229,3 +253,21 @@ class ServeScheduler:
             else:
                 self._inflight[req.tenant] = n - 1
             self._gauges()
+
+    # ------------------------------------------------------ elastic pool
+    def knows(self, request_id: str) -> bool:
+        """Has this scheduler ever admitted ``request_id``?  The pool
+        adoption scan uses this to skip requests already queued, running
+        or finished HERE (the journal says what finished anywhere)."""
+        with self._lock:
+            return request_id in self._known_ids
+
+    def forget(self, request_id: str) -> None:
+        """Drop a request id from the admitted set — the claim-lost
+        path: another member won the execution lease, so THIS member
+        must be able to re-adopt the id later if that member dies
+        (``already_journaled`` re-admission would also bypass the
+        duplicate check, but a forgotten id keeps the set's size honest
+        in a long-lived pool)."""
+        with self._lock:
+            self._known_ids.discard(request_id)

@@ -40,6 +40,11 @@ _IDENTITY_EXCLUDE = {"unload_res", "record_history",
                      # bucket never changes its mask — stolen work must
                      # satisfy the original host's journal entries
                      "fleet_hosts", "fleet_host_id", "fleet_claim_ttl_s"}
+# The elastic-pool knobs (join/member_ttl_s/result_cache) are ServeConfig
+# fields, deliberately outside CleanConfig: pool membership and result
+# caching can never change a mask, and the cache/journal 'member'/'cache'
+# lines therefore key on this CleanConfig identity hash unchanged — a
+# cache entry published by one member verifies identically on any other.
 
 # The identity half, spelled out: every field here participates in
 # config_identity/config_hash, so adding a CleanConfig field forces an

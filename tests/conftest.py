@@ -26,6 +26,32 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 
+import pytest  # noqa: E402
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# droppings the suite must never leave in the repo root: every test runs
+# in tmp_path (or routes its outputs there), so any of these appearing
+# means a code path ignored its cwd/output directory again
+_STRAY_FILES = ("clean.log", "serve.flight.json", "serve.journal.jsonl")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def repo_tree_stays_clean():
+    """Regression guard: the suite leaves the repo root clean.  Records
+    which known droppings pre-exist (a dirty checkout is not this
+    session's fault), then fails the session if a test created one."""
+    before = {n for n in _STRAY_FILES
+              if os.path.exists(os.path.join(_REPO_ROOT, n))}
+    yield
+    created = [n for n in _STRAY_FILES
+               if n not in before
+               and os.path.exists(os.path.join(_REPO_ROOT, n))]
+    assert not created, (
+        f"test suite littered the repo root with {created}; tests must "
+        f"run in tmp_path and code must route logs/journals relative to "
+        f"their outputs, not the process cwd")
+
+
 def repo_subprocess_env(**extra):
     """Environment for tests that launch repo entry points in fresh
     processes: repo on PYTHONPATH (prepended, existing entries kept) and

@@ -46,12 +46,14 @@ def test_bench_small_end_to_end_json_schema():
     contract: one JSON line with the driver-read keys."""
     import json
 
-    # BENCH_SKIP_MULTIHOST: the multi-host row alone launches four CLI
-    # processes — more wall-clock than this tier-1 test's budget allows.
-    # test_bench_multihost_row_keys (slow) pins that row's keys instead;
-    # CI's bench smoke runs the full BENCH_SMALL set including it.
+    # BENCH_SKIP_MULTIHOST / BENCH_SKIP_ELASTIC: those rows launch
+    # several CLI/daemon processes each — more wall-clock than this
+    # tier-1 test's budget allows.  test_bench_multihost_row_keys and
+    # test_bench_elastic_row_keys (slow) pin their keys instead; CI's
+    # bench smoke runs the full BENCH_SMALL set including them.
     proc = _run_repo_script("bench.py", extra_env=(
-        ("BENCH_SMALL", "1"), ("BENCH_SKIP_MULTIHOST", "1")))
+        ("BENCH_SMALL", "1"), ("BENCH_SKIP_MULTIHOST", "1"),
+        ("BENCH_SKIP_ELASTIC", "1")))
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, proc.stdout
@@ -170,6 +172,33 @@ def test_bench_multihost_row_keys():
     assert out["fleet_multihost_vs_single"] > 0
     if out["fleet_multihost_cores"] >= 2:
         assert out["fleet_multihost_vs_single"] < 1.0
+
+
+@pytest.mark.slow
+def test_bench_elastic_row_keys():
+    """The elastic-pool row (two --join daemons, kill -9 on the front
+    door, result-cache resubmission) in isolation: the driver and CI read
+    these keys from the headline JSON.  Exactly-once, mask parity and
+    the cache-hit contract are rc-7-fatal inside the stage."""
+    import json
+
+    proc = _run_repo_script("bench.py", extra_env=(
+        ("BENCH_ELASTIC_ONLY", json.dumps(
+            {"geometries": [[6, 16, 32], [8, 16, 32], [10, 16, 32]]})),))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    err = proc.stderr[-3000:]
+    for key in ("elastic_members", "elastic_platform", "serve_failover_s",
+                "members_evicted", "requests_stolen", "elastic_takeover_s",
+                "cache_hits", "cache_hit_vs_clean", "cache_clean_s",
+                "cache_served_s"):
+        assert key in out, (key, err)
+    assert out["elastic_members"] == 2
+    assert out["members_evicted"] >= 1
+    assert out["requests_stolen"] >= 1
+    assert out["serve_failover_s"] > 0
+    assert out["cache_hits"] >= 1
+    assert out["cache_hit_vs_clean"] > 0
 
 
 def test_profile_stages_small_end_to_end():

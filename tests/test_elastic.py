@@ -1,0 +1,517 @@
+"""Elastic serving pool + result cache tests (PR: elastic membership).
+
+Units: journal membership-lease fold and compaction (live members kept,
+lapsed/left members dropped, cache lines kept, torn-tail heal),
+PoolMembership eviction edge detection and heartbeat throttle/auto-beat,
+ResultCache verification ladder, scheduler pool-wide fair-share,
+deterministic shard_owner affinity, and the extended /healthz document.
+
+End-to-end (in-process): an identical resubmission answered from the
+result cache with zero device work and byte-identical output; a
+corrupted cache entry detected, counted and fallen through to a real
+clean.
+
+End-to-end (subprocess, slow): the chaos drill — two joined members on
+one shared journal, ``kill -9`` the front-door member mid-burst, the
+survivor adopts intake and steals the in-flight request, every accepted
+request completes exactly once with outputs byte-identical to a batch
+CLI run, and failover/eviction/cache metrics are published.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig, ServeConfig
+from iterative_cleaner_tpu.io import make_synthetic_archive, save_archive
+from iterative_cleaner_tpu.parallel.distributed import shard_owner
+from iterative_cleaner_tpu.resilience import FleetJournal
+from iterative_cleaner_tpu.serve import (
+    PoolMembership,
+    Rejection,
+    ResultCache,
+    ServeDaemon,
+    ServeRequest,
+    ServeScheduler,
+    request_work_key,
+)
+from iterative_cleaner_tpu.serve.daemon import default_out_path
+from iterative_cleaner_tpu.telemetry import MetricsRegistry
+from tests.conftest import repo_subprocess_env
+from tests.test_serve import (
+    _assert_outputs_bit_equal,
+    _count_done_lines,
+    _daemon_port,
+    _get,
+    _post,
+    _run_batch_reference,
+    _spool_submit,
+    _start,
+    _wait_request_done,
+    _write_fleet,
+)
+
+NUMPY_BASE = CleanConfig(backend="numpy", max_iter=2)
+
+
+# --------------------------------------------- journal membership grammar
+
+def test_member_table_join_hb_leave_fold(tmp_path):
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    j.record_member("a", "join", host=1, ttl_s=10.0, now=100.0)
+    j.record_member("b", "join", host=2, ttl_s=10.0, now=100.0)
+    t = j.member_table(now=105.0)
+    assert t["a"]["live"] and t["b"]["live"]
+    assert t["a"]["host"] == 1 and t["a"]["expires"] == 110.0
+    # a heartbeat re-grants the lease exactly like a join
+    j.record_member("a", "hb", host=1, ttl_s=10.0, now=108.0)
+    t = j.member_table(now=112.0)
+    assert t["a"]["live"] and t["a"]["expires"] == 118.0
+    assert not t["b"]["live"]  # lapsed: evictable, work stealable
+    # a leave ends the lease immediately, not after the ttl
+    j.record_member("a", "leave", host=1, ttl_s=0.0, now=113.0)
+    t = j.member_table(now=114.0)
+    assert "a" not in t and "b" in t
+
+
+def test_member_hb_alone_regrants_post_compaction(tmp_path):
+    # a compacted roster keeps only each member's LAST line — often an
+    # hb — and must fold back to the same lease
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    j.record_member("m", "hb", host=7, ttl_s=10.0, now=200.0)
+    t = j.member_table(now=205.0)
+    assert t["m"] == {"host": 7, "expires": 210.0, "live": True}
+
+
+def test_record_member_rejects_unknown_state(tmp_path):
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    with pytest.raises(ValueError):
+        j.record_member("m", "exploded", host=1, ttl_s=1.0)
+
+
+# ------------------------------------------------- compaction (satellite)
+
+def _write_cacheable(tmp_path, name):
+    ar, _ = make_synthetic_archive(nsub=4, nchan=8, nbin=16, seed=11)
+    p = str(tmp_path / name)
+    save_archive(ar, p)
+    out = default_out_path(p)
+    save_archive(ar, out)  # any complete file works as the indexed output
+    return p, out
+
+
+def test_compaction_keeps_live_member_and_cache_drops_ghosts(tmp_path):
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    now = time.time()
+    j.record_member("alive", "join", host=1, ttl_s=1e6, now=now)
+    j.record_member("alive", "hb", host=1, ttl_s=1e6, now=now + 1)
+    j.record_member("lapsed", "join", host=2, ttl_s=5.0, now=now - 100)
+    j.record_member("gone", "join", host=3, ttl_s=1e6, now=now)
+    j.record_member("gone", "leave", host=3, ttl_s=0.0, now=now + 1)
+    p, out = _write_cacheable(tmp_path, "a.npz")
+    j.record_cache(p, config_hash="cfg1", out_path=out)
+    assert j.compact()
+    text = open(j.path).read()
+    assert "lapsed" not in text and "gone" not in text
+    roster = j.member_table(now=now + 2)
+    assert list(roster) == ["alive"] and roster["alive"]["live"]
+    # only the live member's LAST line survives
+    assert sum(1 for ln in text.splitlines()
+               if '"event": "member"' in ln) == 1
+    # the cache index line survives compaction verbatim
+    idx = j.cache_index()
+    assert len(idx) == 1
+    (entry,) = idx.values()
+    assert entry["config"] == "cfg1" and entry["out"] == os.path.abspath(out)
+
+
+def test_compaction_heals_torn_tail_then_folds_members(tmp_path):
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    now = time.time()
+    j.record_member("m1", "join", host=1, ttl_s=1e6, now=now)
+    with open(j.path, "a") as f:
+        f.write('{"schema": "icln-fleet-journal/1", "event": "memb')  # torn
+    # the next append heals the missing newline, losing only the torn line
+    j.record_member("m2", "join", host=2, ttl_s=1e6, now=now)
+    roster = j.member_table(now=now + 1)
+    assert set(roster) == {"m1", "m2"}
+    assert j.compact()
+    roster = j.member_table(now=now + 1)
+    assert set(roster) == {"m1", "m2"}
+    for ln in open(j.path).read().splitlines():
+        json.loads(ln)  # every surviving line is whole
+
+
+# ------------------------------------------------------- PoolMembership
+
+def test_pool_membership_eviction_edge_detection(tmp_path):
+    reg = MetricsRegistry()
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    me = PoolMembership(j, ttl_s=10.0, member_id="me", host=1, registry=reg)
+    me.join(now=100.0)
+    j.record_member("peer", "join", host=2, ttl_s=10.0, now=100.0)
+    assert me.evict_lapsed(now=105.0) == []
+    assert reg.gauges["serve_members"] == 2.0
+    # the peer lapses: evicted exactly once, not on every scan
+    assert me.evict_lapsed(now=120.0) == ["peer"]
+    assert me.evict_lapsed(now=121.0) == []
+    assert reg.counters["serve_members_evicted"] == 1
+    # a member never observes ITSELF evicted (its gauge still drops)
+    assert reg.gauges["serve_members"] == 0.0
+    # the peer coming back live re-arms the edge detector
+    j.record_member("peer", "hb", host=2, ttl_s=10.0, now=122.0)
+    assert me.evict_lapsed(now=125.0) == []
+    assert me.evict_lapsed(now=140.0) == ["peer"]
+    assert reg.counters["serve_members_evicted"] == 2
+
+
+def test_pool_membership_heartbeat_throttle(tmp_path):
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    m = PoolMembership(j, ttl_s=9.0, member_id="m", host=1)
+    assert not m.heartbeat(now=100.0)  # never joined: no lease to extend
+    m.join(now=100.0)
+    assert not m.heartbeat(now=101.0)  # inside ttl/3: throttled
+    assert m.heartbeat(now=104.0)
+    assert not m.heartbeat(now=105.0)
+    m.leave(now=106.0)
+    assert not m.heartbeat(now=120.0)  # left: no re-grant ever
+    states = [e["state"] for e in map(json.loads, open(j.path))
+              if e.get("event") == "member"]
+    assert states == ["join", "hb", "leave"]
+
+
+def test_pool_membership_auto_beat_keeps_busy_member_alive(tmp_path):
+    # the daemon loop blocks while executing inline; the auto-beat thread
+    # must keep the lease alive regardless
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    m = PoolMembership(j, ttl_s=0.3, member_id="busy", host=1)
+    m.join()
+    m.start_auto_beat()
+    m.start_auto_beat()  # idempotent
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            n_hb = sum(1 for e in map(json.loads, open(j.path))
+                       if e.get("state") == "hb")
+            if n_hb >= 2:
+                break
+            time.sleep(0.05)
+        assert n_hb >= 2, "auto-beat never appended a heartbeat"
+        assert j.member_table()["busy"]["live"]
+    finally:
+        m.leave()
+    assert m._beat_thread is None  # leave() stopped the beat
+    assert "busy" not in j.member_table()
+
+
+# ----------------------------------------------------------- ResultCache
+
+def test_result_cache_hit_requires_every_signature(tmp_path):
+    reg = MetricsRegistry()
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    rc = ResultCache(j, registry=reg)
+    p, out = _write_cacheable(tmp_path, "a.npz")
+
+    assert rc.lookup([p], "cfg") is None  # nothing indexed yet
+    assert reg.counters["serve_cache_misses"] == 1
+
+    j.record_cache(p, config_hash="cfg", out_path=out)
+    hits = rc.lookup([p], "cfg")
+    assert hits is not None and hits[p]["out"] == os.path.abspath(out)
+    assert reg.counters["serve_cache_hits"] == 1
+
+    assert rc.lookup([p], "other-config") is None  # config is in the key
+    assert reg.counters["serve_cache_misses"] == 2
+
+    # corrupted output: rejected, falls through to a real clean
+    with open(out, "ab") as f:
+        f.write(b"corruption")
+    assert rc.lookup([p], "cfg") is None
+    assert reg.counters["serve_cache_rejected"] == 1
+
+    j.record_cache(p, config_hash="cfg", out_path=out)  # re-index as-is
+    assert rc.lookup([p], "cfg") is not None
+    os.unlink(out)  # vanished output: rejected too
+    assert rc.lookup([p], "cfg") is None
+    assert reg.counters["serve_cache_rejected"] == 2
+
+    # rewritten INPUT changes the key: a plain miss, not a rejection
+    save_archive(make_synthetic_archive(nsub=4, nchan=8, nbin=16,
+                                        seed=99)[0], p)
+    assert rc.lookup([p], "cfg") is None
+    assert reg.counters["serve_cache_misses"] == 3
+
+    # all-or-nothing: one unindexed path spoils the whole request
+    p2, out2 = _write_cacheable(tmp_path, "b.npz")
+    j.record_cache(p2, config_hash="cfg", out_path=out2)
+    assert rc.lookup([p2], "cfg") is not None
+    assert rc.lookup([p2, str(tmp_path / "absent.npz")], "cfg") is None
+
+
+def test_result_cache_publish_skips_missing_outputs(tmp_path):
+    reg = MetricsRegistry()
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    rc = ResultCache(j, registry=reg)
+    p, _out = _write_cacheable(tmp_path, "a.npz")
+    ghost = str(tmp_path / "ghost.npz")
+    save_archive(make_synthetic_archive(nsub=4, nchan=8, nbin=16,
+                                        seed=12)[0], ghost)  # no output
+    assert rc.publish([p, ghost], "cfg", out_path_fn=default_out_path) == 1
+    assert reg.counters["serve_cache_publish_errors"] == 1
+    assert len(j.cache_index()) == 1
+
+
+# ------------------------------------------------- scheduler fair share
+
+def test_scheduler_pool_inflight_caps_across_pool():
+    reg = MetricsRegistry()
+    s = ServeScheduler(queue_limit=16, max_inflight=2, registry=reg,
+                       pool_inflight=lambda tenant: 2)
+    with pytest.raises(Rejection) as exc:
+        s.submit(ServeRequest("r1", ["/d/a.npz"]))
+    assert exc.value.reason == "tenant_limit"
+    # journal-sourced re-admission (recover/adoption) bypasses the pool
+    # view: the request is already counted in the fold itself
+    s.submit(ServeRequest("r1", ["/d/a.npz"]), already_journaled=True)
+    assert reg.counters["serve_accepted"] == 1
+
+
+def test_scheduler_pool_view_failure_degrades_to_local():
+    reg = MetricsRegistry()
+
+    def boom(tenant):
+        raise OSError("torn journal read")
+
+    s = ServeScheduler(queue_limit=16, max_inflight=2, registry=reg,
+                       pool_inflight=boom)
+    s.submit(ServeRequest("r1", ["/d/a.npz"]))  # local view admits
+    assert reg.counters["serve_pool_view_errors"] == 1
+    assert reg.counters["serve_accepted"] == 1
+
+
+def test_shard_owner_deterministic_over_dynamic_members():
+    members = ["m2", "m0", "m1"]
+    owners = {rid: shard_owner(rid, members) for rid in
+              ("r-%d" % i for i in range(20))}
+    assert set(owners.values()) <= set(members)
+    # order-independent and stable across calls (blake2b, not hash())
+    for rid, owner in owners.items():
+        assert shard_owner(rid, reversed(members)) == owner
+    assert shard_owner("r", []) is None
+
+
+# ------------------------------------------------ /healthz (satellite)
+
+def test_health_standalone_reports_membership_view(tmp_path):
+    cfg = ServeConfig(journal_path=str(tmp_path / "j.jsonl"),
+                      http_port=0, flight_recorder="")
+    d = ServeDaemon(cfg, NUMPY_BASE, quiet=True)
+    h = d.health()
+    assert h["draining"] is False
+    assert h["members"] == {"n": 1, "self": "standalone", "id": None,
+                            "evicted": 0}
+    assert h["journal_lag_s"] is None  # no fold yet
+    d.request_state("nothing")         # any journal fold stamps the lag
+    assert d.health()["journal_lag_s"] >= 0.0
+
+
+def test_health_elastic_reports_roster_and_drain(tmp_path):
+    cfg = ServeConfig(journal_path=str(tmp_path / "j.jsonl"), http_port=0,
+                      join=True, member_ttl_s=30.0, flight_recorder="")
+    d = ServeDaemon(cfg, NUMPY_BASE, quiet=True)
+    d.membership.join()
+    peer = PoolMembership(d.journal, ttl_s=30.0, member_id="peer", host=2)
+    peer.join()
+    h = d.health()
+    assert h["members"]["n"] == 2
+    assert h["members"]["self"] == "member"
+    assert h["members"]["id"] == d.membership.member_id
+    peer.leave()
+    d.scheduler.start_drain()
+    h = d.health()
+    assert h["status"] == "draining" and h["draining"] is True
+    assert h["members"] == {"n": 1, "self": "draining",
+                            "id": d.membership.member_id, "evicted": 0}
+
+
+# ------------------------------------- in-process result-cache round trip
+
+def test_daemon_answers_identical_resubmission_from_cache(tmp_path):
+    ar, _ = make_synthetic_archive(nsub=4, nchan=8, nbin=16, seed=21)
+    a = str(tmp_path / "a.npz")
+    save_archive(ar, a)
+    cfg = ServeConfig(http_port=0, poll_s=0.02,
+                      journal_path=str(tmp_path / "serve.jsonl"),
+                      result_cache=True, flight_recorder="")
+    d = ServeDaemon(cfg, NUMPY_BASE, quiet=True)
+    t, url = _start(d)
+    try:
+        def wait_done(rid):
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                state = _get(url + "/requests/" + rid)
+                if state["state"] in ("done", "failed"):
+                    return state
+                time.sleep(0.05)
+            pytest.fail("request %s never finished" % rid)
+
+        _post(url + "/submit", {"paths": [a], "id": "first"})
+        assert wait_done("first")["state"] == "done"
+        out = default_out_path(a)
+        ref = open(out, "rb").read()
+
+        # identical resubmission: served from the journal's cache index —
+        # zero device work (no fleet counters move, no fleet spans open)
+        # and the output bytes untouched
+        mark = d.registry.counters_mark()
+        _post(url + "/submit", {"paths": [a], "id": "again"})
+        state = wait_done("again")
+        assert state["state"] == "done" and state["n_cached"] == 1
+        delta = d.registry.counters_since(mark)
+        assert delta.get("serve_cache_hits") == 1
+        assert not any(k.startswith("fleet_") for k in delta), delta
+        spans = d.trace_view("again")["spans"]
+        assert spans and all(s.get("subsystem") != "fleet" for s in spans)
+        assert open(out, "rb").read() == ref
+
+        # the extended health document rides the same HTTP surface
+        h = _get(url + "/healthz")
+        assert h["draining"] is False and h["members"]["n"] == 1
+        assert h["journal_lag_s"] is not None
+
+        # corrupt the cached output: the entry is rejected, counted, and
+        # the request falls through to a real clean that restores it
+        with open(out, "ab") as f:
+            f.write(b"bitrot")
+        mark = d.registry.counters_mark()
+        _post(url + "/submit", {"paths": [a], "id": "after-rot"})
+        state = wait_done("after-rot")
+        assert state["state"] == "done" and state["n_cleaned"] == 1
+        delta = d.registry.counters_since(mark)
+        assert delta.get("serve_cache_rejected", 0) >= 1
+        assert open(out, "rb").read() == ref  # re-cleaned byte-identical
+    finally:
+        d._on_signal(signal.SIGTERM, None)
+        t.join(30)
+    assert not t.is_alive()
+
+
+# -------------------------------------------- subprocess chaos drill
+
+ELASTIC_FLAGS = ["--serve", "--http-port", "0", "--rotation", "roll",
+                 "--fft_mode", "dft", "--max_iter", "3",
+                 "--io-workers", "1", "--join", "--member-ttl", "2",
+                 "--result-cache"]
+
+
+def _start_member(tmp_path, tag, jpath, extra=(), **env):
+    out_path = str(tmp_path / ("member_%s.out" % tag))
+    outf = open(out_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "iterative_cleaner_tpu", *ELASTIC_FLAGS,
+         "--journal", jpath, "--spool", "spool_%s" % tag,
+         "--flight-recorder", "fr_%s.json" % tag, *extra],
+        env=repo_subprocess_env(ICLEAN_PROBE_TIMEOUT="0", **env),
+        cwd=str(tmp_path), stdout=outf, stderr=subprocess.STDOUT)
+    return proc, out_path
+
+
+@pytest.mark.slow
+def test_elastic_kill9_front_door_survivor_finishes_everything(tmp_path):
+    """The elastic pool's crash contract: two members share one journal;
+    the front-door member wedges mid-request and is SIGKILLed; the
+    survivor observes the eviction, adopts the queued intake, steals the
+    in-flight request's lease and finishes every accepted request exactly
+    once, byte-identical to a batch CLI run — then answers an identical
+    resubmission from the result cache with zero device work."""
+    geoms = [(6, 16, 32)] * 2 + [(8, 16, 32)] * 2 + [(6, 16, 32)]
+    paths = _write_fleet(tmp_path, geoms, ext=".icar")
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    ref_paths = _write_fleet(ref_dir, geoms, ext=".icar")
+    _run_batch_reference(ref_dir, ref_paths)
+    jpath = str(tmp_path / "pool.journal.jsonl")
+
+    # member A (the front door): the 3rd load hangs 600s, so request
+    # "big" journals its first bucket (2 archives) and wedges; the burst
+    # lands entirely on A — "extra" stays journaled 'accepted' behind it
+    proc_a, out_a = _start_member(tmp_path, "a", jpath,
+                                  extra=["--faults", "load:hang@3"],
+                                  ICLEAN_FAULT_HANG_S="600")
+    _daemon_port(proc_a, out_a)
+    _spool_submit(str(tmp_path / "spool_a"), "big",
+                  {"paths": [os.path.basename(p) for p in paths[:4]]})
+    _spool_submit(str(tmp_path / "spool_a"), "extra",
+                  {"paths": [os.path.basename(paths[4])]})
+    big_paths = set(paths[:4])
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if len(set(_count_done_lines(jpath)) & big_paths) >= 2:
+            break
+        if proc_a.poll() is not None:
+            pytest.fail("member A exited early (rc %s):\n%s"
+                        % (proc_a.returncode, open(out_a).read()[-3000:]))
+        time.sleep(0.2)
+    else:
+        proc_a.kill()
+        pytest.fail("journal never showed per-archive progress")
+
+    # member B joins the pool while A is wedged; it shares A's queued
+    # intake ("extra" has no execution lease, so B takes it) but must
+    # not touch "big": A is alive and holds its lease
+    proc_b, out_b = _start_member(tmp_path, "b", jpath)
+    _daemon_port(proc_b, out_b)
+    assert _wait_request_done(jpath, "extra", proc_b) == "done"
+    assert FleetJournal(jpath).request_states()["big"]["state"] == "running"
+
+    # kill -9 the front door mid-burst
+    os.kill(proc_a.pid, signal.SIGKILL)
+    assert proc_a.wait(timeout=60) == -signal.SIGKILL
+
+    # the survivor evicts A, steals "big" and finishes it
+    assert _wait_request_done(jpath, "big", proc_b) == "done"
+
+    # failover metrics are published on the survivor's front door
+    port_b = _daemon_port(proc_b, out_b)
+    health = json.loads(urllib.request.urlopen(
+        "http://127.0.0.1:%d/healthz" % port_b, timeout=10).read())
+    assert health["members"]["n"] == 1  # A evicted from the roster
+    metrics = urllib.request.urlopen(
+        "http://127.0.0.1:%d/metrics" % port_b, timeout=10).read().decode()
+    from iterative_cleaner_tpu.telemetry import parse_prometheus_text
+
+    parsed = parse_prometheus_text(metrics)
+    assert parsed["icln_serve_members_evicted_total"] >= 1.0
+    assert parsed["icln_serve_requests_stolen_total"] >= 1.0
+    assert parsed["icln_serve_last_failover_s"] > 0.0
+
+    # an identical resubmission is answered from the result cache
+    _spool_submit(str(tmp_path / "spool_b"), "rerun",
+                  {"paths": [os.path.basename(paths[4])]})
+    assert _wait_request_done(jpath, "rerun", proc_b) == "done"
+
+    proc_b.send_signal(signal.SIGTERM)
+    assert proc_b.wait(timeout=120) == 0
+
+    # zero duplicate cleans: one 'done' line per archive, exactly
+    done = _count_done_lines(jpath)
+    assert len(done) == 5 and len(set(done)) == 5
+    states = FleetJournal(jpath).request_states()
+    assert states["big"]["state"] == "done"
+    assert states["big"]["n_skipped"] == 2   # A's bucket resumed, not redone
+    assert states["big"]["n_cleaned"] == 2
+    assert states["extra"]["state"] == "done"
+    assert states["rerun"]["state"] == "done"
+    assert states["rerun"].get("n_cached") == 1  # zero device work
+    _assert_outputs_bit_equal(paths, ref_paths, ".icar")
+    text_b = open(out_b).read()
+    assert "evicted member" in text_b
+    assert "stole big from lapsed member" in text_b
+    assert "adopted" in text_b
