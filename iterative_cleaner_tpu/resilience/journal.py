@@ -107,7 +107,11 @@ every claim line of works whose lease is still granted (the fold needs
 the history; released works drop all their lines), the last 'stats'
 line per host, the last 'member' line of each member whose lease is
 still unexpired (left and evicted members drop entirely) and the last
-'cache' line per key.  The rewrite runs under the appenders' flock via
+'cache' line per key that still verifies (:func:`entry_is_current` —
+an entry whose input or output signature drifted can never hit again,
+so compaction ages it out and the cache index stays bounded by the
+inputs that actually exist).  The rewrite runs under the appenders'
+flock via
 :func:`~iterative_cleaner_tpu.utils.logging.compact_under_lock`, so
 compacting under live traffic loses no entries.
 """
@@ -517,7 +521,8 @@ class FleetJournal:
         their claim lines), the last 'stats' line per host, the last
         'member' line of each member whose lease is unexpired at ``now``
         (left and lapsed members drop entirely — a compacted roster
-        carries no ghosts) and the last 'cache' line per key, in
+        carries no ghosts) and the last 'cache' line per key that still
+        verifies (dead entries are aged out — they can never hit), in
         last-seen order.  For a request the kept line is re-serialized
         from the MERGED lifecycle view, so the accepted entry's
         description survives even though only its final state line is
@@ -531,7 +536,7 @@ class FleetJournal:
         stats: Dict[str, str] = {}
         members: Dict[str, str] = {}
         member_entries: List[dict] = []
-        cache: Dict[str, str] = {}
+        cache: Dict[str, dict] = {}
         order: List[str] = []
 
         def touch(key: str) -> None:
@@ -567,7 +572,7 @@ class FleetJournal:
                 member_entries.append(entry)
                 touch("member:" + mid)
             elif entry.get("event") == "cache" and entry.get("key"):
-                cache[entry["key"]] = json.dumps(entry, sort_keys=True)
+                cache[entry["key"]] = entry
                 touch("cache:" + entry["key"])
         owned = self._fold_claims(claim_entries)
         roster = self._fold_members(member_entries)
@@ -589,7 +594,13 @@ class FleetJournal:
                 if lease is not None and lease["expires"] > now:
                     lines.append(members[ident])
             elif kind == "cache":
-                lines.append(cache[ident])
+                # age out, don't keep unconditionally: a line whose
+                # recorded signatures no longer verify can never hit
+                # again (lookup re-checks the same evidence), and with
+                # varied inputs "one line per key forever" is unbounded
+                # growth that every pool fold then pays to re-read
+                if entry_is_current(cache[ident]):
+                    lines.append(json.dumps(cache[ident], sort_keys=True))
             else:
                 lines.append(stats[ident])
         return lines

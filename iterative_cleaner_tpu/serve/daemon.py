@@ -197,6 +197,14 @@ class ServeDaemon:
         # adoption/eviction scan cadence: a fraction of the membership
         # ttl so a lapsed member is noticed well within one lease
         self._pool_scan_s = min(1.0, serve_config.member_ttl_s / 3.0)
+        # memoized request-state fold for pool admission: every submit
+        # consults the pool-wide tenant view under the scheduler lock,
+        # and re-reading the whole journal per request would make
+        # admission latency grow with journal size.  A briefly stale
+        # fold is safe — the scheduler takes max(local, pool), so the
+        # local counter still bounds what the fold hasn't seen yet.
+        self._pool_fold = (0.0, None)
+        self._pool_fold_ttl_s = min(0.5, self._pool_scan_s)
         # the running request's execution-lease heartbeat (elastic only)
         self._exec_hb = None
         # open online streams by request id (kind: "stream"); entries
@@ -234,9 +242,21 @@ class ServeDaemon:
             # which member's front door accepted it — pool members use
             # this to leave a LIVE acceptor's streams alone
             extra["member"] = self.membership.member_id
-        self.journal.record_request(req.request_id, "accepted",
-                                    source=source, **extra,
-                                    **req.journal_fields())
+        try:
+            self.journal.record_request(req.request_id, "accepted",
+                                        source=source, **extra,
+                                        **req.journal_fields())
+        except Exception:
+            # the journal append failed (disk full, I/O error): the
+            # request was never acknowledged, so roll the admission all
+            # the way back — otherwise the tenant slot leaks forever and
+            # the id stays in the known set, poisoning the submitter's
+            # documented-correct retry as a 'duplicate'
+            self._streams.pop(req.request_id, None)
+            self.scheduler.mark_done(req)
+            self.scheduler.forget(req.request_id)
+            self._close_root_span(req, "error")
+            raise
         if req.kind != "stream":
             self.scheduler.enqueue_admitted(req)
         if req.kind == "stream":
@@ -321,11 +341,17 @@ class ServeDaemon:
     def _pool_tenant_inflight(self, tenant: str) -> int:
         """The scheduler's pool-wide fair-share view: how many of this
         tenant's requests are journaled non-terminal anywhere in the
-        pool (every member's front door folds the same journal)."""
+        pool (every member's front door folds the same journal).  The
+        fold is memoized for ``_pool_fold_ttl_s`` so a submission burst
+        costs one journal read, not one per request."""
         from iterative_cleaner_tpu.resilience.journal import REQUEST_TERMINAL
 
-        states = self.journal.request_states()
-        self._journal_read_ts = time.time()
+        now = time.time()
+        ts, states = self._pool_fold
+        if states is None or now - ts > self._pool_fold_ttl_s:
+            states = self.journal.request_states()
+            self._pool_fold = (now, states)
+            self._journal_read_ts = now
         return sum(1 for view in states.values()
                    if view.get("state") not in REQUEST_TERMINAL
                    and str(view.get("tenant") or "default") == str(tenant))
@@ -368,11 +394,6 @@ class ServeDaemon:
         for rid, view in states.items():
             if view.get("state") in REQUEST_TERMINAL:
                 continue
-            if (view.get("kind") or "clean") == "stream":
-                # live stream failover is a restart concern (recover
-                # replays journaled chunks); the loop-time scan only
-                # adopts batch requests
-                continue
             if self.scheduler.knows(rid):
                 continue
             if self._owned_elsewhere(rid, view, roster, claims):
@@ -382,6 +403,12 @@ class ServeDaemon:
             0 if shard_owner(rid, live) == self.membership.member_id else 1,
             rid))
         for rid in candidates:
+            if (states[rid].get("kind") or "clean") == "stream":
+                # a stream reaching here lost its acceptor (the member
+                # lease on its 'member' field lapsed — a live acceptor
+                # is _owned_elsewhere): replay it from journaled chunks
+                self._adopt_stream(rid, states[rid], now)
+                continue
             try:
                 req = ServeRequest.from_journal_entry(rid, states[rid])
                 self._open_root_span(req, source="pool")
@@ -399,6 +426,53 @@ class ServeDaemon:
                 break
             self.registry.counter_inc("serve_pool_adopted")
             self._say("serve: adopted %s from the pool" % rid)
+
+    def _adopt_stream(self, rid: str, view: dict, now: float) -> None:
+        """Adopt a dead acceptor's stream at loop time — the in-memory
+        session died with its member, so replay the journaled chunks
+        into a fresh one exactly like the restart path, then journal a
+        'running' line re-homing the stream's ``member`` field so peers
+        see the new live acceptor (and the client's re-POSTed chunks,
+        re-routed to any surviving front door, dedup against the
+        restored keys).  Without this, a stream whose acceptor crash-
+        restarted under a fresh member id — leaving the stale lease to
+        block recover() — would stay non-terminal forever.
+
+        Two survivors scanning concurrently are serialized through the
+        claim grammar: exactly one wins the adoption lease; it is
+        released once the re-home line landed (ownership rides the
+        member field + our live membership lease from then on)."""
+        work = request_work_key(rid)
+        won = self.journal.try_claim(
+            work, host=self.membership.host,
+            nonce=self.membership.member_id,
+            ttl_s=self.serve_config.member_ttl_s, now=now,
+            trace=({"trace_id": view["trace_id"]}
+                   if view.get("trace_id") else None))
+        if not won:
+            self.registry.counter_inc("serve_claim_lost")
+            return
+        try:
+            try:
+                req = ServeRequest.from_journal_entry(rid, view)
+            except RequestError as exc:
+                self.journal.record_request(rid, "failed",
+                                            error=f"unrecoverable: {exc}")
+                self.registry.counter_inc("serve_failed")
+                return
+            if not self._recover_stream(rid, req, view, source="pool",
+                                        fail_on_reject=False):
+                return
+            self.journal.record_request(rid, "running",
+                                        member=self.membership.member_id)
+            self.registry.counter_inc("serve_pool_adopted")
+            self._say("serve: adopted stream %s from the pool" % rid)
+        finally:
+            try:
+                self.journal.release(work, host=self.membership.host,
+                                     nonce=self.membership.member_id)
+            except OSError:
+                pass  # an unreleased adoption lease merely expires
 
     def _claim_for_execute(self, req: ServeRequest) -> bool:
         """Lease this request's execution through the journal before
@@ -842,19 +916,27 @@ class ServeDaemon:
                      fields["subint_p99_ms"], fields["recompiles_steady"]))
 
     def _recover_stream(self, rid: str, req: ServeRequest,
-                        view: dict) -> int:
-        """Restart path for a journaled open stream: re-admit (no queue),
-        replay its journaled chunk files from disk into a fresh session —
+                        view: dict, source: str = "recover",
+                        fail_on_reject: bool = True) -> int:
+        """Restart path for a journaled open stream (also the pool
+        adoption path, ``source="pool"``): re-admit (no queue), replay
+        its journaled chunk files from disk into a fresh session —
         counted ``online_replayed_subints``, never as new ingests — and
         restore the dedup keys so a client's re-POST of an already-
         journaled subint answers ``duplicate``.  A stream journaled
-        closed re-queues for the worker immediately."""
-        self._open_root_span(req, source="recover")
+        closed re-queues for the worker immediately.
+
+        ``fail_on_reject=False`` (the adoption path) treats an admission
+        Rejection as transient pressure: the stream stays journaled for
+        the next scan instead of failing terminally."""
+        self._open_root_span(req, source=source)
         try:
             self.scheduler.submit(req, already_journaled=True,
                                   enqueue=False)
         except Rejection as exc:
             self._root_spans.pop(rid, None)
+            if not fail_on_reject:
+                return 0
             self.journal.record_request(rid, "failed",
                                         error=f"unrecoverable: {exc}")
             self.registry.counter_inc("serve_failed")
@@ -1090,6 +1172,16 @@ class ServeDaemon:
                              if k.startswith("serve_")},
                             sort_keys=True)),
               flush=True)
+        from iterative_cleaner_tpu.telemetry.recorder import (
+            get_active,
+            set_active,
+        )
+
+        # release the process-global black box if it is still ours: an
+        # embedder outliving this daemon (the in-process tests) must not
+        # have ITS later watchdog trips dumped to our recorder path
+        if self.recorder is not None and get_active() is self.recorder:
+            set_active(None)
 
 
 def run_serve(serve_config: ServeConfig, base_config: CleanConfig, *,

@@ -12,10 +12,14 @@ Trust ladder (the PR 5 degradation pattern — verify, then fall back):
 an index entry is a CLAIM, not proof.  Before serving from cache the
 lookup re-verifies, per path,
 
-1. the input still matches the recorded signature (the key embeds it,
+1. the entry was recorded for THIS path (a ``cp -p`` copy or hardlink
+   of a cleaned input carries the same signature, but its output lives
+   next to the ORIGINAL path — a cross-path "hit" would answer done
+   without materializing this path's output; it misses instead),
+2. the input still matches the recorded signature (the key embeds it,
    and :func:`entry_is_current` re-checks — a rewritten input misses),
-2. the recorded output still exists,
-3. the output still matches its recorded signature (a truncated or
+3. the recorded output still exists,
+4. the output still matches its recorded signature (a truncated or
    hand-edited output is a corruption, not a hit).
 
 Any rung failing counts ``serve_cache_rejected`` and the request falls
@@ -64,6 +68,14 @@ class ResultCache:
                 return None  # unreadable input: let the fleet report it
             entry = index.get(self.journal.cache_key(sig, config_hash))
             if entry is None:
+                self._count("serve_cache_misses")
+                return None
+            if entry.get("path") != os.path.abspath(p):
+                # same content, different path (a cp -p copy or hardlink
+                # of a cleaned input): the recorded output belongs to the
+                # ORIGINAL path — serving it would journal this request
+                # done without ever materializing THIS path's output.
+                # A plain miss: the real clean writes the right file.
                 self._count("serve_cache_misses")
                 return None
             if not entry.get("out") or not entry_is_current(entry):

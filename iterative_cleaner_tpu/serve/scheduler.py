@@ -156,19 +156,25 @@ class ServeScheduler:
                     "queue_full",
                     f"queue at its bound ({self.queue_limit}); backpressure")
             inflight = self._inflight.get(req.tenant, 0)
+            # the admission check may see a larger POOL-wide count, but
+            # the stored counter stays strictly local: it only ever
+            # decrements on local mark_done, so folding pool work into
+            # it would inflate it permanently (spurious tenant_limit
+            # 429s long after the pool went idle)
+            effective = inflight
             if self.pool_inflight is not None and not already_journaled:
                 # fair-share across the POOL: the journal sees every
                 # member's unfinished requests; take the larger of the
                 # two views (the local one includes admitted-but-not-
                 # yet-journaled work the fold can't see yet)
                 try:
-                    inflight = max(inflight,
-                                   int(self.pool_inflight(req.tenant)))
+                    effective = max(inflight,
+                                    int(self.pool_inflight(req.tenant)))
                 except Exception:
                     # a torn journal read must not wedge admission:
                     # degrade to the per-host view
                     self._count("serve_pool_view_errors")
-            if inflight >= self.max_inflight:
+            if effective >= self.max_inflight:
                 self._count("serve_rejected")
                 raise Rejection(
                     "tenant_limit",

@@ -32,7 +32,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # droppings the suite must never leave in the repo root: every test runs
 # in tmp_path (or routes its outputs there), so any of these appearing
 # means a code path ignored its cwd/output directory again
-_STRAY_FILES = ("clean.log", "serve.flight.json", "serve.journal.jsonl")
+_STRAY_FILES = ("clean.log", "serve.flight.json", "serve.flight.1.json",
+                "serve.journal.jsonl")
 
 
 @pytest.fixture(scope="session", autouse=True)

@@ -307,6 +307,63 @@ def test_shard_owner_deterministic_over_dynamic_members():
     assert shard_owner("r", []) is None
 
 
+def test_scheduler_pool_view_never_inflates_local_counter():
+    # the pool fold is an ADMISSION input, not local state: storing it
+    # into the local counter (which only decrements on local mark_done)
+    # left a tenant permanently at its cap after transient pool load
+    reg = MetricsRegistry()
+    pool = {"n": 1}
+    s = ServeScheduler(queue_limit=16, max_inflight=2, registry=reg,
+                       pool_inflight=lambda tenant: pool["n"])
+    s.submit(ServeRequest("r1", ["/d/a.npz"]))  # effective 1 < 2: admitted
+    assert s._inflight["default"] == 1          # local work only
+    pool["n"] = 0  # the pool went idle
+    s.submit(ServeRequest("r2", ["/d/b.npz"]))
+    assert s._inflight["default"] == 2
+    for rid in ("r1", "r2"):
+        s.mark_done(ServeRequest(rid, ["/d/x.npz"]))
+    assert s._inflight == {}  # every slot released: no spurious 429s
+
+
+def test_result_cache_cross_path_same_signature_misses(tmp_path):
+    # a hardlink (or cp -p copy) of a cleaned input carries an identical
+    # file signature, but the indexed output belongs to the ORIGINAL
+    # path: a cross-path "hit" would answer done without materializing
+    # the new path's output file.  It must miss into a real clean.
+    reg = MetricsRegistry()
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    rc = ResultCache(j, registry=reg)
+    p, out = _write_cacheable(tmp_path, "a.npz")
+    j.record_cache(p, config_hash="cfg", out_path=out)
+    assert rc.lookup([p], "cfg") is not None  # the original hits
+
+    twin = str(tmp_path / "twin.npz")
+    os.link(p, twin)  # same inode: size, mtime_ns and head hash all match
+    assert rc.lookup([twin], "cfg") is None
+    assert reg.counters["serve_cache_misses"] == 1
+    assert not os.path.exists(default_out_path(twin))
+    assert rc.lookup([p], "cfg") is not None  # original still serves
+
+
+def test_compaction_ages_out_dead_cache_lines(tmp_path):
+    # a cache line whose signatures no longer verify can never hit again
+    # (lookup re-checks the same evidence) — compaction must drop it, or
+    # a long-lived daemon's journal grows one dead line per distinct
+    # input forever and every pool fold re-reads them all
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    pa, outa = _write_cacheable(tmp_path, "a.npz")
+    pb, outb = _write_cacheable(tmp_path, "b.npz")
+    j.record_cache(pa, config_hash="cfg", out_path=outa)
+    j.record_cache(pb, config_hash="cfg", out_path=outb)
+    assert len(j.cache_index()) == 2
+    os.unlink(outb)  # b's entry is now unverifiable: dead weight
+    assert j.compact()
+    idx = j.cache_index()
+    assert len(idx) == 1
+    (entry,) = idx.values()
+    assert entry["path"] == os.path.abspath(pa)
+
+
 # ------------------------------------------------ /healthz (satellite)
 
 def test_health_standalone_reports_membership_view(tmp_path):
@@ -401,6 +458,128 @@ def test_daemon_answers_identical_resubmission_from_cache(tmp_path):
         d._on_signal(signal.SIGTERM, None)
         t.join(30)
     assert not t.is_alive()
+
+
+# ------------------------------------- pool stream adoption + admission
+
+def _journal_dead_member_stream(tmp_path, j, rid, member, n_chunks=2):
+    """Journal an open stream as if ``member``'s front door accepted it
+    and ingested ``n_chunks`` subints before the member died."""
+    import numpy as np
+
+    from iterative_cleaner_tpu.online import StreamMeta
+
+    ar, _ = make_synthetic_archive(nsub=4, nchan=8, nbin=16, seed=33)
+    cube = ar.total_intensity()
+    chunks = []
+    for i in range(n_chunks):
+        p = str(tmp_path / ("%s_c%02d.npy" % (rid, i)))
+        np.save(p, cube[i])
+        chunks.append(p)
+    req = ServeRequest(rid, [], kind="stream",
+                       meta=StreamMeta.from_archive(ar).to_dict())
+    j.record_request(rid, "accepted", source="http", member=member,
+                     **req.journal_fields())
+    j.record_request(rid, "running", chunks=chunks,
+                     keys=[str(i) for i in range(n_chunks)],
+                     n_ingested=n_chunks)
+    return chunks
+
+
+def test_poll_pool_adopts_dead_acceptor_stream(tmp_path):
+    """The orphaned-stream fix: a crash-restarted acceptor re-joins
+    under a fresh member id while its predecessor's stale lease blocks
+    recover() — so the loop-time scan must adopt the stream once that
+    lease lapses (replaying journaled chunks, restoring dedup keys and
+    re-homing the 'member' field), while a LIVE acceptor's streams are
+    left strictly alone."""
+    now = time.time()
+    cfg = ServeConfig(journal_path=str(tmp_path / "j.jsonl"), http_port=0,
+                      join=True, member_ttl_s=30.0, flight_recorder="")
+    d = ServeDaemon(cfg, NUMPY_BASE, quiet=True)
+    j = d.journal
+    j.record_member("acceptor", "join", host=9, ttl_s=30.0, now=now)
+    chunks = _journal_dead_member_stream(tmp_path, j, "s1", "acceptor")
+    d.membership.join()
+
+    d._poll_pool(now)  # the acceptor is live: its stream stays its own
+    assert "s1" not in d._streams
+
+    # its lease lapses (SIGKILL, or a fast crash-restart under a fresh
+    # id): the next scan adopts instead of orphaning the stream forever
+    later = now + 60.0
+    d._poll_pool(later)
+    st = d._streams["s1"]
+    assert st.chunks == chunks and st.keys == {"0", "1"}
+    assert st.session is not None and not st.closed
+    assert d.registry.counters["serve_pool_adopted"] == 1
+    assert d.registry.counters["online_replayed_subints"] == 2
+    view = j.request_states()["s1"]
+    assert view["state"] == "running"
+    assert view["member"] == d.membership.member_id  # re-homed
+    # the adoption lease was released: ownership rides the member field
+    assert request_work_key("s1") not in j.claim_table(now=later)
+
+    # idempotent: a second scan never re-adopts
+    d._poll_pool(later + 1.0)
+    assert d.registry.counters["serve_pool_adopted"] == 1
+
+    # and a pool peer scanning now sees OUR live acceptance on it
+    d2 = ServeDaemon(cfg, NUMPY_BASE, quiet=True)
+    d2.membership.join()
+    d2._poll_pool(time.time())
+    assert "s1" not in d2._streams
+
+
+def test_admit_rolls_back_on_journal_append_failure(tmp_path):
+    # a failed 'accepted' append must not leak the tenant slot nor
+    # poison the id: the submitter never saw an ack, so its documented
+    # retry must admit cleanly instead of drawing 'duplicate' forever
+    cfg = ServeConfig(journal_path=str(tmp_path / "j.jsonl"), http_port=0,
+                      flight_recorder="")
+    d = ServeDaemon(cfg, NUMPY_BASE, quiet=True)
+    real = d.journal.record_request
+
+    def boom(*_a, **_k):
+        raise OSError("disk full")
+
+    d.journal.record_request = boom
+    with pytest.raises(OSError):
+        d.admit(ServeRequest("r1", ["/d/a.npz"]), source="http")
+    assert not d.scheduler.knows("r1")
+    assert d.scheduler._inflight == {}   # the slot was rolled back
+    assert d._root_spans == {}           # the root span was closed
+    with pytest.raises(OSError):
+        d.admit(ServeRequest("s1", [], kind="stream"), source="http")
+    assert d._streams == {}              # stream rollback drops the entry
+    d.journal.record_request = real
+    d.admit(ServeRequest("r1", ["/d/a.npz"]), source="http")
+    assert d.scheduler.knows("r1")
+    assert d.journal.request_states()["r1"]["state"] == "accepted"
+
+
+def test_pool_tenant_inflight_memoizes_the_fold(tmp_path):
+    # pool admission consults the journal fold under the scheduler lock;
+    # memoizing it briefly keeps a submission burst at one read
+    cfg = ServeConfig(journal_path=str(tmp_path / "j.jsonl"), http_port=0,
+                      join=True, member_ttl_s=30.0, flight_recorder="")
+    d = ServeDaemon(cfg, NUMPY_BASE, quiet=True)
+    calls = {"n": 0}
+    real = d.journal.request_states
+
+    def counted():
+        calls["n"] += 1
+        return real()
+
+    d.journal.request_states = counted
+    assert d._pool_tenant_inflight("t") == 0
+    assert d._pool_tenant_inflight("t") == 0  # inside the ttl: memoized
+    assert calls["n"] == 1
+    d.journal.record_request("r1", "accepted", tenant="t",
+                             paths=["/d/a.npz"])
+    d._pool_fold = (0.0, d._pool_fold[1])     # force expiry
+    assert d._pool_tenant_inflight("t") == 1  # fresh fold sees the line
+    assert calls["n"] == 2
 
 
 # -------------------------------------------- subprocess chaos drill

@@ -480,6 +480,11 @@ def _daemon(tmp_path, **serve_kw):
     serve_kw.setdefault("http_port", 0)
     serve_kw.setdefault("poll_s", 0.02)
     serve_kw.setdefault("journal_path", str(tmp_path / "serve.jsonl"))
+    # never the cwd-relative default: an in-process daemon's recorder
+    # becomes the process-global active one, and a later watchdog trip
+    # anywhere in the suite would dump it into the repo root
+    serve_kw.setdefault("flight_recorder",
+                        str(tmp_path / "serve.flight.json"))
     cfg = ServeConfig(**serve_kw)
     return ServeDaemon(cfg, NUMPY_BASE, quiet=True)
 
