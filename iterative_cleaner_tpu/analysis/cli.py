@@ -1,13 +1,23 @@
 """`icln-lint` console entry point and the --selfcheck driver.
 
+Besides the AST rules and the jaxpr contract verifier, two concurrency
+gates live here: ``--journal-fsck PATH`` validates an on-disk fleet
+journal against the protocol state machine
+(:mod:`~iterative_cleaner_tpu.analysis.journal_fsck`), and
+``--race-sweep`` runs the deterministic interleaving model checker
+(:mod:`~iterative_cleaner_tpu.analysis.interleave`) over every protocol
+scenario — a failing schedule is minimized and written to
+``--race-out`` as the CI artifact.
+
 Exit codes: 0 clean (suppressed findings allowed), 1 unsuppressed
-findings or jaxpr contract violations, 2 usage/internal error — so CI
-can gate on the bare exit status.
+findings, contract violations, fsck errors or a race counterexample,
+2 usage/internal error — so CI can gate on the bare exit status.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import Optional, Sequence
 
@@ -39,19 +49,104 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "explicit paths")
     p.add_argument("--show-suppressed", action="store_true",
                    help="include suppressed findings in text output")
+    p.add_argument("--journal-fsck", action="append", default=[],
+                   metavar="JOURNAL",
+                   help="validate a fleet journal file against the "
+                        "protocol state machine (grammar, request "
+                        "lifecycle, lease monotonicity, torn tail); "
+                        "repeatable; standalone — skips the lint pass")
+    p.add_argument("--race-sweep", action="store_true",
+                   help="run the deterministic interleaving model "
+                        "checker over every journal-lease protocol "
+                        "scenario (exhaustive DFS + seeded random "
+                        "tail); standalone — skips the lint pass")
+    p.add_argument("--race-schedules", type=int, default=5000,
+                   help="max schedules explored per scenario "
+                        "(default: 5000)")
+    p.add_argument("--race-budget", type=float, default=None,
+                   help="wall-clock budget in seconds for the whole "
+                        "sweep (default: $ICLEAN_RACE_BUDGET_S or 120)")
+    p.add_argument("--race-seed", type=int, default=0,
+                   help="seed for the bounded-random tail (default: 0)")
+    p.add_argument("--race-out", metavar="PATH", default=None,
+                   help="write the minimized counterexample schedule "
+                        "here when the sweep fails (the CI artifact)")
     return p
+
+
+def run_journal_fsck(paths: Sequence[str], *, fmt: str = "text",
+                     stream=None, registry=None) -> int:
+    """Fsck each journal; exit 0 only when every one is error-free."""
+    out = stream if stream is not None else sys.stdout
+    from iterative_cleaner_tpu.analysis.journal_fsck import (
+        fsck_journal,
+        record_fsck,
+    )
+
+    ok = True
+    reports = []
+    for path in paths:
+        report = fsck_journal(path)
+        reports.append(report)
+        ok = ok and report.ok
+        if registry is not None:
+            record_fsck(registry, report)
+        if fmt != "json":
+            print(report.render_text(), file=out)
+    if fmt == "json":
+        import json
+
+        print(json.dumps({"ok": ok,
+                          "journals": [r.to_dict() for r in reports]},
+                         indent=2, sort_keys=True), file=out)
+    return 0 if ok else 1
+
+
+def run_race_sweep(*, max_schedules: int = 5000,
+                   budget_s: Optional[float] = None, seed: int = 0,
+                   out_path: Optional[str] = None, stream=None) -> int:
+    """Model-check every clean protocol scenario; on failure, write the
+    minimized counterexample schedule to ``out_path``."""
+    out = stream if stream is not None else sys.stdout
+    if budget_s is None:
+        budget_s = float(os.environ.get("ICLEAN_RACE_BUDGET_S", "120"))
+    from iterative_cleaner_tpu.analysis.interleave import sweep
+
+    results = sweep(max_schedules=max_schedules, budget_s=budget_s,
+                    seed=seed, stream=out)
+    failed = [r for r in results if not r.ok]
+    if failed and out_path:
+        from iterative_cleaner_tpu.io.atomic import atomic_output
+
+        with atomic_output(out_path) as tmp:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for r in failed:
+                    f.write(r.render() + "\n")
+        print(f"race-sweep: counterexample written to {out_path}",
+              file=out)
+    if not failed and all(not r.budget_exhausted for r in results):
+        print("race-sweep: all scenarios explored exhaustively",
+              file=out)
+    return 0 if not failed else 1
 
 
 def run_selfcheck(*, paths: Optional[Sequence[str]] = None,
                   fmt: str = "text", jaxpr: bool = True,
                   show_suppressed: bool = False,
+                  journal_fsck: Sequence[str] = (),
                   registry=None, stream=None) -> int:
     """Lint + (optionally) verify the jaxpr contracts; render a report.
 
+    ``journal_fsck`` paths are additionally validated against the
+    journal state machine and count toward the exit status.
     ``registry`` receives ``lint_findings{rule=...}`` counters when
     given, so the serve daemon and the --precompile session export
     analyzer results alongside their run metrics."""
     out = stream if stream is not None else sys.stdout
+    fsck_rc = 0
+    if journal_fsck:
+        fsck_rc = run_journal_fsck(journal_fsck, fmt="text", stream=out,
+                                   registry=registry)
     report = lint_paths(paths)
     program_reports = []
     if jaxpr:
@@ -71,7 +166,7 @@ def run_selfcheck(*, paths: Optional[Sequence[str]] = None,
         if jaxpr:
             registry.gauge_set("jaxpr_contract_violations",
                                len(violations))
-    ok = report.ok and not violations
+    ok = report.ok and not violations and fsck_rc == 0
     if fmt == "json":
         print(report_json(report, {
             "jaxpr": [r.to_dict() for r in program_reports],
@@ -119,7 +214,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     jaxpr = not args.no_jaxpr if not args.paths else args.jaxpr
     if args.jaxpr and args.no_jaxpr:
         build_arg_parser().error("--jaxpr and --no-jaxpr conflict")
+    if (args.journal_fsck or args.race_sweep) and args.paths:
+        build_arg_parser().error(
+            "--journal-fsck/--race-sweep are standalone gates and take "
+            "no lint paths")
     try:
+        if args.journal_fsck or args.race_sweep:
+            rc = 0
+            if args.journal_fsck:
+                rc = max(rc, run_journal_fsck(args.journal_fsck,
+                                              fmt=args.format))
+            if args.race_sweep:
+                rc = max(rc, run_race_sweep(
+                    max_schedules=args.race_schedules,
+                    budget_s=args.race_budget, seed=args.race_seed,
+                    out_path=args.race_out))
+            return rc
         return run_selfcheck(paths=args.paths or None, fmt=args.format,
                              jaxpr=jaxpr,
                              show_suppressed=args.show_suppressed)

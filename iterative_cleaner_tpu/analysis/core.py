@@ -217,6 +217,7 @@ def default_rules() -> List[Rule]:
         rules_io,
         rules_jit,
         rules_project,
+        rules_threads,
     )
 
     return [
@@ -230,6 +231,9 @@ def default_rules() -> List[Rule]:
         rules_project.ConfigIdentityRule(),
         rules_project.EnvDriftRule(),
         rules_project.FlagDocsRule(),
+        rules_threads.ThreadSharedStateRule(),
+        rules_threads.ThreadLockOrderRule(),
+        rules_threads.JournalClaimRule(),
     ]
 
 

@@ -35,6 +35,7 @@ ENV_ONLY = frozenset({
     "ICLEAN_SCALER_VMEM_MB",
     "ICLEAN_BUILDER_CACHE",     # lru_cache bound for the batch builders
     "ICLEAN_FAULT_HANG_S",      # fault-injection hang duration
+    "ICLEAN_RACE_BUDGET_S",     # model-checker sweep wall-clock budget
 })
 
 _ENV_RE = re.compile(r"\bICLEAN_[A-Z0-9_]+\b")

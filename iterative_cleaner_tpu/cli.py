@@ -174,6 +174,14 @@ def build_parser() -> argparse.ArgumentParser:
                         help="--selfcheck output format (default text; "
                              "json prints one machine-readable report "
                              "document for CI).")
+    parser.add_argument("--journal-fsck", action="append", default=[],
+                        metavar="JOURNAL", dest="journal_fsck",
+                        help="With --selfcheck: additionally validate "
+                             "a fleet journal file against the "
+                             "protocol state machine (request "
+                             "lifecycle, claim/member lease grammar, "
+                             "torn-tail healing, lease monotonicity). "
+                             "Repeatable; fsck errors fail the check.")
     parser.add_argument("--no-donate", "--no_donate", action="store_true",
                         dest="no_donate",
                         help="Disable buffer donation on the jax hot "
@@ -1260,13 +1268,18 @@ def main(argv=None) -> int:
                 "no archives or run modes")
         from iterative_cleaner_tpu.analysis.cli import run_selfcheck
 
-        return run_selfcheck(fmt=args.selfcheck_format or "text")
+        return run_selfcheck(fmt=args.selfcheck_format or "text",
+                             journal_fsck=args.journal_fsck)
     if args.selfcheck_format is not None:
         # a silently ignored flag would mislead (same contract as
         # --bucket-pad)
         build_parser().error(
             "--format/--selfcheck-format only applies to --selfcheck; "
             "pass --selfcheck")
+    if args.journal_fsck:
+        build_parser().error(
+            "--journal-fsck only applies to --selfcheck; pass "
+            "--selfcheck (or use the icln-lint console script)")
 
     # pure-argument validation first: never make a bad invocation wait
     # out the device probe below before erroring

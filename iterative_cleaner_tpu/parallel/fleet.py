@@ -943,6 +943,7 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
             # journal strictly after the (atomic) output write succeeded:
             # a crash between the two re-cleans the archive on resume —
             # never the reverse (a journaled path with no output)
+            # icln: ignore[journal-append-without-claim] -- runs under the bucket lease: _serve_multihost try_claim'd it before serve()
             res.journal.record_done(
                 path, config_hash=cfg_hash,
                 out_path=out_path_fn(path) if out_path_fn else None,

@@ -155,6 +155,11 @@ class ServeDaemon:
             spool_path=(spool_path_for(self.trace_out)
                         if self.trace_out else None),
             events=events, recorder=self.recorder)
+        # leaf lock for daemon-local maps written from both the HTTP
+        # handler threads and the worker loop (_streams, _root_spans,
+        # _pool_fold, _journal_read_ts): held only around the dict/
+        # scalar op itself, NEVER across a journal or scheduler call
+        self._state_lock = threading.Lock()
         self._root_spans: Dict[str, object] = {}
         # elastic pool membership (--join): None for a standalone daemon
         self.membership = None
@@ -233,10 +238,12 @@ class ServeDaemon:
             # never queued here: the worker only runs it once it closes
             self.scheduler.submit(req, enqueue=False)
         except Rejection:
-            self._root_spans.pop(req.request_id, None)  # never admitted
+            with self._state_lock:
+                self._root_spans.pop(req.request_id, None)  # never admitted
             raise
         if req.kind == "stream":
-            self._streams[req.request_id] = _StreamState(req=req)
+            with self._state_lock:
+                self._streams[req.request_id] = _StreamState(req=req)
         extra = {}
         if self.membership is not None:
             # which member's front door accepted it — pool members use
@@ -252,7 +259,8 @@ class ServeDaemon:
             # the way back — otherwise the tenant slot leaks forever and
             # the id stays in the known set, poisoning the submitter's
             # documented-correct retry as a 'duplicate'
-            self._streams.pop(req.request_id, None)
+            with self._state_lock:
+                self._streams.pop(req.request_id, None)
             self.scheduler.mark_done(req)
             self.scheduler.forget(req.request_id)
             self._close_root_span(req, "error")
@@ -286,7 +294,8 @@ class ServeDaemon:
             now = time.time()
             roster = self.membership.members(now=now)
             claims = self.journal.claim_table(now=now)
-            self._journal_read_ts = now
+            with self._state_lock:
+                self._journal_read_ts = now
         for rid, view in sorted(self.journal.request_states().items()):
             if view.get("state") in REQUEST_TERMINAL:
                 continue
@@ -302,7 +311,8 @@ class ServeDaemon:
             except (RequestError, Rejection) as exc:
                 # un-replayable (compacted away, corrupt, or beyond the
                 # queue bound): fail it terminally rather than loop on it
-                self._root_spans.pop(rid, None)
+                with self._state_lock:
+                    self._root_spans.pop(rid, None)
                 self.journal.record_request(rid, "failed",
                                             error=f"unrecoverable: {exc}")
                 self.registry.counter_inc("serve_failed")
@@ -347,11 +357,13 @@ class ServeDaemon:
         from iterative_cleaner_tpu.resilience.journal import REQUEST_TERMINAL
 
         now = time.time()
-        ts, states = self._pool_fold
+        with self._state_lock:
+            ts, states = self._pool_fold
         if states is None or now - ts > self._pool_fold_ttl_s:
             states = self.journal.request_states()
-            self._pool_fold = (now, states)
-            self._journal_read_ts = now
+            with self._state_lock:
+                self._pool_fold = (now, states)
+                self._journal_read_ts = now
         return sum(1 for view in states.values()
                    if view.get("state") not in REQUEST_TERMINAL
                    and str(view.get("tenant") or "default") == str(tenant))
@@ -388,7 +400,8 @@ class ServeDaemon:
         states = self.journal.request_states()
         claims = self.journal.claim_table(now=now)
         roster = self.membership.members(now=now)
-        self._journal_read_ts = now
+        with self._state_lock:
+            self._journal_read_ts = now
         live = [m for m, lease in roster.items() if lease["live"]]
         candidates = []
         for rid, view in states.items():
@@ -414,7 +427,8 @@ class ServeDaemon:
                 self._open_root_span(req, source="pool")
                 self.scheduler.submit(req, already_journaled=True)
             except RequestError as exc:
-                self._root_spans.pop(rid, None)
+                with self._state_lock:
+                    self._root_spans.pop(rid, None)
                 self.journal.record_request(rid, "failed",
                                             error=f"unrecoverable: {exc}")
                 self.registry.counter_inc("serve_failed")
@@ -422,7 +436,8 @@ class ServeDaemon:
             except Rejection:
                 # our queue is full right now; the request stays
                 # journaled and the next scan (or another member) takes it
-                self._root_spans.pop(rid, None)
+                with self._state_lock:
+                    self._root_spans.pop(rid, None)
                 break
             self.registry.counter_inc("serve_pool_adopted")
             self._say("serve: adopted %s from the pool" % rid)
@@ -469,8 +484,12 @@ class ServeDaemon:
             self._say("serve: adopted stream %s from the pool" % rid)
         finally:
             try:
+                # stamp with the same scan clock as the claim: a release
+                # stamped behind its own claim line breaks the journal's
+                # lease monotonicity (fsck flags it as replayed lines)
                 self.journal.release(work, host=self.membership.host,
-                                     nonce=self.membership.member_id)
+                                     nonce=self.membership.member_id,
+                                     now=now)
             except OSError:
                 pass  # an unreleased adoption lease merely expires
 
@@ -542,10 +561,12 @@ class ServeDaemon:
             lane="serve", request_id=req.request_id, tenant=req.tenant,
             source=source, n_paths=len(req.paths))
         req.root_span_id = root.span_id
-        self._root_spans[req.request_id] = root
+        with self._state_lock:
+            self._root_spans[req.request_id] = root
 
     def _close_root_span(self, req: ServeRequest, status: str) -> None:
-        root = self._root_spans.pop(req.request_id, None)
+        with self._state_lock:
+            root = self._root_spans.pop(req.request_id, None)
         if root is not None:
             root.end(status=status)
 
@@ -597,7 +618,8 @@ class ServeDaemon:
         /requests/<id>) — reading the journal means the answer survives
         restarts and never races the worker loop."""
         view = self.journal.request_states().get(request_id)
-        self._journal_read_ts = time.time()
+        with self._state_lock:
+            self._journal_read_ts = time.time()
         if view is None:
             return None
         doc = {k: view[k] for k in _STATUS_FIELDS if k in view}
@@ -786,6 +808,10 @@ class ServeDaemon:
             n = self._ingest_chunk(st, str(chunk_path))
             st.chunks.append(str(chunk_path))
             st.keys.add(key)
+            # an open stream is acceptor-local while the acceptor's
+            # MEMBERSHIP lease lives (peers see it as owned via the
+            # 'member' field); the execution claim exists from close on
+            # icln: ignore[journal-append-without-claim] -- acceptor-owned line
             self.journal.record_request(
                 request_id, "running", chunks=list(st.chunks),
                 keys=sorted(st.keys), n_ingested=len(st.chunks))
@@ -811,6 +837,9 @@ class ServeDaemon:
                     f"stream {request_id!r} has no ingested subints; "
                     f"POST at least one chunk before closing")
             st.closed = True
+            # the close line is still the acceptor's (membership lease,
+            # not execution claim): the worker claims when it pops
+            # icln: ignore[journal-append-without-claim] -- acceptor-owned line
             self.journal.record_request(
                 request_id, "running", closed=True,
                 chunks=list(st.chunks), keys=sorted(st.keys),
@@ -861,7 +890,8 @@ class ServeDaemon:
         batch by construction) and write the cleaned archive."""
         from iterative_cleaner_tpu import io as ar_io
 
-        st = self._streams.pop(req.request_id, None)
+        with self._state_lock:
+            st = self._streams.pop(req.request_id, None)
         self._running_id = req.request_id
         self.journal.record_request(req.request_id, "running")
         t0 = time.perf_counter()
@@ -934,7 +964,8 @@ class ServeDaemon:
             self.scheduler.submit(req, already_journaled=True,
                                   enqueue=False)
         except Rejection as exc:
-            self._root_spans.pop(rid, None)
+            with self._state_lock:
+                self._root_spans.pop(rid, None)
             if not fail_on_reject:
                 return 0
             self.journal.record_request(rid, "failed",
@@ -942,14 +973,16 @@ class ServeDaemon:
             self.registry.counter_inc("serve_failed")
             return 0
         st = _StreamState(req=req)
-        self._streams[rid] = st
+        with self._state_lock:
+            self._streams[rid] = st
         chunks = [str(c) for c in (view.get("chunks") or [])]
         try:
             for chunk in chunks:
                 self._ingest_chunk(st, chunk)
                 st.chunks.append(chunk)
         except (RequestError, Rejection) as exc:
-            self._streams.pop(rid, None)
+            with self._state_lock:
+                self._streams.pop(rid, None)
             self.scheduler.mark_done(req)
             self._close_root_span(req, "failed")
             self.journal.record_request(
@@ -974,7 +1007,8 @@ class ServeDaemon:
         (the journal is the source of truth, so the index survives
         restarts and includes terminal requests)."""
         states = self.journal.request_states()
-        self._journal_read_ts = time.time()
+        with self._state_lock:
+            self._journal_read_ts = time.time()
         return {
             "n": len(states),
             "requests": [

@@ -69,8 +69,11 @@ class PoolMembership:
         self.journal.record_member(self.member_id, "join",
                                    host=self.host, ttl_s=self.ttl_s,
                                    now=now)
-        self._joined = True
-        self._last_beat = now
+        # under the same lock heartbeat() takes: an auto-beat thread
+        # started early must see join's throttle stamp, not a torn pair
+        with self._lock:
+            self._joined = True
+            self._last_beat = now
         self._seen_live.add(self.member_id)
         self._gauge(now)
 

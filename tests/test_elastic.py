@@ -29,6 +29,7 @@ import urllib.request
 
 import pytest
 
+from iterative_cleaner_tpu.analysis.journal_fsck import fsck_journal
 from iterative_cleaner_tpu.config import CleanConfig, ServeConfig
 from iterative_cleaner_tpu.io import make_synthetic_archive, save_archive
 from iterative_cleaner_tpu.parallel.distributed import shard_owner
@@ -142,11 +143,18 @@ def test_compaction_heals_torn_tail_then_folds_members(tmp_path):
     j.record_member("m2", "join", host=2, ttl_s=1e6, now=now)
     roster = j.member_table(now=now + 1)
     assert set(roster) == {"m1", "m2"}
+    # fsck agrees: the healed torn line is a warning, never a gate failure
+    report = fsck_journal(j.path)
+    assert report.ok
+    assert [i.kind for i in report.warnings] == ["torn-line"]
     assert j.compact()
     roster = j.member_table(now=now + 1)
     assert set(roster) == {"m1", "m2"}
     for ln in open(j.path).read().splitlines():
         json.loads(ln)  # every surviving line is whole
+    # compaction dropped the torn debris: fully clean now
+    report = fsck_journal(j.path)
+    assert report.ok and not report.issues
 
 
 # ------------------------------------------------------- PoolMembership
@@ -458,6 +466,11 @@ def test_daemon_answers_identical_resubmission_from_cache(tmp_path):
         d._on_signal(signal.SIGTERM, None)
         t.join(30)
     assert not t.is_alive()
+    # three full accept→claim→done round trips (one cache hit, one
+    # cache rejection) plus membership traffic must fsck clean
+    report = fsck_journal(cfg.journal_path)
+    assert report.ok, [i.render() for i in report.issues]
+    assert report.counts["req"] >= 3 and report.counts["cache"] >= 1
 
 
 # ------------------------------------- pool stream adoption + admission
@@ -529,6 +542,10 @@ def test_poll_pool_adopts_dead_acceptor_stream(tmp_path):
     d2.membership.join()
     d2._poll_pool(time.time())
     assert "s1" not in d2._streams
+    # the crash + adoption + re-home history still fscks clean: the
+    # adoption path journals only well-formed, claim-disciplined lines
+    report = fsck_journal(j.path)
+    assert report.ok, [i.render() for i in report.issues]
 
 
 def test_admit_rolls_back_on_journal_append_failure(tmp_path):

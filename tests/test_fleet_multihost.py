@@ -251,6 +251,12 @@ def test_two_worker_threads_share_slice_exactly_once(tmp_path):
     assert set(fullest.host_counters) == {0, 1}
     assert sum(c.get("fleet_cleaned", 0)
                for c in fullest.host_counters.values()) == len(paths)
+    # the journal two racing workers wrote must fsck clean end to end
+    from iterative_cleaner_tpu.analysis.journal_fsck import fsck_journal
+
+    report = fsck_journal(jpath)
+    assert report.ok, [i.render() for i in report.issues]
+    assert not report.issues
 
 
 @pytest.mark.slow

@@ -1,0 +1,203 @@
+"""Journal fsck (analysis/journal_fsck.py): the protocol state machine
+over on-disk journals.
+
+Every journal the FleetJournal API itself produces must fsck clean —
+including after compaction and after a torn-tail heal (a warning, never
+an error).  Synthetic corruptions exercise each checker: grammar
+(foreign schema, unknown events, missing typed fields, mis-keyed cache
+lines), the request lifecycle state machine (after-terminal, duplicate
+terminal, rank regression — the admit-ordering hazard), and lease
+monotonicity for claim/member stamps.
+"""
+
+import json
+
+import pytest
+
+from iterative_cleaner_tpu.analysis.journal_fsck import (
+    FsckReport,
+    fsck_journal,
+    fsck_text,
+    record_fsck,
+)
+from iterative_cleaner_tpu.resilience.journal import SCHEMA, FleetJournal
+from iterative_cleaner_tpu.telemetry.registry import MetricsRegistry
+
+
+def _line(**fields) -> str:
+    entry = {"schema": SCHEMA}
+    entry.update(fields)
+    return json.dumps(entry) + "\n"
+
+
+def _kinds(issues):
+    return sorted({i.kind for i in issues})
+
+
+# ------------------------------------------------------ API-produced text
+
+def _real_journal(tmp_path) -> FleetJournal:
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    j.record_request("r1", "accepted", paths=["/a.npz"], tenant="t")
+    j.record_request("r1", "running")
+    j.try_claim("req:r1", host=1, nonce="n1", ttl_s=30.0)
+    j.heartbeat("req:r1", host=1, nonce="n1", ttl_s=30.0)
+    j.record_member("mA", "join", host=1, ttl_s=30.0)
+    j.record_member("mA", "hb", host=1, ttl_s=30.0)
+    j.record_request("r1", "done")
+    j.release("req:r1", host=1, nonce="n1")
+    j.record_host_stats(1, {"cleaned": 3})
+    return j
+
+
+def test_api_produced_journal_fscks_clean(tmp_path):
+    j = _real_journal(tmp_path)
+    report = fsck_journal(j.path)
+    assert report.ok and not report.issues
+    assert report.counts["req"] == 3
+    assert report.counts["claim"] == 3
+    assert report.counts["member"] == 2
+    assert report.counts["stats"] == 1
+
+
+def test_compacted_journal_still_fscks_clean(tmp_path):
+    j = _real_journal(tmp_path)
+    j.compact()
+    report = fsck_journal(j.path)
+    assert report.ok, [i.render() for i in report.issues]
+
+
+def test_torn_tail_is_a_warning_not_an_error(tmp_path):
+    j = _real_journal(tmp_path)
+    with open(j.path, "a") as f:
+        f.write('{"schema": "icln-fleet-journal/1", "event": "mem')
+    report = fsck_journal(j.path)
+    assert report.ok  # warnings never fail the gate
+    assert [i.kind for i in report.warnings] == ["torn-line"]
+    assert "torn tail" in report.warnings[0].message
+    # the next append heals it; a healed mid-file torn line still warns
+    j.record_member("mB", "join", host=2, ttl_s=30.0)
+    report = fsck_journal(j.path)
+    assert report.ok
+    assert "healed" in report.warnings[0].message
+
+
+def test_missing_journal_is_an_error(tmp_path):
+    report = fsck_journal(str(tmp_path / "never-written.jsonl"))
+    assert not report.ok
+
+
+# ------------------------------------------------- request state machine
+
+def test_state_after_terminal_is_flagged():
+    text = (_line(event="req", req="x", state="done")
+            + _line(event="req", req="x", state="accepted"))
+    issues, _, _ = fsck_text(text)
+    assert _kinds(issues) == ["state-machine"]
+    assert "after terminal" in issues[0].message
+
+
+def test_duplicate_terminal_is_flagged():
+    text = (_line(event="req", req="x", state="failed")
+            + _line(event="req", req="x", state="failed"))
+    issues, _, _ = fsck_text(text)
+    assert "duplicate terminal" in issues[0].message
+
+
+def test_rank_regression_names_the_admit_ordering_hazard():
+    text = (_line(event="req", req="x", state="running")
+            + _line(event="req", req="x", state="accepted"))
+    issues, _, _ = fsck_text(text)
+    assert _kinds(issues) == ["state-machine"]
+    assert "admit-ordering" in issues[0].message
+
+
+def test_normal_lifecycle_and_idempotent_running_are_clean():
+    text = (_line(event="req", req="x", state="accepted")
+            + _line(event="req", req="x", state="running")
+            + _line(event="req", req="x", state="running")  # re-poll
+            + _line(event="req", req="x", state="done"))
+    issues, _, _ = fsck_text(text)
+    assert issues == []
+
+
+# ------------------------------------------------------------- grammar
+
+@pytest.mark.parametrize("text,expect", [
+    ('["not", "an", "object"]\n', "not an object"),
+    (_line(event="req", req="x", state="accepted").replace(
+        SCHEMA, "someone-elses/9"), "foreign or missing schema"),
+    (_line(event="wat"), "unknown event"),
+    (_line(event="req", req="x", state="paused"), "not one of"),
+    (_line(event="claim", work="w", host="one", nonce="n",
+           state="claim", t=1.0, ttl=1.0), "host"),
+    (_line(event="claim", work="w", host=1, nonce="n", state="claim",
+           t=1.0, ttl=-2.0), "negative"),
+    (_line(event="done", path="/a", sig="s", config="c", out="/o"),
+        "out_sig"),
+    (_line(event="stats", host=1, counters={"n": True}), "not numeric"),
+    (_line(event="cache", key="wrong", path="/a", sig="s", config="c",
+           out="/o", out_sig="os"), "mis-keyed"),
+])
+def test_grammar_violations_are_errors(text, expect):
+    issues, _, _ = fsck_text(text)
+    errors = [i for i in issues if i.severity == "error"]
+    assert errors, f"expected an error mentioning {expect!r}"
+    assert any(expect in i.message for i in errors)
+
+
+def test_blank_lines_are_ignored():
+    text = ("\n\n" + _line(event="req", req="x", state="accepted") + "\n")
+    issues, counts, _ = fsck_text(text)
+    assert issues == [] and counts["req"] == 1
+
+
+# ----------------------------------------------------- lease monotonicity
+
+def test_backwards_lease_stamp_is_flagged():
+    text = (_line(event="claim", work="w", host=1, nonce="a",
+                  state="claim", t=100.0, ttl=30.0)
+            + _line(event="claim", work="w", host=2, nonce="b",
+                    state="claim", t=90.0, ttl=30.0))
+    issues, _, _ = fsck_text(text)
+    assert _kinds(issues) == ["lease-monotonicity"]
+
+
+def test_skew_tolerance_allows_small_backwards_stamps():
+    text = (_line(event="member", member="m", host=1, state="join",
+                  t=100.0, ttl=30.0)
+            + _line(event="member", member="m", host=1, state="hb",
+                    t=99.5, ttl=30.0))
+    issues, _, _ = fsck_text(text, skew_s=1.0)
+    assert issues == []
+    issues, _, _ = fsck_text(text)
+    assert _kinds(issues) == ["lease-monotonicity"]
+
+
+# --------------------------------------------------------------- surfaces
+
+def test_report_render_and_dict_roundtrip(tmp_path):
+    j = _real_journal(tmp_path)
+    report = fsck_journal(j.path)
+    assert "ok" in report.render_text()
+    d = report.to_dict()
+    assert d["ok"] and d["n_lines"] == report.n_lines
+
+
+def test_record_fsck_publishes_metrics(tmp_path):
+    j = _real_journal(tmp_path)
+    with open(j.path, "a") as f:
+        f.write('{"schema": "icln-fleet-journal/1", "event": "mem')
+    reg = MetricsRegistry()
+    record_fsck(reg, fsck_journal(j.path))
+    assert reg.gauges["journal_fsck_ok"] == 1
+    assert reg.gauges["journal_fsck_lines"] > 0
+    assert reg.counters["journal_fsck_warnings{kind=torn-line}"] == 1
+
+    bad = FsckReport(path="x")
+    bad.issues, bad.counts, bad.n_lines = fsck_text(
+        _line(event="req", req="x", state="done")
+        + _line(event="req", req="x", state="running"))
+    record_fsck(reg, bad)
+    assert reg.gauges["journal_fsck_ok"] == 0
+    assert reg.counters["journal_fsck_errors{kind=state-machine}"] == 1

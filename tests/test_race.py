@@ -1,0 +1,155 @@
+"""Interleaving model checker (analysis/interleave.py): the journal-lease
+protocol under systematic schedule exploration.
+
+The contract has two halves.  Soundness: every seeded-bug scenario — an
+in-memory revert of a known fix (the PR-12 admit-ordering and pool-count
+fixes among them) — must be CAUGHT, with a minimized counterexample
+schedule that replays deterministically.  Completeness-in-the-small: the
+clean scenarios explore exhaustively (DFS terminates before the
+schedule cap) and come back green, and partial-order reduction shrinks
+the schedule count without losing any bug.
+
+Also here: the one-line regression tests for the shared-state fixes the
+thread rules surfaced in this PR (membership join stamping the
+heartbeat throttle under the lock; the serve daemon's ``_state_lock``).
+"""
+
+import threading
+
+import pytest
+
+from iterative_cleaner_tpu.analysis.interleave import (
+    SCENARIOS,
+    build_scenario,
+    explore,
+    render_counterexample,
+    run_schedule,
+)
+
+ALL_BUGS = [(name, bug) for name in sorted(SCENARIOS)
+            for bug in SCENARIOS[name]]
+
+
+# ------------------------------------------------------------ soundness
+
+@pytest.mark.parametrize("name,bug", ALL_BUGS,
+                         ids=[f"{n}--{b}" for n, b in ALL_BUGS])
+def test_seeded_bug_is_caught_with_minimized_counterexample(name, bug):
+    res = explore(build_scenario(name, bug=bug), max_schedules=5000,
+                  budget_s=60.0)
+    assert not res.ok, f"seeded bug {name}/{bug} escaped the checker"
+    cx = res.counterexample
+    assert cx is not None and cx.failure is not None
+    # the minimized schedule must REPLAY to the same failure
+    replay = run_schedule(build_scenario(name, bug=bug), cx.choices)
+    assert replay.failure is not None
+    assert replay.failure["type"] == cx.failure["type"]
+    # and render as a numbered, human-replayable trace
+    text = render_counterexample(cx)
+    assert "step" in text and "schedule=" in text
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_clean_scenario_explores_exhaustively_green(name):
+    res = explore(build_scenario(name), max_schedules=5000,
+                  budget_s=90.0)
+    assert res.ok, res.render()
+    assert not res.budget_exhausted, \
+        f"{name} did not finish its exhaustive sweep: {res.render()}"
+    assert res.schedules > 1  # a real exploration, not a single run
+
+
+# ---------------------------------------------------------- determinism
+
+def test_same_prefix_replays_the_same_schedule():
+    scenario = build_scenario("claim-race")
+    a = run_schedule(scenario, ())
+    b = run_schedule(build_scenario("claim-race"), a.choices)
+    assert a.choices == b.choices
+    assert [d.op for d in a.decisions] == [d.op for d in b.decisions]
+
+
+def test_random_mode_is_seed_deterministic():
+    runs = []
+    for _ in range(2):
+        res = explore(build_scenario("admit-order", bug="admit-order"),
+                      mode="random", seed=7, max_schedules=200,
+                      budget_s=60.0)
+        assert not res.ok
+        runs.append(res.counterexample.choices)
+    assert runs[0] == runs[1]
+
+
+# -------------------------------------------------- POR: sound + smaller
+
+def test_por_prunes_schedules_without_losing_the_race():
+    full = explore(build_scenario("claim-race", bug="no-readback"),
+                   por=False, max_schedules=5000, budget_s=60.0)
+    pruned = explore(build_scenario("claim-race", bug="no-readback"),
+                     por=True, max_schedules=5000, budget_s=60.0)
+    assert not full.ok and not pruned.ok  # both find the bug
+    clean_full = explore(build_scenario("claim-race"), por=False,
+                         max_schedules=5000, budget_s=60.0)
+    clean_pruned = explore(build_scenario("claim-race"), por=True,
+                           max_schedules=5000, budget_s=60.0)
+    assert clean_full.ok and clean_pruned.ok
+    assert clean_pruned.schedules < clean_full.schedules
+
+
+# ------------------------------------------------------------- bounds
+
+def test_budget_bounds_the_sweep():
+    res = explore(build_scenario("pool-count"), max_schedules=5000,
+                  budget_s=0.0)
+    assert res.ok and res.budget_exhausted
+    assert res.schedules <= 1
+
+
+def test_max_schedules_bounds_the_sweep():
+    res = explore(build_scenario("pool-count"), max_schedules=3,
+                  budget_s=60.0)
+    assert res.ok and res.budget_exhausted
+    assert res.schedules == 3
+
+
+def test_max_steps_aborts_a_runaway_schedule():
+    res = run_schedule(build_scenario("eviction-edge"), max_steps=2)
+    assert res.failure is not None
+    assert "max_steps" in res.failure["message"]
+
+
+def test_unknown_scenario_and_bug_are_rejected():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        build_scenario("nope")
+    with pytest.raises(ValueError, match="no seeded bug"):
+        build_scenario("claim-race", bug="admit-order")
+
+
+# ------------------------------------- regression: this PR's audit fixes
+
+def test_membership_join_stamps_throttle_under_the_lock(tmp_path):
+    """join() must publish the throttle stamp atomically with _joined:
+    an auto-beat thread racing join must never see a torn pair (joined
+    but stamp 0.0 → immediate spurious double-beat)."""
+    from iterative_cleaner_tpu.resilience.journal import FleetJournal
+    from iterative_cleaner_tpu.serve.membership import PoolMembership
+
+    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    m = PoolMembership(j, ttl_s=30.0, member_id="m1", host=1)
+    m.join(now=100.0)
+    assert m.heartbeat(now=100.0 + 30.0 / 3 - 0.01) is False  # throttled
+    assert m.heartbeat(now=100.0 + 30.0 / 3 + 0.01) is True
+
+
+def test_daemon_guards_its_cross_thread_maps_with_one_lock(tmp_path):
+    """The HTTP handler threads and the worker loop share _streams /
+    _root_spans / _pool_fold / _journal_read_ts; every write goes
+    through the single leaf _state_lock."""
+    from iterative_cleaner_tpu.config import CleanConfig, ServeConfig
+    from iterative_cleaner_tpu.serve.daemon import ServeDaemon
+
+    cfg = ServeConfig(journal_path=str(tmp_path / "j.jsonl"),
+                      http_port=0)
+    d = ServeDaemon(cfg, CleanConfig(backend="numpy", max_iter=2),
+                    quiet=True)
+    assert isinstance(d._state_lock, type(threading.Lock()))
