@@ -130,6 +130,56 @@ def _cube_passes(stats_impl, stats_frame, baseline_mode="integration",
     return base + 6.0
 
 
+def _sweep_cube_reads(cfg, nsub, nchan, nbin):
+    """Per-iteration cube-tile reads by the sweep stage (template
+    subtraction -> robust stats -> threshold/zap) for the route ``cfg``
+    resolves to at this geometry.
+
+    When the fused sweep engages the count is PROVEN, not narrated: the
+    kernel is traced and its cube-ref loads counted by the same helper
+    ``--selfcheck``'s single-read contract uses (anything but 1 is a
+    broken contract and raises).  The multi-kernel route materialises
+    the residual (one cube read) and reads it back for the diagnostics
+    — two cube-sized HBM round trips per iteration: 2."""
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.analysis.jaxpr_contracts import (
+        _count_cube_ref_reads,
+    )
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fft_mode,
+        resolve_fused_sweep,
+        resolve_stats_impl,
+    )
+    from iterative_cleaner_tpu.stats import pallas_kernels as pk
+
+    dtype = jnp.dtype(cfg.dtype)
+    fft_mode = resolve_fft_mode(cfg.fft_mode, dtype)
+    stats_impl = resolve_stats_impl(cfg.stats_impl, dtype, nbin, fft_mode)
+    if not (dtype == jnp.float32
+            and resolve_fused_sweep(cfg.fused_sweep, stats_impl) == "on"
+            and pk.fused_sweep_eligible(nsub, nchan, nbin)):
+        return 2
+    # trace at >= 2 subints: the kernel program is nsub-independent, and
+    # the contract counter needs shape[0] != 1 to tell the cube ref from
+    # the (1, s, c) cell tables
+    ns = max(int(nsub), 2)
+    f32 = jnp.float32
+    cube = jax.ShapeDtypeStruct((ns, nchan, nbin), f32)
+    plane = jax.ShapeDtypeStruct((ns, nchan), f32)
+    mask = jax.ShapeDtypeStruct((ns, nchan), jnp.bool_)
+    row = jax.ShapeDtypeStruct((nbin,), f32)
+    closed = jax.make_jaxpr(
+        lambda d, t, win, w, m: pk.fused_sweep_pallas_dedisp(
+            d, t, win, w, m, float(cfg.chanthresh),
+            float(cfg.subintthresh)))(cube, row, row, plane, mask)
+    reads = _count_cube_ref_reads(closed)
+    assert reads == [1], (
+        "fused sweep kernel broke its single-read budget: %r" % (reads,))
+    return reads[0]
+
+
 def _arm_watchdog(seconds: float):
     """Hard-exit if the bench wedges (e.g. an unreachable device tunnel
     blocks inside PJRT init, which no Python signal can interrupt)."""
@@ -395,6 +445,11 @@ def bench_streaming(nsub, nchan, nbin, chunk, max_iter=3):
         "streaming_eff_gbps": round(eff_gbps, 3),
         "streaming_h2d_bytes": h2d,
         "streaming_vs_whole": round(t_stream / t_whole, 2),
+        # per-iteration cube-tile reads of the sweep stage for this row's
+        # resolved route (1 when the fused sweep engages, proven by the
+        # --selfcheck contract counter; 2 on the multi-kernel route)
+        "streaming_sweep_cube_reads": _sweep_cube_reads(
+            cfg, min(chunk, nsub), nchan, nbin),
     }
 
 
@@ -1454,6 +1509,133 @@ def bench_online(n_subints, nchan, nbin, reconcile_every=4, bucket_pad=8,
         "online_reconciles": int(result.reconciles),
         "online_mask_drift": int(result.mask_drift + result.final_drift),
         "online_vs_batch_masks": "identical",
+        # per-subint cube reads of the provisional-zap sweep (nsub=1
+        # step): 1 when the fused route engages, 2 multi-kernel
+        "online_sweep_cube_reads": _sweep_cube_reads(cfg, 1, nchan, nbin),
+    }
+
+
+def bench_fused(nsub, nchan, nbin, max_iter=3, chunk=None):
+    """Fused-sweep row (stats/pallas_kernels.py ``fused_sweep_pallas*``):
+    the one-launch sweep (``--fused-sweep on``) against the multi-kernel
+    route it replaces (``off``), same archive, both warm.
+
+    ``fused_vs_unfused`` is warm best-of-2 wall clock (the compile and a
+    first warming run are paid before any timing).  The CPU-provable wins
+    ride alongside and ARE asserted, because they are deterministic:
+    a strictly smaller per-iteration program (``fused_eqns`` <
+    ``fused_unfused_eqns``), strictly fewer streaming H2D bytes (the
+    exact-streaming combine tail keeps its diagnostic planes on device),
+    and the single-read cube budget (``fused_sweep_cube_reads`` == 1,
+    counted from the traced kernel by the --selfcheck contract helper).
+    Mask parity between the routes is rc-7 fatal like every row above."""
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.analysis.jaxpr_contracts import iter_eqns
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        build_clean_fn,
+        resolve_fft_mode,
+        resolve_median_impl,
+        resolve_stats_frame,
+        resolve_stats_impl,
+    )
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io.synthetic import (
+        bench_rfi_density,
+        make_synthetic_archive,
+    )
+    from iterative_cleaner_tpu.parallel import clean_streaming_exact
+    from iterative_cleaner_tpu.telemetry import MetricsRegistry
+
+    ar, _ = make_synthetic_archive(
+        nsub=nsub, nchan=nchan, nbin=nbin, **bench_rfi_density(nsub, nchan),
+        seed=0, dtype=np.float32)
+    # median_impl=pallas is the apples-to-apples baseline: the kth-select
+    # lane machinery the sweep absorbs (the sort baseline trades kernel
+    # equations for one opaque XLA sort and would flatter neither route)
+    base = dict(backend="jax", dtype="float32", stats_impl="fused",
+                fft_mode="dft", median_impl="pallas", max_iter=max_iter)
+    results, times = {}, {}
+    for mode in ("on", "off"):
+        cfg = CleanConfig(fused_sweep=mode, **base)
+        clean_archive(ar.clone(), cfg)          # compile + warm
+        for _ in range(2):                      # warm best-of-2
+            t0 = time.perf_counter()
+            results[mode] = clean_archive(ar.clone(), cfg)
+            dt = time.perf_counter() - t0
+            times[mode] = min(times.get(mode, dt), dt)
+    assert np.array_equal(results["on"].final_weights,
+                          results["off"].final_weights), (
+        "fused sweep masks diverged from the multi-kernel route (%d cells)"
+        % int(np.sum(results["on"].final_weights
+                     != results["off"].final_weights)))
+
+    # per-iteration program size, fused vs the route it replaces
+    c = CleanConfig(**base)
+    dtype = jnp.dtype(c.dtype)
+    fft_mode = resolve_fft_mode(c.fft_mode, dtype)
+
+    def eqns(mode):
+        fn = build_clean_fn(
+            c.max_iter, c.chanthresh, c.subintthresh, c.pulse_slice,
+            c.pulse_scale, c.pulse_region_active, c.rotation,
+            c.baseline_duty, c.unload_res, fft_mode,
+            resolve_median_impl(c.median_impl, dtype),
+            resolve_stats_impl(c.stats_impl, dtype, nbin, fft_mode),
+            resolve_stats_frame(c.stats_frame, dtype), False,
+            c.baseline_mode, donate=True, fused_sweep=mode)
+        f32 = jnp.float32
+        avals = (jax.ShapeDtypeStruct((nsub, nchan, nbin), f32),
+                 jax.ShapeDtypeStruct((nsub, nchan), f32),
+                 jax.ShapeDtypeStruct((nchan,), f32),
+                 jax.ShapeDtypeStruct((), f32),
+                 jax.ShapeDtypeStruct((), f32),
+                 jax.ShapeDtypeStruct((), f32))
+        return sum(1 for _ in iter_eqns(jax.make_jaxpr(fn)(*avals).jaxpr))
+
+    e_on, e_off = eqns("on"), eqns("off")
+    assert e_on < e_off, (
+        "fused program no longer shrinks the multi-kernel route: "
+        "%d vs %d equations" % (e_on, e_off))
+
+    # exact-streaming H2D bytes: the fused combine keeps its per-tile
+    # diagnostic planes on device instead of re-uploading them
+    chunk = chunk or max(4, nsub // 4)
+    s_base = dict(base, median_impl="sort")
+    h2d, sres = {}, {}
+    for mode in ("on", "off"):
+        reg = MetricsRegistry()
+        sres[mode] = clean_streaming_exact(
+            ar.clone(), chunk, CleanConfig(fused_sweep=mode, **s_base),
+            registry=reg)
+        h2d[mode] = int(reg.counters.get("stream_h2d_bytes", 0))
+    assert np.array_equal(sres["on"].final_weights,
+                          sres["off"].final_weights), \
+        "fused streaming combine masks diverged from the unfused tail"
+    assert 0 < h2d["on"] < h2d["off"], (
+        "fused streaming route moved no fewer H2D bytes: %d vs %d"
+        % (h2d["on"], h2d["off"]))
+
+    reads = _sweep_cube_reads(CleanConfig(fused_sweep="on", **base),
+                              nsub, nchan, nbin)
+    assert reads == 1, reads
+
+    _log(f"fused ({nsub}x{nchan}x{nbin}): warm best-of-2 "
+         f"{times['on'] * 1e3:.1f} ms fused vs {times['off'] * 1e3:.1f} ms "
+         f"unfused ({times['on'] / times['off']:.2f}x), "
+         f"{e_on} vs {e_off} eqns, stream H2D {h2d['on']} vs "
+         f"{h2d['off']} bytes, {reads} cube read(s)/iteration")
+    return {
+        "fused_geometry": f"{nsub}x{nchan}x{nbin}",
+        "fused_platform": jax.default_backend(),
+        "fused_vs_unfused": round(times["on"] / times["off"], 3),
+        "fused_sweep_cube_reads": int(reads),
+        "fused_eqns": int(e_on),
+        "fused_unfused_eqns": int(e_off),
+        "fused_stream_h2d_bytes": h2d["on"],
+        "fused_unfused_stream_h2d_bytes": h2d["off"],
     }
 
 
@@ -1531,6 +1713,7 @@ def main():
                            ("BENCH_FLEET_ONLY", bench_fleet),
                            ("BENCH_SERVE_ONLY", bench_serve),
                            ("BENCH_ONLINE_ONLY", bench_online),
+                           ("BENCH_FUSED_ONLY", bench_fused),
                            ("BENCH_MULTIHOST_ONLY", bench_multihost),
                            ("BENCH_ELASTIC_ONLY", bench_elastic)):
         if os.environ.get(env_key):
@@ -1661,6 +1844,19 @@ def main():
          "reconcile_every": 4, "bucket_pad": 4 if small else 16},
         timeout=float(os.environ.get("BENCH_ONLINE_TIMEOUT", "600")),
         label="online")
+    if row:
+        extras = {**(extras or {}), **row}
+
+    # fused-sweep row (stats/pallas_kernels.py fused_sweep_pallas*): the
+    # one-launch sweep vs the multi-kernel route, warm best-of-2, plus
+    # the deterministic CPU-provable contracts (program shrink, streaming
+    # H2D shrink, single cube read) — parity-is-fatal like the rows above
+    fu_geom = (16, 32, 64) if small else (64, 128, 256)
+    row = _bench_row_subprocess(
+        "BENCH_FUSED_ONLY",
+        {"nsub": fu_geom[0], "nchan": fu_geom[1], "nbin": fu_geom[2]},
+        timeout=float(os.environ.get("BENCH_FUSED_TIMEOUT", "600")),
+        label="fused")
     if row:
         extras = {**(extras or {}), **row}
 

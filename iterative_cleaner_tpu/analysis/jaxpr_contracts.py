@@ -208,7 +208,7 @@ def _clean_fn_program() -> ProgramReport:
              jax.ShapeDtypeStruct((), f32),
              jax.ShapeDtypeStruct((), f32))
     weights_bytes = NSUB * NCHAN * 4
-    return verify_fn("build_clean_fn", fn, avals, max_eqns=4000,
+    return verify_fn("build_clean_fn", fn, avals, max_eqns=1800,
                      min_alias_bytes=weights_bytes)
 
 
@@ -227,7 +227,7 @@ def _batched_fn_program() -> ProgramReport:
     fn = build_batched_clean_fn(*build_args, donate=True)
     avals = batch_abstract_inputs(BATCH, NSUB, NCHAN, NBIN, jnp.float32)
     weights_bytes = BATCH * NSUB * NCHAN * 4
-    return verify_fn("build_batched_clean_fn", fn, avals, max_eqns=6000,
+    return verify_fn("build_batched_clean_fn", fn, avals, max_eqns=1900,
                      min_alias_bytes=weights_bytes)
 
 
@@ -248,7 +248,112 @@ def _online_step_program() -> ProgramReport:
              jax.ShapeDtypeStruct((1, NCHAN), f32),
              jax.ShapeDtypeStruct((NBIN,), f32),
              jax.ShapeDtypeStruct((), jnp.int32))
-    return verify_fn("online_step", step, avals, max_eqns=2500)
+    return verify_fn("online_step", step, avals, max_eqns=1400)
+
+
+def _count_cube_ref_reads(closed_jaxpr) -> List[int]:
+    """Per sweep ``pallas_call``, how many loads its kernel issues on the
+    cube tile ref.  Both sweep kernels take the cube ref as kernel invar
+    0 (the only rank-3 ref whose last axis is nbin); the read count is
+    the number of ``get``-family equations bound to that ref at any
+    nesting depth.  Returns one count per matching launch."""
+    counts = []
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        kernel = eqn.params.get("jaxpr")
+        kernel = getattr(kernel, "jaxpr", kernel)
+        if kernel is None or not getattr(kernel, "invars", None):
+            continue
+        cube_ref = kernel.invars[0]
+        shape = getattr(getattr(cube_ref, "aval", None), "shape", ())
+        if len(shape) != 3 or shape[0] == 1:
+            continue  # not a cube-tiled kernel (cell tables are (1,s,c))
+        reads = 0
+        for sub in iter_eqns(kernel):
+            if sub.primitive.name in ("get", "masked_load", "load") \
+                    and sub.invars and sub.invars[0] is cube_ref:
+                reads += 1
+        counts.append(reads)
+    return counts
+
+
+def _fused_sweep_program() -> ProgramReport:
+    """The fused sweep route (--fused-sweep on): the engine program must
+    strictly SHRINK against the multi-kernel route it replaces (same
+    config, median_impl=pallas — the machinery the sweep absorbs), and
+    each sweep kernel must read its cube tile ref exactly ONCE — the
+    single-read budget that makes the fusion a bandwidth win, not just a
+    launch-count win."""
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        build_clean_fn,
+        resolve_fft_mode,
+        resolve_median_impl,
+        resolve_stats_frame,
+        resolve_stats_impl,
+    )
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.stats import pallas_kernels as pk
+
+    c = CleanConfig(backend="jax", dtype="float32", stats_impl="fused",
+                    fft_mode="dft", median_impl="pallas")
+    dtype = jnp.dtype(c.dtype)
+    fft_mode = resolve_fft_mode(c.fft_mode, dtype)
+
+    def build(fused_sweep):
+        return build_clean_fn(
+            c.max_iter, c.chanthresh, c.subintthresh, c.pulse_slice,
+            c.pulse_scale, c.pulse_region_active, c.rotation,
+            c.baseline_duty, c.unload_res, fft_mode,
+            resolve_median_impl(c.median_impl, dtype),
+            resolve_stats_impl(c.stats_impl, dtype, NBIN, fft_mode),
+            resolve_stats_frame(c.stats_frame, dtype), False,
+            c.baseline_mode, donate=True, fused_sweep=fused_sweep)
+
+    f32 = jnp.float32
+    avals = (jax.ShapeDtypeStruct((NSUB, NCHAN, NBIN), f32),
+             jax.ShapeDtypeStruct((NSUB, NCHAN), f32),
+             jax.ShapeDtypeStruct((NCHAN,), f32),
+             jax.ShapeDtypeStruct((), f32),
+             jax.ShapeDtypeStruct((), f32),
+             jax.ShapeDtypeStruct((), f32))
+    fused = jax.make_jaxpr(build("on"))(*avals)
+    count, violations = check_jaxpr("fused_sweep", fused, max_eqns=2600)
+    unfused_count = sum(1 for _ in iter_eqns(
+        jax.make_jaxpr(build("off"))(*avals).jaxpr))
+    if count >= unfused_count:
+        violations.append(ContractViolation(
+            "fused_sweep", "dispatch-bound",
+            f"fused program has {count} equations vs {unfused_count} "
+            "unfused: the sweep no longer shrinks the per-iteration "
+            "program it exists to replace"))
+    # single-read budget, proven on BOTH sweep kernels traced standalone
+    plane = jax.ShapeDtypeStruct((NSUB, NCHAN), f32)
+    mask = jax.ShapeDtypeStruct((NSUB, NCHAN), jnp.bool_)
+    row = jax.ShapeDtypeStruct((NBIN,), f32)
+    chan_rows = jax.ShapeDtypeStruct((NCHAN, NBIN), f32)
+    cube = jax.ShapeDtypeStruct((NSUB, NCHAN, NBIN), f32)
+    traced = {
+        "fused_sweep_pallas_dedisp": jax.make_jaxpr(
+            lambda d, t, win, w, m: pk.fused_sweep_pallas_dedisp(
+                d, t, win, w, m, 5.0, 5.0))(cube, row, row, plane, mask),
+        "fused_sweep_pallas": jax.make_jaxpr(
+            lambda d, rt, nq, t, w, m: pk.fused_sweep_pallas(
+                d, rt, nq, t, w, m, 5.0, 5.0))(
+                    cube, chan_rows, chan_rows, row, plane, mask),
+    }
+    for name, closed in traced.items():
+        reads = _count_cube_ref_reads(closed)
+        if reads != [1]:
+            violations.append(ContractViolation(
+                "fused_sweep", "single-cube-read",
+                f"{name}: expected exactly one sweep kernel reading its "
+                f"cube tile ref exactly once, found read counts "
+                f"{reads}"))
+    return ProgramReport("fused_sweep", count, 0, violations)
 
 
 #: the registered hot programs — every builder whose output owns a
@@ -258,6 +363,7 @@ HOT_PROGRAMS = (
     ("build_clean_fn", _clean_fn_program),
     ("build_batched_clean_fn", _batched_fn_program),
     ("online_step", _online_step_program),
+    ("fused_sweep", _fused_sweep_program),
 )
 
 

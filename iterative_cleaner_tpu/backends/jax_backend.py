@@ -133,13 +133,36 @@ def resolve_stats_impl(stats_impl: str, dtype, nbin: int,
     return "fused" if ok else "xla"
 
 
+def resolve_fused_sweep(fused_sweep, stats_impl_resolved: str) -> str:
+    """Resolve the fused-SWEEP knob to 'on'/'off'.
+
+    ``None`` defers to the ``ICLEAN_FUSED_SWEEP`` env mirror, then
+    'auto'.  'auto' follows the RESOLVED stats_impl: the sweep is the
+    one-launch packaging of the fused cell kernels, so it engages exactly
+    where those kernels are already trusted — and nowhere else (no
+    separate hardware allowlist to drift).  The resolved 'on' is still a
+    request, not a promise: the engine's per-program gate
+    (``fused_sweep_eligible`` geometry, unsharded, float32) makes the
+    final trace-time call and quietly keeps the multi-kernel route when
+    it fails."""
+    import os
+
+    if fused_sweep is None:
+        fused_sweep = os.environ.get("ICLEAN_FUSED_SWEEP", "") or "auto"
+    if fused_sweep not in ("auto", "on", "off"):
+        raise ValueError(f"unknown fused sweep mode {fused_sweep!r}")
+    if fused_sweep != "auto":
+        return fused_sweep
+    return "on" if stats_impl_resolved == "fused" else "off"
+
+
 @functools.lru_cache(maxsize=None)
 def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, baseline_duty,
                    unload_res, fft_mode="fft", median_impl="sort",
                    stats_impl="xla", stats_frame="dispersed",
                    dedispersed=False, baseline_mode="profile",
-                   donate=False):
+                   donate=False, fused_sweep="off"):
     """Build (and cache) the jitted whole-archive cleaning program for one
     static configuration.
 
@@ -178,6 +201,7 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             rotation=rotation, fft_mode=fft_mode, median_impl=median_impl,
             stats_impl=stats_impl, stats_frame=stats_frame,
             baseline_corr=baseline_corr, disp_iteration=disp_iteration,
+            fused_sweep=(fused_sweep == "on"),
         )
         if not unload_res:
             return outs, None
@@ -225,17 +249,19 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
               and not isinstance(orig_weights, jax.Array))
     if donate:
         silence_unusable_donation_warning()
+    stats_impl = resolve_stats_impl(config.stats_impl, dtype,
+                                    cube.shape[-1], fft_mode)
     fn = build_clean_fn(
         config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
         config.rotation, config.baseline_duty, config.unload_res,
         fft_mode, resolve_median_impl(config.median_impl, dtype),
-        resolve_stats_impl(config.stats_impl, dtype, cube.shape[-1],
-                           fft_mode),
+        stats_impl,
         resolve_stats_frame(config.stats_frame, dtype),
         bool(dedispersed),
         config.baseline_mode,
         donate=donate,
+        fused_sweep=resolve_fused_sweep(config.fused_sweep, stats_impl),
     )
     outs, resid = fn(
         jnp.asarray(cube, dtype=dtype),

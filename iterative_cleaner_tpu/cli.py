@@ -111,6 +111,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "op, the MXU matmul DFT (mathematically "
                              "identical; what the fused kernel and TPU "
                              "prefer), or auto (dft on TPU float32).")
+    parser.add_argument("--fused-sweep", choices=("auto", "on", "off"),
+                        default=None,
+                        help="One-launch SWEEP route on the jax path: fit + "
+                             "residual + diagnostics + scaler + combine + "
+                             "zap in ONE Pallas kernel reading each cube "
+                             "tile exactly once per iteration. 'auto' "
+                             "(default; env ICLEAN_FUSED_SWEEP) follows the "
+                             "resolved --stats_impl; 'on' forces it where "
+                             "the geometry gate admits; 'off' keeps the "
+                             "multi-kernel route. Masks are bit-equal at "
+                             "every setting.")
     parser.add_argument("--stats_frame",
                         choices=("auto", "dispersed", "dedispersed"),
                         default="auto",
@@ -544,6 +555,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         median_impl=args.median_impl,
         stats_impl=args.stats_impl,
         stats_frame=args.stats_frame,
+        fused_sweep=args.fused_sweep,
         fft_mode=args.fft_mode,
         baseline_mode=args.baseline_mode,
         stream_hbm_mb=getattr(args, "stream_hbm_mb", None),
@@ -1031,6 +1043,14 @@ def _run_fleet(args, telemetry=None) -> list:
                  report.n_buckets_owned,
                  "" if report.n_buckets_owned == 1 else "s",
                  report.n_stolen))
+    # release the process-global black box if it is still ours: an
+    # embedder outliving this fleet run (the in-process tests) must not
+    # have ITS later watchdog trips dumped to our recorder path
+    if recorder is not None:
+        from iterative_cleaner_tpu.telemetry.recorder import get_active
+
+        if get_active() is recorder:
+            set_active(None)
     return failed
 
 

@@ -58,6 +58,17 @@ class CleanConfig:
     # agree.  Measured on the synthetic fixtures: ~0.4% of cells at default
     # thresholds, all with dispersed-frame scores in (0.9, 1.2).
     stats_frame: str = "auto"
+    # fused SWEEP kernel on the jax path (stats/pallas_kernels.py
+    # fused_sweep_pallas*): template fit + residual + diagnostics + scaler
+    # + combine + zap in ONE Pallas launch reading each cube tile exactly
+    # once per iteration.  "on" forces the sweep whenever the geometry
+    # gate (fused_sweep_eligible) and backend gates admit it, "off" keeps
+    # the multi-kernel route, "auto" follows the resolved stats_impl
+    # (sweep iff the fused cell kernels are in play).  Masks are bit-equal
+    # either way (the sweep reuses the exact kernel bodies of the unfused
+    # route), so the knob is excluded from the checkpoint/journal config
+    # identity.  None defers to ICLEAN_FUSED_SWEEP, then "auto".
+    fused_sweep: Optional[str] = None
     baseline_duty: float = 0.15  # off-pulse window fraction for baseline find
     # baseline estimator (ops/psrchive_baseline.py).  "integration" (the
     # default) is the PSRCHIVE-spec scheme the reference's remove_baseline
@@ -193,6 +204,9 @@ class CleanConfig:
             raise ValueError(f"unknown stats impl {self.stats_impl!r}")
         if self.stats_frame not in ("auto", "dispersed", "dedispersed"):
             raise ValueError(f"unknown stats frame {self.stats_frame!r}")
+        if self.fused_sweep is not None \
+                and self.fused_sweep not in ("auto", "on", "off"):
+            raise ValueError(f"unknown fused sweep mode {self.fused_sweep!r}")
         if self.baseline_mode not in ("integration", "profile"):
             raise ValueError(f"unknown baseline mode {self.baseline_mode!r}")
         if self.stats_impl == "fused" and self.dtype != "float32":

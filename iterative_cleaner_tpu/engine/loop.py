@@ -93,6 +93,23 @@ def dispersed_residual_base(ded_cube, back_shifts, *, pulse_slice,
     return rotate_bins(masked, back_shifts, jnp, method=rotation)
 
 
+def _nyq_correction_row(back_shifts, nbin, rotation, dtype):
+    """(nchan, nbin) Nyquist round-trip correction row for the dispersed-
+    frame one-read fit, or None when the rotation round-trips exactly
+    (roll rotation, odd nbin) — see the ``disp_iteration`` branch of
+    :func:`diagnostics_given_template` for the derivation.  Shared by the
+    multi-kernel route and the fused-sweep route so the two stay
+    bit-identical."""
+    if rotation != "fourier" or nbin % 2 != 0:
+        return None
+    # fractional part keeps the cos argument small (f32 range reduction
+    # at pi*s for s ~ nbin loses ~1e-5 of gamma)
+    frac = back_shifts - jnp.round(back_shifts)
+    gamma = jnp.cos(np.pi * frac.astype(dtype)) ** 2 - 1.0
+    alt = (1.0 - 2.0 * (jnp.arange(nbin) % 2)).astype(dtype)
+    return (gamma / nbin)[:, None] * alt[None, :]
+
+
 def disp_iteration_enabled(baseline_mode: str, stats_frame: str,
                            pulse_active: bool, dedispersed: bool) -> bool:
     """The ONE eligibility predicate for the dispersed-frame fast path
@@ -223,7 +240,7 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
                    median_impl="sort", stats_impl="xla",
                    stats_frame="dispersed", shard_mesh=None,
                    baseline_corr=None, disp_iteration=False,
-                   with_metrics=False):
+                   fused_sweep=False, with_metrics=False):
     """One cleaning iteration: template -> fit -> residual stats -> new weights.
 
     ``weights`` are the previous iteration's (template) weights;
@@ -250,16 +267,75 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
     partitioned under GSPMD — a bare ``pallas_call`` in a sharded program
     would gather its operands onto every device.  The XLA/sort paths ignore
     it (GSPMD partitions them natively).
+
+    ``fused_sweep=True`` requests the one-launch SWEEP route
+    (stats/pallas_kernels ``fused_sweep_pallas*``): the entire post-
+    template half — fit, residual, diagnostics, both scaler orientations,
+    combine, zap — runs as ONE Pallas kernel reading each cube tile
+    exactly once per iteration.  It engages only where its trace-time
+    gate admits it (fused stats route, unsharded, float32 weights, a
+    one-read frame — ``stats_frame='dedispersed'`` or ``disp_iteration``
+    — and :func:`~iterative_cleaner_tpu.stats.pallas_kernels.
+    fused_sweep_eligible` geometry); everything else quietly keeps the
+    multi-kernel route.  Masks and scores are bit-equal either way (the
+    sweep reuses the exact kernel bodies; tests/test_fused_sweep.py).
     """
     if stats_impl == "fused" and fft_mode == "fft":
         raise ValueError(
             "stats_impl='fused' computes DFT-flavoured rFFT magnitudes; "
             "pass fft_mode='dft'")
+    use_sweep = (bool(fused_sweep) and stats_impl == "fused"
+                 and shard_mesh is None
+                 and (stats_frame == "dedispersed" or disp_iteration))
+    if use_sweep:
+        from iterative_cleaner_tpu.stats.pallas_kernels import (
+            fused_sweep_eligible,
+        )
+
+        use_sweep = (orig_weights.dtype == jnp.float32
+                     and fused_sweep_eligible(*ded_cube.shape))
     with jax.named_scope("icln_template"):
         template = _build_template(
             ded_cube, disp_base, weights, back_shifts, rotation=rotation,
             stats_impl=stats_impl, shard_mesh=shard_mesh,
             baseline_corr=baseline_corr, disp_iteration=disp_iteration)
+    if use_sweep:
+        nsub, nchan, nbin = ded_cube.shape
+        with jax.named_scope("icln_fused_sweep"):
+            from iterative_cleaner_tpu.stats.pallas_kernels import (
+                fused_sweep_pallas,
+                fused_sweep_pallas_dedisp,
+            )
+
+            if stats_frame == "dedispersed":
+                m = _pulse_window(nbin, pulse_slice, pulse_scale,
+                                  pulse_active, ded_cube.dtype)
+                window = jnp.ones((nbin,), ded_cube.dtype) if m is None \
+                    else m
+                new_weights, scores, d_std = fused_sweep_pallas_dedisp(
+                    ded_cube, template, window, orig_weights, cell_mask,
+                    chanthresh, subintthresh)
+            else:
+                # disp_iteration: pulse inactive by construction, so the
+                # rotated-template row is unwindowed — same prep as
+                # diagnostics_given_template's one-read branch
+                rot_t = rotate_bins(
+                    jnp.broadcast_to(template, (nchan, nbin)), back_shifts,
+                    jnp, method=rotation)
+                nyq_row = _nyq_correction_row(back_shifts, nbin, rotation,
+                                              ded_cube.dtype)
+                new_weights, scores, d_std = fused_sweep_pallas(
+                    disp_base, rot_t, nyq_row, template, orig_weights,
+                    cell_mask, chanthresh, subintthresh)
+        if not with_metrics:
+            return new_weights, scores
+        with jax.named_scope("icln_iter_metrics"):
+            # identical arithmetic to the unfused branch below: d_std IS
+            # the residual-std diagnostic plane the sweep kept resident
+            rstd = masked_median(d_std.reshape(1, -1),
+                                 cell_mask.reshape(1, -1), axis=1)[0, 0]
+            tpeak = jnp.max(template)
+        return new_weights, scores, (rstd, tpeak)
     with jax.named_scope("icln_residual_stats"):
         diags = diagnostics_given_template(
             ded_cube, disp_base, template, orig_weights, cell_mask,
@@ -357,17 +433,9 @@ def diagnostics_given_template(ded_cube, disp_base, template, orig_weights,
             # one alternating-sign reduction instead of a cube-sized
             # double rotation.  Roll rotation (a permutation) and odd
             # nbin round-trip exactly: no correction.
-            apply_nyq = rotation == "fourier" and nbin % 2 == 0
-            nyq_row = None
-            if apply_nyq:
-                # fractional part keeps the cos argument small (f32 range
-                # reduction at pi*s for s ~ nbin loses ~1e-5 of gamma)
-                frac = back_shifts - jnp.round(back_shifts)
-                gamma = jnp.cos(np.pi * frac.astype(ded_cube.dtype)) ** 2 \
-                    - 1.0
-                alt = (1.0 - 2.0 * (jnp.arange(nbin) % 2)).astype(
-                    ded_cube.dtype)
-                nyq_row = (gamma / nbin)[:, None] * alt[None, :]
+            nyq_row = _nyq_correction_row(back_shifts, nbin, rotation,
+                                          ded_cube.dtype)
+            apply_nyq = nyq_row is not None
             if stats_impl == "fused":
                 if shard_mesh is not None:
                     from iterative_cleaner_tpu.parallel.shard_stats import (
@@ -433,7 +501,8 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
                           stats_frame="dispersed",
                           shard_mesh=None,
                           baseline_corr=None,
-                          disp_iteration=False) -> CleanOutputs:
+                          disp_iteration=False,
+                          fused_sweep=False) -> CleanOutputs:
     """Run the full iteration loop on an already-prepared cube.
 
     ``ded_cube``: baseline-removed, dedispersed (nsub, nchan, nbin) cube.
@@ -455,6 +524,11 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
     template — so ``ded_cube`` is never read inside the loop and XLA
     dead-code-eliminates the preamble's cube rotation: one resident
     cube, two cube reads per iteration.
+
+    ``fused_sweep``: request the one-launch SWEEP route for the
+    post-template half of every iteration (see :func:`iteration_step`) —
+    ONE cube read per iteration where its trace-time gate admits it,
+    bit-equal masks everywhere.
     """
     nsub, nchan, _ = ded_cube.shape
     wdtype = orig_weights.dtype
@@ -508,7 +582,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
             median_impl=median_impl, stats_impl=stats_impl,
             stats_frame=stats_frame, shard_mesh=shard_mesh,
             baseline_corr=baseline_corr, disp_iteration=disp_iteration,
-            with_metrics=True,
+            fused_sweep=fused_sweep, with_metrics=True,
         )
         seen = jnp.arange(max_iter + 1) < c.count
         matches = jnp.all(c.history == new_w[None], axis=(1, 2)) & seen

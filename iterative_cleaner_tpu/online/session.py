@@ -208,9 +208,12 @@ class OnlineSession:
 
         from iterative_cleaner_tpu.backends.jax_backend import (
             resolve_fft_mode,
+            resolve_fused_sweep,
             resolve_median_impl,
+            resolve_stats_impl,
         )
         from iterative_cleaner_tpu.engine.loop import (
+            _pulse_window,
             diagnostics_given_template,
             prepare_cube_jax,
         )
@@ -224,6 +227,32 @@ class OnlineSession:
         median_impl = resolve_median_impl(cfg.median_impl, dtype)
         alpha = float(self.alpha)
         freqs = np.asarray(meta.freqs_mhz, dtype=dtype)
+        # One-launch SWEEP route for the provisional zap (the same fused
+        # tile step as the batch engine's fused route, at nsub=1): engages
+        # where the resolved --fused-sweep is on and the geometry gate
+        # admits a single-subint plane.  The provisional diagnostics then
+        # carry the fused route's DFT-flavoured rFFT magnitudes — a
+        # legitimate flavour change for a *provisional* mask (only the
+        # reconciles are contractual; they run the configured batch path
+        # unconditionally), and bit-equal to composing the fused cell
+        # kernel with scale_and_combine (tests/test_fused_sweep.py).
+        use_sweep = False
+        sweep_window = None
+        if dtype == jnp.float32:
+            from iterative_cleaner_tpu.stats.pallas_kernels import (
+                fused_sweep_eligible,
+                fused_sweep_pallas_dedisp,
+            )
+
+            stats_impl = resolve_stats_impl(cfg.stats_impl, dtype,
+                                            meta.nbin, fft_mode)
+            use_sweep = (
+                resolve_fused_sweep(cfg.fused_sweep, stats_impl) == "on"
+                and fused_sweep_eligible(1, meta.nchan, meta.nbin))
+        if use_sweep:
+            m = _pulse_window(meta.nbin, cfg.pulse_slice, cfg.pulse_scale,
+                              cfg.pulse_region_active, dtype)
+            sweep_window = jnp.ones((meta.nbin,), dtype) if m is None else m
 
         def step(tile, w_row, template, count):
             # cell-local preamble; always baseline_mode="profile" — the
@@ -244,15 +273,20 @@ class OnlineSession:
                 updated, ew_update(template, count, profile, alpha, jnp),
                 template)
             cell_mask = w_row == 0
-            diags = diagnostics_given_template(
-                ded, None, new_template, w_row, cell_mask, None,
-                pulse_slice=cfg.pulse_slice, pulse_scale=cfg.pulse_scale,
-                pulse_active=cfg.pulse_region_active, rotation=cfg.rotation,
-                fft_mode=fft_mode, stats_impl="xla",
-                stats_frame="dedispersed")
-            scores = scale_and_combine(diags, cell_mask, cfg.chanthresh,
-                                       cfg.subintthresh, median_impl)
-            new_w = jnp.where(scores >= 1.0, 0.0, w_row)
+            if use_sweep:
+                new_w, scores, _ = fused_sweep_pallas_dedisp(
+                    ded, new_template, sweep_window, w_row, cell_mask,
+                    float(cfg.chanthresh), float(cfg.subintthresh))
+            else:
+                diags = diagnostics_given_template(
+                    ded, None, new_template, w_row, cell_mask, None,
+                    pulse_slice=cfg.pulse_slice, pulse_scale=cfg.pulse_scale,
+                    pulse_active=cfg.pulse_region_active,
+                    rotation=cfg.rotation, fft_mode=fft_mode,
+                    stats_impl="xla", stats_frame="dedispersed")
+                scores = scale_and_combine(diags, cell_mask, cfg.chanthresh,
+                                           cfg.subintthresh, median_impl)
+                new_w = jnp.where(scores >= 1.0, 0.0, w_row)
             return new_w, scores, new_template, updated
 
         self._dtype = dtype

@@ -61,7 +61,7 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                            fft_mode, median_impl="sort",
                            stats_frame="dispersed", dedispersed=False,
                            stats_impl="xla", baseline_mode="profile",
-                           donate=False):
+                           fused_sweep="off", donate=False):
     """Jitted batched cleaner: every per-archive input gains a leading batch
     axis; scalars (dm, period, ref freq) are per-archive vectors.  The
     Pallas kernels (median/fused stats) batch through their custom_vmap
@@ -106,6 +106,7 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             # batched masks must equal the per-archive path's bit-for-bit
             disp_iteration=disp_iteration_enabled(
                 baseline_mode, stats_frame, pulse_active, dedispersed),
+            fused_sweep=(fused_sweep == "on"),
         )
 
     if donate:
@@ -173,6 +174,7 @@ def resolve_batch_build_args(config: CleanConfig, nbin: int,
 
     from iterative_cleaner_tpu.backends.jax_backend import (
         resolve_fft_mode,
+        resolve_fused_sweep,
         resolve_median_impl,
         resolve_stats_frame,
         resolve_stats_impl,
@@ -222,6 +224,9 @@ def resolve_batch_build_args(config: CleanConfig, nbin: int,
         bool(dedispersed),
         stats_impl,
         config.baseline_mode,
+        # the sweep's 'auto' follows the resolved stats route, so the
+        # GSPMD branches above (stats_impl forced to xla) resolve it off
+        resolve_fused_sweep(config.fused_sweep, stats_impl),
     )
     use_shardmap = (kernel_route
                     and (median_impl == "pallas" or stats_impl == "fused"))

@@ -437,19 +437,53 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
             diag_tile, ("cube", "rep", "rep", "cell", "cell", "rep"))
 
     # combine runs on the reassembled FULL (nsub, nchan) plane — tiny
-    # (nbin-times smaller than any tile), so it stays unsharded.  The
-    # compact (stacked-sort) scaler keeps this standalone program's op
-    # count — and so its first-iteration compile latency — down; output
-    # is bit-identical to scale_and_combine (stats/masked_jax.py).
-    @jax.jit
-    def combine(diags, cell_mask, orig_weights):
-        scores = scale_and_combine_compact(
-            diags, cell_mask, config.chanthresh, config.subintthresh,
-            median_impl)
-        return jnp.where(scores >= 1.0, 0.0, orig_weights), scores
+    # (nbin-times smaller than any tile), so it stays unsharded.  Two
+    # implementations, bit-identical masks/scores:
+    #   * fused (float32, no mesh, --fused-sweep resolves on): the drained
+    #     per-tile diagnostic handles stay ON DEVICE, concatenate inside
+    #     this one program, and the whole scaler + 4-way median +
+    #     threshold/zap tail runs as a single Pallas launch
+    #     (fused_combine_pallas) — the four full planes are never
+    #     re-uploaded, so per-iteration stream_h2d_bytes drops by
+    #     4 * nsub * nchan * 4 bytes.
+    #   * compact (everything else): the stacked-sort scaler keeps this
+    #     standalone program's op count — and so its first-iteration
+    #     compile latency — down; output is bit-identical to
+    #     scale_and_combine (stats/masked_jax.py).
+    use_fused_combine = False
+    if mesh is None and dtype == jnp.float32:
+        from iterative_cleaner_tpu.backends.jax_backend import (
+            resolve_fused_sweep,
+        )
+
+        use_fused_combine = (
+            resolve_fused_sweep(config.fused_sweep, stats_impl) == "on")
+
+    if use_fused_combine:
+        from iterative_cleaner_tpu.stats.pallas_kernels import (
+            fused_combine_pallas,
+        )
+
+        @jax.jit
+        def combine(tile_diags, cell_mask, orig_weights):
+            # tile_diags: per-tile 4-tuples of (chunk, nchan) device planes
+            diags = tuple(
+                jnp.concatenate([t[k] for t in tile_diags],
+                                axis=0)[:cell_mask.shape[0]]
+                for k in range(4))
+            return fused_combine_pallas(diags, cell_mask, orig_weights,
+                                        config.chanthresh,
+                                        config.subintthresh)
+    else:
+        @jax.jit
+        def combine(diags, cell_mask, orig_weights):
+            scores = scale_and_combine_compact(
+                diags, cell_mask, config.chanthresh, config.subintthresh,
+                median_impl)
+            return jnp.where(scores >= 1.0, 0.0, orig_weights), scores
 
     return (prep, template_partial, correction_partial, diag_tile,
-            combine, disp_mode)
+            combine, disp_mode, use_fused_combine)
 
 
 def _host_parallelism():
@@ -467,7 +501,7 @@ def _host_parallelism():
 def _warm_tile_programs(template_partial, correction_partial, diag_tile,
                         combine, ded0, w0, v0, m0, shifts,
                         cell_mask_full, orig_w_dtype, raw0, disp_mode,
-                        integration, dtype):
+                        integration, dtype, use_fused_combine, n_tiles):
     """Compile the per-iteration tile programs concurrently, ahead of use.
 
     Each closure calls its jitted program once with tile-0-shaped
@@ -502,11 +536,21 @@ def _warm_tile_programs(template_partial, correction_partial, diag_tile,
             return diag_tile(ded0, template_partial(ded0, w0), plane, w0,
                              m0_d, shifts)
 
+    if use_fused_combine:
+        # the fused combine traces on the per-tile handle structure: a
+        # list of n_tiles 4-tuples of (chunk, nchan) planes
+        tile_plane = jnp.zeros((ded0.shape[0], cell_mask_full.shape[1]),
+                               dtype=dtype)
+        combine_args = ([(tile_plane,) * 4] * n_tiles,
+                        jnp.asarray(cell_mask_full),
+                        jnp.asarray(orig_w_dtype))
+    else:
+        combine_args = ((plane, plane, plane, plane),
+                        jnp.asarray(cell_mask_full),
+                        jnp.asarray(orig_w_dtype))
     jobs = [
         warm_diag,
-        lambda: combine((plane, plane, plane, plane),
-                        jnp.asarray(cell_mask_full),
-                        jnp.asarray(orig_w_dtype)),
+        lambda: combine(*combine_args),
     ]
     pool = concurrent.futures.ThreadPoolExecutor(
         max_workers=len(jobs), thread_name_prefix="icln-warm")
@@ -529,8 +573,8 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
     integration = config.baseline_mode == "integration"
     chunk = tiles[0].stop - tiles[0].start
     (prep, template_partial, correction_partial, diag_tile,
-     combine, disp_mode) = _jax_tile_fns(config, cube.shape[-1],
-                                         bool(dedispersed), mesh)
+     combine, disp_mode, use_fused_combine) = _jax_tile_fns(
+         config, cube.shape[-1], bool(dedispersed), mesh)
     if mesh is not None:
         # meshes can span processes: every sharded tile output is gathered
         # to the host before reassembly (parallel/distributed.host_fetch)
@@ -654,7 +698,8 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
             warm_futures = _warm_tile_programs(
                 template_partial, correction_partial, diag_tile,
                 combine, ded_t, w_d, v_t, m_host[0], shifts, cell_mask_full,
-                orig_w_dtype, cube_d, disp_mode, integration, dtype)
+                orig_w_dtype, cube_d, disp_mode, integration, dtype,
+                use_fused_combine, n_tiles)
         # np.asarray(ded_t) above IS a host fetch — the sync that frees
         # any unpinned upload this tile made
         cache.mark_sync()
@@ -739,6 +784,7 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
                     cache.get(("m", i), m_host[i])]
 
         diag_host = [None] * n_tiles
+        diag_dev = [None] * n_tiles
 
         def run_diag(i, ins):
             if integration:
@@ -747,6 +793,20 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
             return diag_tile(ins[0], num_d, plane_d, ins[1], ins[2], shifts)
 
         def drain_diag(i, out):
+            if use_fused_combine:
+                # the four plane handles stay ON DEVICE for the one-launch
+                # combine (they are tiny — nbin-times smaller than a tile
+                # — so pinning them costs no meaningful residency).  d_std
+                # still lands on the host: it backs the rstd telemetry AND
+                # its fetch is the per-tile sync that caps residency; tile
+                # 0 additionally fetches the tile-invariant template.
+                diag_dev[i] = tuple(out[:4])
+                fetched = (np.asarray(out[0]),)
+                if i == 0:
+                    fetched += (np.asarray(host_fetch(out[4])),)
+                cache.count_d2h(sum(a.nbytes for a in fetched))
+                diag_host[i] = fetched
+                return
             fetched = tuple(np.asarray(x) for x in host_fetch(out))
             cache.count_d2h(sum(a.nbytes for a in fetched))
             diag_host[i] = fetched
@@ -754,18 +814,29 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
         pipelined_sweep(n_tiles, put_diag_inputs, run_diag, drain_diag,
                         depth=sweep_depth, on_sync=cache.mark_sync)
 
-        # each tile's 5th output is the (tile-invariant) template; the
-        # first four concatenate back into the full diagnostic planes
-        template = diag_host[0][4]
-        diag_np = [np.concatenate([t[i] for t in diag_host], axis=0)[:nsub]
-                   for i in range(4)]
-        diags = tuple(cache.get(None, d) for d in diag_np)
-        new_w_d, scores_d = combine(
-            diags, cache.get(("cell_mask",), cell_mask_full),
-            cache.get(("orig_w",), orig_w_dtype))
+        if use_fused_combine:
+            # fused tail: the drained handles concatenate on device inside
+            # the combine program — no diagnostic-plane H2D at all
+            template = diag_host[0][1]
+            dstd_np = np.concatenate([t[0] for t in diag_host],
+                                     axis=0)[:nsub]
+            new_w_d, scores_d = combine(
+                diag_dev, cache.get(("cell_mask",), cell_mask_full),
+                cache.get(("orig_w",), orig_w_dtype))
+        else:
+            # each tile's 5th output is the (tile-invariant) template; the
+            # first four concatenate back into the full diagnostic planes
+            template = diag_host[0][4]
+            diag_np = [np.concatenate([t[i] for t in diag_host],
+                                      axis=0)[:nsub] for i in range(4)]
+            dstd_np = diag_np[0]
+            diags = tuple(cache.get(None, d) for d in diag_np)
+            new_w_d, scores_d = combine(
+                diags, cache.get(("cell_mask",), cell_mask_full),
+                cache.get(("orig_w",), orig_w_dtype))
         # telemetry aux, same definitions as the whole-archive engines
         valid = ~cell_mask_full
-        rstd = (float(np.median(diag_np[0][valid])) if valid.any() else 0.0)
+        rstd = (float(np.median(dstd_np[valid])) if valid.any() else 0.0)
         new_w = np.asarray(new_w_d, dtype=np.float64)
         scores = np.asarray(scores_d)
         cache.count_d2h(new_w.nbytes + scores.nbytes)

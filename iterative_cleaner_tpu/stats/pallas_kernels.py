@@ -39,6 +39,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams to CompilerParams; accept either spelling
+# so the kernels load on both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 _INT32_MIN = np.int32(-2147483648)
 _INT32_MAX = np.int32(2147483647)
 # key of +inf: the masked sentinel, chosen to equal the sort path's +inf
@@ -91,6 +96,26 @@ def _key_to_float(o):
     # The transform is an involution.
     b = o ^ ((o >> 31) & np.int32(0x7FFFFFFF))
     return jax.lax.bitcast_convert_type(b, jnp.float32)
+
+
+def _median4(a, b, c, d):
+    """``jnp.median`` of four stacked planes (axis 0), elementwise, as a
+    min/max network on the ordered keys: for ``x = max(min(a,b),
+    min(c,d))`` and ``y = min(max(a,b), max(c,d))`` the two middle order
+    statistics of {a,b,c,d} are ``min(x,y)`` and ``max(x,y)``.  Matches
+    ``jnp.median`` bit-for-bit: any-NaN lanes poison to NaN first (the
+    quantile path patches NaN columns before its sort), the key order
+    equals the sort's total order (-0 < +0; no NaNs survive the patch),
+    and the final ``lo*0.5 + hi*0.5`` is quantile's method='linear'
+    arithmetic — NOT ``0.5*(lo+hi)``, whose pre-rounded sum is the
+    midpoint method's different float."""
+    any_nan = (jnp.isnan(a) | jnp.isnan(b)) | (jnp.isnan(c) | jnp.isnan(d))
+    ka, kb, kc, kd = (_ordered_key(v) for v in (a, b, c, d))
+    x = jnp.maximum(jnp.minimum(ka, kb), jnp.minimum(kc, kd))
+    y = jnp.minimum(jnp.maximum(ka, kb), jnp.maximum(kc, kd))
+    med = (_key_to_float(jnp.minimum(x, y)) * np.float32(0.5)
+           + _key_to_float(jnp.maximum(x, y)) * np.float32(0.5))
+    return jnp.where(any_nan, np.float32(np.nan), med)
 
 
 def _line_fold(axis, B, S, C, keepdims=False):
@@ -167,7 +192,7 @@ def _median_kernel(v_ref, m_ref, out_ref):
     out_ref[0, :] = med
 
 
-def _scaled_sides_body(d0, d1, d2, d3, mask, thresh):
+def _scaled_sides_body(d0, d1, d2, d3, mask, thresh, plain_mask=None):
     """One orientation of the whole scaler stage for all four diagnostics
     on (n_reduce, T_lines) VMEM arrays: median -> centring -> MAD ->
     epilogue.
@@ -178,7 +203,12 @@ def _scaled_sides_body(d0, d1, d2, d3, mask, thresh):
     inf/nan flow for the rFFT one — they are pure jnp ops and trace fine
     inside the kernel), so the outputs are bit-identical to the unfused
     kernel+XLA route by construction, while collapsing two median launches
-    plus the XLA elementwise middle into a single pass over the tile."""
+    plus the XLA elementwise middle into a single pass over the tile.
+
+    ``plain_mask`` drops entries from the rFFT diagnostic's *rank
+    selection* the way cropping would (the sweep kernel's grid-padding
+    rows, which the unpadded route never sees); the default all-false
+    mask IS the existing plain path — rank over every entry."""
     from iterative_cleaner_tpu.stats.masked_jax import (
         _masked_side,
         _patch_nan_lines,
@@ -195,11 +225,12 @@ def _scaled_sides_body(d0, d1, d2, d3, mask, thresh):
     # the rFFT diagnostic: plain path (quirk 5) — no mask, NaN-bearing
     # lines median to NaN (matching jnp.median propagation), zero MAD
     # yields IEEE inf/nan that flow onward
-    no_mask = jnp.zeros_like(mask)
-    med, _ = _masked_median_lanes(d3, no_mask)
+    if plain_mask is None:
+        plain_mask = jnp.zeros_like(mask)
+    med, _ = _masked_median_lanes(d3, plain_mask)
     centred = d3 - _patch_nan_lines(med[None, :], d3, 0)
     absc = jnp.abs(centred)
-    mad, _ = _masked_median_lanes(absc, no_mask)
+    mad, _ = _masked_median_lanes(absc, plain_mask)
     outs.append(jnp.abs(centred / _patch_nan_lines(mad[None, :], absc, 0))
                 / t)
     return outs
@@ -278,7 +309,7 @@ def _scaled_sides_axis0(d0, d1, d2, d3, mask, thresh, interpret):
         in_specs=[spec] * 5,
         out_specs=[spec] * 4,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=_SCALER_VMEM_BYTES),
     )(*(chunked(d) for d in (d0, d1, d2, d3)), chunked(mask))
     return tuple(o.swapaxes(0, 1).reshape(n, mp)[:, :m] for o in outs)
@@ -310,7 +341,7 @@ def _scaled_sides_axis1(d0, d1, d2, d3, mask, thresh, interpret):
         in_specs=[spec] * 5,
         out_specs=[spec] * 4,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=_SCALER_VMEM_BYTES),
     )(d0, d1, d2, d3, mask)
     return tuple(o[:n] for o in outs)
@@ -619,7 +650,7 @@ def _marginals_call(disp, weights, interpret):
         scratch_shapes=[pltpu.VMEM((nc, nbin), jnp.float32),
                         pltpu.VMEM((ns, nbin), jnp.float32)],
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=_SCALER_VMEM_BYTES),
     )(disp, w_rows)
     return a[:nchan], t1[:nsub]
@@ -677,16 +708,49 @@ def weighted_marginals_pallas(disp, weights):
     return _marginals_fn()(disp, weights.astype(jnp.float32))
 
 
-def _write_diags(wres, mask, cos_ref, sin_ref,
-                 std_ref, mean_ref, ptp_ref, fft_ref, num_k):
+class _RefSink:
+    """Diagnostics destination of the standalone fused kernels: each
+    statistic goes straight to its (1, S, C) output block ref (slots
+    0..3 = std, mean, ptp, fft)."""
+
+    def __init__(self, std_ref, mean_ref, ptp_ref, fft_ref):
+        self.refs = (std_ref, mean_ref, ptp_ref, fft_ref)
+
+    def store(self, slot, value):
+        self.refs[slot][0] = value
+
+    def load_fft(self):
+        return self.refs[3][0]
+
+
+class _SliceSink:
+    """Diagnostics destination of the sweep kernels: statistics accumulate
+    into per-archive (S_pad, nc) VMEM scratch planes at this grid step's
+    cell-block slice, so the final grid step can run the whole scaler +
+    combine + zap stage on the resident planes without another HBM trip."""
+
+    def __init__(self, accs, row, col, s_blk, c_blk):
+        self.accs = accs
+        self.idx = (pl.ds(row, s_blk), pl.ds(col, c_blk))
+
+    def store(self, slot, value):
+        self.accs[slot][self.idx] = value
+
+    def load_fft(self):
+        return self.accs[3][self.idx]
+
+
+def _diag_tail(wres, mask, cos_ref, sin_ref, num_k, sink):
     """Shared diagnostics tail: the four per-cell statistics of a weighted
-    residual tile (S, C, B), written to the (1, S, C) output refs.
+    residual tile (S, C, B), stored through ``sink`` (output refs for the
+    standalone kernels, scratch-plane slices for the sweep kernels — ONE
+    op sequence, so the two stay bit-identical by construction).
 
     The DFT spectrum is swept over ``num_k`` grid steps (innermost grid
     dim; one step when the table fits VMEM whole, see :func:`_k_chunk`):
     each step sees one (B, K_CHUNK) table slice, the k-independent
-    moments are written on the first step only, and ``fft_ref`` holds the
-    running |spectrum|^2 maximum until the last step takes the sqrt."""
+    moments are written on the first step only, and the fft slot holds
+    the running |spectrum|^2 maximum until the last step takes the sqrt."""
     kk = pl.program_id(2)
     nbin = wres.shape[-1]
     inv_n = np.float32(1.0 / nbin)
@@ -694,9 +758,9 @@ def _write_diags(wres, mask, cos_ref, sin_ref,
 
     @pl.when(kk == 0)
     def _moments():
-        mean_ref[0] = jnp.where(mask, np.float32(0.0), mean)
+        sink.store(1, jnp.where(mask, np.float32(0.0), mean))
         ptp = jnp.max(wres, axis=2) - jnp.min(wres, axis=2)
-        ptp_ref[0] = jnp.where(mask, _MA_FILL_F32, ptp)
+        sink.store(2, jnp.where(mask, _MA_FILL_F32, ptp))
 
     # mask-aware mean subtraction (reference :210-211); the tile is
     # VMEM-resident, so the two-pass centred variance (jnp.std's stable
@@ -708,7 +772,7 @@ def _write_diags(wres, mask, cos_ref, sin_ref,
     @pl.when(kk == 0)
     def _variance():
         var = jnp.sum(centred * centred, axis=2) * inv_n
-        std_ref[0] = jnp.where(mask, np.float32(0.0), jnp.sqrt(var))
+        sink.store(0, jnp.where(mask, np.float32(0.0), jnp.sqrt(var)))
 
     flat = centred.reshape(-1, nbin)                # (S*C, B)
     re = jax.lax.dot_general(flat, cos_ref[:], (((1,), (0,)), ((), ())),
@@ -722,15 +786,22 @@ def _write_diags(wres, mask, cos_ref, sin_ref,
 
     @pl.when(kk == 0)
     def _init_fft():
-        fft_ref[0] = chunk_max
+        sink.store(3, chunk_max)
 
     @pl.when(kk > 0)
     def _acc_fft():
-        fft_ref[0] = jnp.maximum(fft_ref[0], chunk_max)
+        sink.store(3, jnp.maximum(sink.load_fft(), chunk_max))
 
     @pl.when(kk == num_k - 1)
     def _final_fft():
-        fft_ref[0] = jnp.sqrt(fft_ref[0])
+        sink.store(3, jnp.sqrt(sink.load_fft()))
+
+
+def _write_diags(wres, mask, cos_ref, sin_ref,
+                 std_ref, mean_ref, ptp_ref, fft_ref, num_k):
+    """:func:`_diag_tail` onto the (1, S, C) output block refs."""
+    _diag_tail(wres, mask, cos_ref, sin_ref, num_k,
+               _RefSink(std_ref, mean_ref, ptp_ref, fft_ref))
 
 
 def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
@@ -746,6 +817,35 @@ def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
     wres = resid * w_ref[0][:, :, None]             # apply_weights
     _write_diags(wres, m_ref[0], cos_ref, sin_ref,
                  std_ref, mean_ref, ptp_ref, fft_ref, num_k)
+
+
+def _wres_disp(disp, rott, nyq, tt_safe, tt_zero, w, *, apply_nyq):
+    """Dispersed-frame one-read weighted residual of a (S, C, B) cube
+    block: fit against the rotated template, Nyquist round-trip
+    correction, weighting.  The shared body of
+    :func:`_cell_stats_disp_kernel` and the sweep kernel — one op
+    sequence, bit-identical residuals by construction."""
+    tp = jnp.sum(disp * rott[None], axis=2)
+    amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
+    base = disp
+    if apply_nyq:
+        nbin = disp.shape[-1]
+        alt = (1.0 - 2.0 * (jax.lax.broadcasted_iota(
+            jnp.int32, (nbin,), 0) % 2)).astype(disp.dtype)
+        nyqcoef = jnp.sum(disp * alt[None, None, :], axis=2)
+        base = disp + nyqcoef[:, :, None] * nyq[None]
+    resid = amp[:, :, None] * rott[None] - base
+    return resid * w[:, :, None]                    # apply_weights
+
+
+def _wres_dedisp(ded, t, win, tt_safe, tt_zero, w):
+    """Dedispersed-frame weighted residual of a (S, C, B) cube block:
+    ``(amp*t - ded) * window``, weighted.  Shared by
+    :func:`_cell_stats_dedisp_kernel` and the sweep kernel."""
+    tp = jnp.sum(ded * t[None, None, :], axis=2)
+    amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
+    resid = (amp[:, :, None] * t[None, None, :] - ded) * win[None, None, :]
+    return resid * w[:, :, None]                    # apply_weights
 
 
 def _cell_stats_disp_kernel(disp_ref, rott_ref, nyq_ref, w_ref, m_ref,
@@ -766,20 +866,9 @@ def _cell_stats_disp_kernel(disp_ref, rott_ref, nyq_ref, w_ref, m_ref,
     alternating-sign reduction per VMEM-resident cell — ``nyq_ref`` rows
     carry ``(gamma_c / nbin) * (-1)^b``.  Roll rotation / odd nbin
     round-trip exactly: the static flag compiles the term away."""
-    rott = rott_ref[0]                              # (C, B)
     tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
-    disp = disp_ref[:]                              # (S, C, B)
-    tp = jnp.sum(disp * rott[None], axis=2)
-    amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
-    base = disp
-    if apply_nyq:
-        nbin = disp.shape[-1]
-        alt = (1.0 - 2.0 * (jax.lax.broadcasted_iota(
-            jnp.int32, (nbin,), 0) % 2)).astype(disp.dtype)
-        nyqcoef = jnp.sum(disp * alt[None, None, :], axis=2)
-        base = disp + nyqcoef[:, :, None] * nyq_ref[0][None]
-    resid = amp[:, :, None] * rott[None] - base
-    wres = resid * w_ref[0][:, :, None]             # apply_weights
+    wres = _wres_disp(disp_ref[:], rott_ref[0], nyq_ref[0], tt_safe,
+                      tt_zero, w_ref[0], apply_nyq=apply_nyq)
     _write_diags(wres, m_ref[0], cos_ref, sin_ref,
                  std_ref, mean_ref, ptp_ref, fft_ref, num_k)
 
@@ -790,14 +879,9 @@ def _cell_stats_dedisp_kernel(ded_ref, t_ref, win_ref, w_ref, m_ref,
     """Dedispersed-frame variant: one cube read.  The residual never leaves
     the dedispersed frame, so there is no disp_base input and no per-channel
     rotated template — ``resid = (amp*t - ded) * window``."""
-    t = t_ref[0]                                    # (B,)
-    win = win_ref[0]                                # (B,) pulse-region window
     tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
-    ded = ded_ref[:]                                # (S, C, B)
-    tp = jnp.sum(ded * t[None, None, :], axis=2)
-    amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
-    resid = (amp[:, :, None] * t[None, None, :] - ded) * win[None, None, :]
-    wres = resid * w_ref[0][:, :, None]             # apply_weights
+    wres = _wres_dedisp(ded_ref[:], t_ref[0], win_ref[0], tt_safe, tt_zero,
+                        w_ref[0])
     _write_diags(wres, m_ref[0], cos_ref, sin_ref,
                  std_ref, mean_ref, ptp_ref, fft_ref, num_k)
 
@@ -829,6 +913,7 @@ class _FusedScaffold:
         # tier-strategy change can never hit a stale jit cache entry keyed
         # only on shapes); None keeps the env-selected tier for direct use
         s_blk, c_blk = blocks or _cell_blocks(nbin)
+        self.s_blk = s_blk
         self.c_blk = c_blk
         self.pad_s = (-nsub) % s_blk
         self.pad_c = (-nchan) % c_blk
@@ -836,9 +921,16 @@ class _FusedScaffold:
         self.ns = batch * self.s_pad            # folded subint axis
         self.nc = nchan + self.pad_c
         bpa = self.s_pad // s_blk               # subint blocks per archive
+        self.bpa = bpa
         # kk innermost: the cube/cell blocks' index maps ignore it, so
         # those blocks stay resident in VMEM across the spectrum sweep
         self.grid = (self.ns // s_blk, self.nc // c_blk, num_k)
+        # whole-archive (S_pad, nc) plane in PLAIN layout: the last block
+        # dim is the full array dim, so lane tiling is satisfied without
+        # the chunk-major reshape the small cell blocks need
+        self.plane_spec = pl.BlockSpec((self.s_pad, self.nc),
+                                       lambda i, j, kk: (i // bpa, 0),
+                                       memory_space=pltpu.VMEM)
         self.cell_spec = pl.BlockSpec((1, s_blk, c_blk),
                                       lambda i, j, kk: (j, i, 0),
                                       memory_space=pltpu.VMEM)
@@ -880,10 +972,34 @@ class _FusedScaffold:
         return (self.to_cellrows(weights.reshape(fold)),
                 self.to_cellrows(cell_mask.reshape(fold)))
 
+    def pad_plane(self, x, masked=False):
+        """(B, S, C) cell plane -> folded PLAIN-layout (ns, nc) for the
+        whole-archive ``plane_spec`` blocks; padding cells masked/zero."""
+        pads = ((0, 0), (0, self.pad_s), (0, self.pad_c))
+        if self.pad_s or self.pad_c:
+            x = jnp.pad(x, pads, constant_values=masked)
+        return x.reshape(self.ns, self.nc)
+
     def launch(self, kernel, inputs, in_specs, cos_t, sin_t, tt_info,
                interpret):
+        outs = pl.pallas_call(
+            functools.partial(kernel, num_k=self.num_k),
+            out_shape=[jax.ShapeDtypeStruct(
+                (self.nc // self.c_blk, self.ns, self.c_blk),
+                jnp.float32)] * 4,
+            grid=self.grid,
+            in_specs=list(in_specs) + self._table_specs(cos_t, sin_t),
+            out_specs=[self.cell_spec] * 4,
+            interpret=interpret,
+        )(*inputs, cos_t, sin_t, tt_info)
+        return tuple(
+            o.swapaxes(0, 1).reshape(self.batch, self.s_pad, self.nc)
+            [:, : self.nsub, : self.nchan]
+            for o in outs)
+
+    def _table_specs(self, cos_t, sin_t):
         k_chunk = cos_t.shape[1] // self.num_k
-        table_specs = [
+        return [
             pl.BlockSpec((cos_t.shape[0], k_chunk),
                          lambda i, j, kk: (0, kk),
                          memory_space=pltpu.VMEM),
@@ -892,18 +1008,34 @@ class _FusedScaffold:
                          memory_space=pltpu.VMEM),
             self.tt_spec,
         ]
+
+    def launch_sweep(self, kernel, inputs, in_specs, cos_t, sin_t, tt_info,
+                     interpret):
+        """Sweep-kernel launch: same grid/blocking as :meth:`launch`, but
+        the per-step diagnostics accumulate into four per-archive
+        (S_pad, nc) scratch planes (reused across archives — the TPU grid
+        is sequential) and the outputs are the three whole-archive planes
+        the final grid step of each archive writes: new weights, scores,
+        and the residual-std diagnostic (the engine's telemetry plane)."""
+        plane = pl.BlockSpec((self.s_pad, self.nc),
+                             lambda i, j, kk, bpa=self.bpa: (i // bpa, 0),
+                             memory_space=pltpu.VMEM)
         outs = pl.pallas_call(
-            functools.partial(kernel, num_k=self.num_k),
-            out_shape=[jax.ShapeDtypeStruct(
-                (self.nc // self.c_blk, self.ns, self.c_blk),
-                jnp.float32)] * 4,
+            functools.partial(kernel, num_k=self.num_k, bpa=self.bpa,
+                              nsub=self.nsub, nchan=self.nchan),
+            out_shape=[jax.ShapeDtypeStruct((self.ns, self.nc),
+                                            jnp.float32)] * 3,
             grid=self.grid,
-            in_specs=list(in_specs) + table_specs,
-            out_specs=[self.cell_spec] * 4,
+            in_specs=list(in_specs) + self._table_specs(cos_t, sin_t),
+            out_specs=[plane] * 3,
+            scratch_shapes=[pltpu.VMEM((self.s_pad, self.nc),
+                                       jnp.float32)] * 4,
             interpret=interpret,
+            compiler_params=_CompilerParams(
+                vmem_limit_bytes=_SCALER_VMEM_BYTES),
         )(*inputs, cos_t, sin_t, tt_info)
         return tuple(
-            o.swapaxes(0, 1).reshape(self.batch, self.s_pad, self.nc)
+            o.reshape(self.batch, self.s_pad, self.nc)
             [:, : self.nsub, : self.nchan]
             for o in outs)
 
@@ -1118,6 +1250,354 @@ def cell_diagnostics_pallas_dedisp(ded, template, window, weights, cell_mask):
     under ``vmap`` like :func:`cell_diagnostics_pallas`."""
     return _fused_dedisp(ded, template, window.astype(jnp.float32),
                          weights.astype(jnp.float32), cell_mask)
+
+
+# ---------------------------------------------------------------------------
+# Fused sweep: diagnostics + scaler + combine + zap, one cube read
+# ---------------------------------------------------------------------------
+#
+# The fused cell kernels above still hand their four diagnostic planes back
+# to XLA for the scaler/combine/zap stages — three more launches plus four
+# plane round-trips through HBM per iteration.  The sweep kernels keep the
+# per-archive diagnostic planes in VMEM scratch for the whole launch
+# (sequential TPU grid, same idiom as _marginals_kernel) and, on each
+# archive's final grid step, run the entire remaining iteration tail —
+# both scaler orientations (_scaled_sides_body), the 4-way median
+# (_median4), and the threshold/zap — on the resident planes.  One kernel,
+# one cube-tile read per iteration; outputs are the new weights, the
+# scores, and the residual-std plane (the engine's telemetry input).
+#
+# Bit-equality with the unfused route is by construction: the residual and
+# diagnostics bodies are the SAME functions the standalone kernels trace
+# (_wres_disp/_wres_dedisp, _diag_tail), and the combine tail reuses the
+# scaler body already locked in as bit-identical to the sort/XLA route.
+# Hardware status: interpret-verified; Mosaic lowering of the combine tail
+# awaits a TPU validation pass (same class as the k-chunked 2048/4096
+# path) — the engine knob's 'auto' is gated on the fused-stats resolution,
+# not on a separate hardware allowlist.
+
+# The sweep kernel's whole-archive VMEM set: four scratch planes, three
+# output planes, the two plain-layout input planes, plus the combine
+# stage's plane-sized bisection temporaries — conservatively budgeted as
+# 12 resident (S_pad, nc) float32 planes against a 24 MiB cap (the same
+# budget class as MARGINALS_PALLAS_MAX_BYTES).  Bigger cell planes keep
+# the multi-kernel route.
+FUSED_SWEEP_MAX_BYTES = 24 * 2**20
+
+
+def fused_sweep_eligible(nsub: int, nchan: int, nbin: int) -> bool:
+    """THE eligibility predicate for the fused sweep kernels — callers
+    (engine/loop.py, online/session.py, bench.py's bytes-moved model)
+    must use this, not re-derive the plane budget.  Geometry-only: the
+    float32/backend/knob gates live with the caller (engine routes also
+    require ``stats_impl='fused'`` and an unsharded program)."""
+    if nbin > FUSED_STATS_MAX_NBIN:
+        return False
+    s_blk, c_blk = _cell_blocks(nbin)
+    s_pad = nsub + (-nsub) % s_blk
+    nc = nchan + (-nchan) % c_blk
+    return 12 * s_pad * nc * 4 <= FUSED_SWEEP_MAX_BYTES
+
+
+def _combine_zap(d0, d1, d2, d3, mask, worig, chanthresh, subintthresh,
+                 pad_mask):
+    """The iteration tail on whole (S, C) VMEM planes: both scaler
+    orientations, the 4-way median, and the threshold/zap.  One op
+    sequence shared by the sweep kernels' final step and the standalone
+    :func:`fused_combine_pallas` launch.
+
+    ``pad_mask`` marks grid-padding cells (None when the planes are
+    unpadded): they are already True in ``mask`` (masked medians skip
+    them), and the rFFT diagnostic's plain path gets them as
+    ``plain_mask`` — rank selection over exactly the real cells, the way
+    cropping would — with the plane zeroed at pads first so the
+    NaN-propagation patch (which scans whole lines) sees finite values
+    there.  Outputs at padding cells are garbage and must be cropped."""
+    if pad_mask is not None:
+        d3 = jnp.where(pad_mask, np.float32(0.0), d3)
+    chan = _scaled_sides_body(d0, d1, d2, d3, mask, chanthresh,
+                              plain_mask=pad_mask)
+    sub_pm = None if pad_mask is None else pad_mask.T
+    # transposed orientation in VMEM (the _scaled_sides_t_kernel trick: a
+    # transpose moves values, it does not round them)
+    sub = _scaled_sides_body(d0.T, d1.T, d2.T, d3.T, mask.T, subintthresh,
+                             plain_mask=sub_pm)
+    per = [jnp.maximum(c, s.T) for c, s in zip(chan, sub)]
+    scores = _median4(*per)
+    new_w = jnp.where(scores >= np.float32(1.0), np.float32(0.0), worig)
+    return new_w, scores
+
+
+def _sweep_combine(i, j, kk, bpa, num_k, nsub, nchan, accs, mplane_ref,
+                   worig_ref, neww_ref, scores_ref, dstd_ref,
+                   chanthresh, subintthresh):
+    """Shared final-step tail of the sweep kernels: on each archive's last
+    grid step, combine the resident scratch planes and write the three
+    whole-archive output planes."""
+    i_loc = i % bpa
+
+    @pl.when((i_loc == bpa - 1) & (j == pl.num_programs(1) - 1)
+             & (kk == num_k - 1))
+    def _combine():
+        m = mplane_ref[:]
+        s_pad, nc = m.shape
+        pad_mask = None
+        if s_pad != nsub or nc != nchan:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (s_pad, nc), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (s_pad, nc), 1)
+            pad_mask = (rows >= nsub) | (cols >= nchan)
+        new_w, scores = _combine_zap(
+            accs[0][...], accs[1][...], accs[2][...], accs[3][...],
+            m, worig_ref[:], chanthresh, subintthresh, pad_mask)
+        neww_ref[...] = new_w
+        scores_ref[...] = scores
+        dstd_ref[...] = accs[0][...]
+
+
+def _sweep_disp_kernel(disp_ref, rott_ref, nyq_ref, w_ref, m_ref,
+                       mplane_ref, worig_ref, cos_ref, sin_ref, tt_ref,
+                       neww_ref, scores_ref, dstd_ref,
+                       std_acc, mean_acc, ptp_acc, fft_acc, *, num_k, bpa,
+                       nsub, nchan, apply_nyq, chanthresh, subintthresh):
+    """Dispersed-frame one-read SWEEP: :func:`_cell_stats_disp_kernel`'s
+    per-step body accumulating into per-archive scratch planes, plus the
+    combine/zap tail on each archive's final grid step."""
+    i, j, kk = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
+    wres = _wres_disp(disp_ref[:], rott_ref[0], nyq_ref[0], tt_safe,
+                      tt_zero, w_ref[0], apply_nyq=apply_nyq)
+    accs = (std_acc, mean_acc, ptp_acc, fft_acc)
+    s_blk, c_blk = disp_ref.shape[0], disp_ref.shape[1]
+    _diag_tail(wres, m_ref[0], cos_ref, sin_ref, num_k,
+               _SliceSink(accs, (i % bpa) * s_blk, j * c_blk, s_blk, c_blk))
+    _sweep_combine(i, j, kk, bpa, num_k, nsub, nchan, accs, mplane_ref,
+                   worig_ref, neww_ref, scores_ref, dstd_ref,
+                   chanthresh, subintthresh)
+
+
+def _sweep_dedisp_kernel(ded_ref, t_ref, win_ref, w_ref, m_ref,
+                         mplane_ref, worig_ref, cos_ref, sin_ref, tt_ref,
+                         neww_ref, scores_ref, dstd_ref,
+                         std_acc, mean_acc, ptp_acc, fft_acc, *, num_k, bpa,
+                         nsub, nchan, chanthresh, subintthresh):
+    """Dedispersed-frame SWEEP twin of :func:`_cell_stats_dedisp_kernel`."""
+    i, j, kk = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
+    wres = _wres_dedisp(ded_ref[:], t_ref[0], win_ref[0], tt_safe, tt_zero,
+                        w_ref[0])
+    accs = (std_acc, mean_acc, ptp_acc, fft_acc)
+    s_blk, c_blk = ded_ref.shape[0], ded_ref.shape[1]
+    _diag_tail(wres, m_ref[0], cos_ref, sin_ref, num_k,
+               _SliceSink(accs, (i % bpa) * s_blk, j * c_blk, s_blk, c_blk))
+    _sweep_combine(i, j, kk, bpa, num_k, nsub, nchan, accs, mplane_ref,
+                   worig_ref, neww_ref, scores_ref, dstd_ref,
+                   chanthresh, subintthresh)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_k", "interpret", "blocks",
+                                    "apply_nyq", "chanthresh",
+                                    "subintthresh"))
+def _sweep_disp_call(disp, rot_t, nyq_row, tt_info, weights, cell_mask,
+                     cos_t, sin_t, num_k, interpret, blocks, apply_nyq,
+                     chanthresh, subintthresh):
+    sc = _FusedScaffold(*disp.shape[1:], num_k, batch=disp.shape[0],
+                        blocks=blocks)
+    w_cells, m_cells = sc.pad_cells(weights, cell_mask)
+    kernel = functools.partial(_sweep_disp_kernel, apply_nyq=apply_nyq,
+                               chanthresh=chanthresh,
+                               subintthresh=subintthresh)
+    return sc.launch_sweep(
+        kernel,
+        (sc.pad_cube(disp), sc.pad_chan_row(rot_t),
+         sc.pad_chan_row(nyq_row), w_cells, m_cells,
+         sc.pad_plane(cell_mask, masked=True), sc.pad_plane(weights)),
+        (sc.cube_spec, sc.chan_row_spec, sc.chan_row_spec, sc.cell_spec,
+         sc.cell_spec, sc.plane_spec, sc.plane_spec),
+        cos_t, sin_t, tt_info, interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_k", "interpret", "blocks",
+                                    "chanthresh", "subintthresh"))
+def _sweep_dedisp_call(ded, template, window, tt_info, weights, cell_mask,
+                       cos_t, sin_t, num_k, interpret, blocks, chanthresh,
+                       subintthresh):
+    sc = _FusedScaffold(*ded.shape[1:], num_k, batch=ded.shape[0],
+                        blocks=blocks)
+    w_cells, m_cells = sc.pad_cells(weights, cell_mask)
+    kernel = functools.partial(_sweep_dedisp_kernel, chanthresh=chanthresh,
+                               subintthresh=subintthresh)
+    return sc.launch_sweep(
+        kernel,
+        (sc.pad_cube(ded), template, window, w_cells, m_cells,
+         sc.pad_plane(cell_mask, masked=True), sc.pad_plane(weights)),
+        (sc.cube_spec, sc.row_spec, sc.row_spec, sc.cell_spec,
+         sc.cell_spec, sc.plane_spec, sc.plane_spec),
+        cos_t, sin_t, tt_info, interpret)
+
+
+def _fused_sweep_disp_batched(disp, rot_t, nyq_row, template, weights,
+                              cell_mask, apply_nyq, chanthresh,
+                              subintthresh):
+    cos_t, sin_t, num_k, interpret = _fused_tables(disp.shape[-1],
+                                                   disp.dtype)
+    return _sweep_disp_call(disp, rot_t, nyq_row, _tt_info(template),
+                            weights.astype(jnp.float32), cell_mask,
+                            cos_t, sin_t, num_k, interpret,
+                            _cell_blocks(disp.shape[-1]), apply_nyq,
+                            chanthresh, subintthresh)
+
+
+@functools.lru_cache(maxsize=16)
+def _fused_sweep_disp_fn(apply_nyq: bool, chanthresh: float,
+                         subintthresh: float):
+    from jax.custom_batching import custom_vmap as _custom_vmap
+
+    @_custom_vmap
+    def f(disp, rot_t, nyq_row, template, weights, cell_mask):
+        outs = _fused_sweep_disp_batched(
+            disp[None], rot_t[None], nyq_row[None], template[None],
+            weights[None], cell_mask[None], apply_nyq, chanthresh,
+            subintthresh)
+        return tuple(o[0] for o in outs)
+
+    @f.def_vmap
+    def _rule(axis_size, in_batched, *args):
+        # batched archives fold into the subint grid of ONE launch; the
+        # per-archive combine fires on each archive's final grid step
+        return (_fused_sweep_disp_batched(
+            *_batch_args(axis_size, in_batched, *args), apply_nyq,
+            chanthresh, subintthresh), (True,) * 3)
+
+    return f
+
+
+def fused_sweep_pallas(disp, rot_t, nyq_row, template, weights, cell_mask,
+                       chanthresh, subintthresh):
+    """Dispersed-frame one-read fused SWEEP (float32; interpreted off-TPU):
+    fit + residual + diagnostics + scaler + combine + zap in ONE kernel
+    reading each cube tile exactly once.  ``weights`` is the plane the
+    residual is weighted by AND the zap edits — the engine's
+    ``orig_weights`` (reference :112: zaps re-derive from the original
+    weights each round).  Returns (new_weights, scores, d_std), each
+    (nsub, nchan) float32, bit-equal to the unfused
+    :func:`cell_diagnostics_pallas_disp` +
+    :func:`masked_jax.scale_and_combine` + threshold route.  Batches
+    under ``vmap`` by folding archives into the launch grid."""
+    apply_nyq = nyq_row is not None
+    if nyq_row is None:
+        nyq_row = jnp.zeros_like(rot_t)
+    return _fused_sweep_disp_fn(apply_nyq, float(chanthresh),
+                                float(subintthresh))(
+        disp, rot_t, nyq_row, template, weights.astype(jnp.float32),
+        cell_mask)
+
+
+def _fused_sweep_dedisp_batched(ded, template, window, weights, cell_mask,
+                                chanthresh, subintthresh):
+    cos_t, sin_t, num_k, interpret = _fused_tables(ded.shape[-1], ded.dtype)
+    return _sweep_dedisp_call(ded, template, window, _tt_info(template),
+                              weights.astype(jnp.float32), cell_mask,
+                              cos_t, sin_t, num_k, interpret,
+                              _cell_blocks(ded.shape[-1]), chanthresh,
+                              subintthresh)
+
+
+@functools.lru_cache(maxsize=16)
+def _fused_sweep_dedisp_fn(chanthresh: float, subintthresh: float):
+    from jax.custom_batching import custom_vmap as _custom_vmap
+
+    @_custom_vmap
+    def f(ded, template, window, weights, cell_mask):
+        outs = _fused_sweep_dedisp_batched(
+            ded[None], template[None], window[None], weights[None],
+            cell_mask[None], chanthresh, subintthresh)
+        return tuple(o[0] for o in outs)
+
+    @f.def_vmap
+    def _rule(axis_size, in_batched, *args):
+        return (_fused_sweep_dedisp_batched(
+            *_batch_args(axis_size, in_batched, *args), chanthresh,
+            subintthresh), (True,) * 3)
+
+    return f
+
+
+def fused_sweep_pallas_dedisp(ded, template, window, weights, cell_mask,
+                              chanthresh, subintthresh):
+    """Dedispersed-frame fused SWEEP twin of :func:`fused_sweep_pallas`:
+    one cube read, returns (new_weights, scores, d_std).  ``window`` is
+    the (nbin,) pulse-region multiplier (all ones when inactive)."""
+    return _fused_sweep_dedisp_fn(float(chanthresh), float(subintthresh))(
+        ded, template, window.astype(jnp.float32),
+        weights.astype(jnp.float32), cell_mask)
+
+
+def _fused_combine_kernel(d0_ref, d1_ref, d2_ref, d3_ref, m_ref, worig_ref,
+                          neww_ref, scores_ref, *, nsub, nchan, chanthresh,
+                          subintthresh):
+    s_pad, nc = m_ref.shape
+    pad_mask = None
+    if s_pad != nsub or nc != nchan:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (s_pad, nc), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (s_pad, nc), 1)
+        pad_mask = (rows >= nsub) | (cols >= nchan)
+    new_w, scores = _combine_zap(d0_ref[:], d1_ref[:], d2_ref[:], d3_ref[:],
+                                 m_ref[:], worig_ref[:], chanthresh,
+                                 subintthresh, pad_mask)
+    neww_ref[...] = new_w
+    scores_ref[...] = scores
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("chanthresh", "subintthresh",
+                                    "interpret"))
+def _fused_combine_call(d0, d1, d2, d3, cell_mask, worig, chanthresh,
+                        subintthresh, interpret):
+    nsub, nchan = d0.shape
+    pad_s, pad_c = (-nsub) % 8, (-nchan) % 128
+    if pad_s or pad_c:
+        pads = ((0, pad_s), (0, pad_c))
+        d0, d1, d2, d3, worig = (jnp.pad(x, pads)
+                                 for x in (d0, d1, d2, d3, worig))
+        cell_mask = jnp.pad(cell_mask, pads, constant_values=True)
+    shape = d0.shape
+    spec = pl.BlockSpec(shape, lambda i: (0, 0), memory_space=pltpu.VMEM)
+    kernel = functools.partial(_fused_combine_kernel, nsub=nsub,
+                               nchan=nchan, chanthresh=chanthresh,
+                               subintthresh=subintthresh)
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct(shape, jnp.float32)] * 2,
+        grid=(1,),
+        in_specs=[spec] * 6,
+        out_specs=[spec] * 2,
+        interpret=interpret,
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=_SCALER_VMEM_BYTES),
+    )(d0, d1, d2, d3, cell_mask, worig)
+    return tuple(o[:nsub, :nchan] for o in outs)
+
+
+def fused_combine_pallas(diagnostics, cell_mask, orig_weights, chanthresh,
+                         subintthresh):
+    """The iteration tail — both scaler orientations, 4-way median,
+    threshold/zap — as ONE launch on already-computed diagnostic planes
+    (float32; interpreted off-TPU).  Returns (new_weights, scores),
+    bit-equal to :func:`masked_jax.scale_and_combine` (any median_impl)
+    plus the threshold.  Built for exact streaming's per-iteration
+    combine, where the planes are device-resident tile concatenations and
+    the multi-launch scaler route would round-trip them through HBM (and,
+    host-side, back over the interconnect) every iteration."""
+    d0, d1, d2, d3 = diagnostics
+    if d0.dtype != jnp.float32:
+        raise TypeError("fused_combine_pallas requires float32, got %s"
+                        % d0.dtype)
+    return _fused_combine_call(d0, d1, d2, d3, cell_mask,
+                               orig_weights.astype(jnp.float32),
+                               float(chanthresh), float(subintthresh),
+                               _interpret_default())
 
 
 @functools.lru_cache(maxsize=8)

@@ -35,6 +35,11 @@ FORMAT_VERSION = 1
 # change whether a faulted run survives, never what a surviving archive's
 # mask is — a resume under a different --retries must still match).
 _IDENTITY_EXCLUDE = {"unload_res", "record_history",
+                     # fused_sweep routes the same kernel bodies through
+                     # one launch instead of several; masks are bit-equal
+                     # at every setting (tests/test_fused_sweep.py), so a
+                     # resume under a different --fused-sweep must match
+                     "fused_sweep",
                      "fleet_retries", "stage_timeout_s",
                      # host placement/lease knobs: which process serves a
                      # bucket never changes its mask — stolen work must

@@ -36,11 +36,35 @@ _STRAY_FILES = ("clean.log", "serve.flight.json", "serve.flight.1.json",
                 "serve.journal.jsonl")
 
 
+def _tracked_stray_files():
+    """Known droppings that are not merely present but COMMITTED — a past
+    session's litter that `git add -A` swept into history (how
+    serve.flight.json escaped once).  Empty when git is unavailable."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "ls-files", "--", *_STRAY_FILES, "serve.flight*.json"],
+            cwd=_REPO_ROOT, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    if out.returncode != 0:
+        return []
+    return sorted(set(out.stdout.split()))
+
+
 @pytest.fixture(scope="session", autouse=True)
 def repo_tree_stays_clean():
     """Regression guard: the suite leaves the repo root clean.  Records
     which known droppings pre-exist (a dirty checkout is not this
-    session's fault), then fails the session if a test created one."""
+    session's fault), then fails the session if a test created one.
+    Tracked droppings fail IMMEDIATELY: those are already committed
+    litter, and only a human `git rm` fixes them."""
+    tracked = _tracked_stray_files()
+    assert not tracked, (
+        f"flight-recorder/log artifacts are COMMITTED to the repo: "
+        f"{tracked}; `git rm` them and keep the .gitignore patterns "
+        f"(serve.flight*.json) that stop the next escape")
     before = {n for n in _STRAY_FILES
               if os.path.exists(os.path.join(_REPO_ROOT, n))}
     yield

@@ -70,7 +70,8 @@ def test_bench_small_end_to_end_json_schema():
     # streaming row: measured-transfer contract (tile cache H2D counter)
     for key in ("streaming_geometry", "streaming_platform",
                 "streaming_tile_passes_per_s", "streaming_eff_gbps",
-                "streaming_h2d_bytes", "streaming_vs_whole"):
+                "streaming_h2d_bytes", "streaming_vs_whole",
+                "streaming_sweep_cube_reads"):
         assert key in out, (key, err)
     # the interim modeled-throughput companion key is retired: every
     # shipped figure is measured
@@ -144,6 +145,24 @@ def test_bench_small_end_to_end_json_schema():
     assert out["online_recompiles_steady"] == 0
     assert out["online_warmup_compiles"] >= 1
     assert out["online_vs_batch_masks"] == "identical"
+    # fused-sweep row: warm best-of-2 timing plus the deterministic
+    # contracts (strict program shrink, strict streaming-H2D shrink, and
+    # the single-read cube budget — each rc-7 fatal inside the stage, so
+    # their mere presence means they held); the sweep_cube_reads keys on
+    # the streaming/online rows report the per-iteration budget of the
+    # route those rows actually resolved (1 fused, 2 multi-kernel)
+    for key in ("fused_geometry", "fused_platform", "fused_vs_unfused",
+                "fused_sweep_cube_reads", "fused_eqns",
+                "fused_unfused_eqns", "fused_stream_h2d_bytes",
+                "fused_unfused_stream_h2d_bytes"):
+        assert key in out, (key, err)
+    assert out["fused_vs_unfused"] > 0
+    assert out["fused_sweep_cube_reads"] == 1
+    assert out["fused_eqns"] < out["fused_unfused_eqns"]
+    assert 0 < out["fused_stream_h2d_bytes"] \
+        < out["fused_unfused_stream_h2d_bytes"]
+    assert out["streaming_sweep_cube_reads"] in (1, 2)
+    assert out["online_sweep_cube_reads"] in (1, 2)
 
 
 @pytest.mark.slow
@@ -254,3 +273,27 @@ def test_cube_passes_model_tracks_engine_routes():
     assert bench._cube_passes("fused", "dedispersed") == 3.0
     assert bench._cube_passes("fused", "dispersed", "profile") == 3.0
     assert bench._cube_passes("xla", "dispersed", "profile") == 6.0
+
+
+def test_sweep_cube_reads_tracks_route_selection():
+    """The bench rows' per-iteration sweep read budget must mirror the
+    engine's actual route: 1 where the fused sweep engages (proven by
+    tracing the kernel through the --selfcheck contract counter), 2 on
+    the multi-kernel route (residual write + diagnostics read), and the
+    nsub=1 online step must still prove 1 despite the counter's
+    cell-table shape heuristic."""
+    spec = importlib.util.spec_from_file_location(
+        "bench3", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    from iterative_cleaner_tpu.config import CleanConfig
+
+    fused = CleanConfig(backend="jax", dtype="float32", stats_impl="fused",
+                        fft_mode="dft", fused_sweep="on")
+    assert bench._sweep_cube_reads(fused, 16, 32, 64) == 1
+    assert bench._sweep_cube_reads(fused, 1, 32, 64) == 1   # online step
+    off = CleanConfig(backend="jax", dtype="float32", stats_impl="fused",
+                      fft_mode="dft", fused_sweep="off")
+    assert bench._sweep_cube_reads(off, 16, 32, 64) == 2
+    # geometry past the VMEM gate falls back to the multi-kernel route
+    assert bench._sweep_cube_reads(fused, 20000, 4096, 64) == 2

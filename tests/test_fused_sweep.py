@@ -1,0 +1,327 @@
+"""Fused sweep kernel (stats/pallas_kernels.fused_sweep_pallas*): the
+template-subtract -> robust-stats -> threshold/zap iteration tail as ONE
+Pallas launch, reading each cube tile exactly once.
+
+The central contract: masks and scores are BIT-EQUAL to the multi-kernel
+route (cell diagnostics + scale_and_combine + zap) at every setting —
+`--fused-sweep on|auto` may change launch count and transfer volume,
+never a single mask bit.  Everything here runs the kernels in interpret
+mode on CPU (the conftest platform pin), which is the same numerics path
+Mosaic compiles on TPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from iterative_cleaner_tpu.stats import pallas_kernels as pk
+from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
+
+CH, ST = 4.0, 4.0
+
+
+def _case(rng, nsub, nchan, nbin, zap_frac=0.2, nan_template=False):
+    cube = rng.normal(size=(nsub, nchan, nbin)).astype(np.float32)
+    t = rng.normal(size=(nbin,)).astype(np.float32)
+    if nan_template:
+        t[3] = np.nan
+    w = rng.uniform(0.5, 2.0, size=(nsub, nchan)).astype(np.float32)
+    w[rng.uniform(size=(nsub, nchan)) < zap_frac] = 0.0
+    m = w == 0
+    return jnp.asarray(cube), jnp.asarray(t), jnp.asarray(w), jnp.asarray(m)
+
+
+# ------------------------------------------------------- kernel-level parity
+
+def test_median4_matches_jnp_median_bitwise():
+    """The in-kernel 4-way median network vs jnp.median, including the
+    NaN-propagation and signed-zero cases the scorer leans on."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(4, 2000)).astype(np.float32)
+    x[0, :10] = np.nan
+    x[1, 10:20] = np.inf
+    x[2, 20:30] = -np.inf
+    x[3, 30:40] = -0.0
+    x[0, 40:50] = 0.0
+    got = np.asarray(pk._median4(*(jnp.asarray(x[i]) for i in range(4))))
+    want = np.asarray(jnp.median(jnp.asarray(x), axis=0))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("nsub,nchan,nbin,kw", [
+    (12, 10, 32, {}),
+    (8, 128, 64, {}),                      # lane-exact channel count
+    (3, 5, 16, {}),                        # heavy sublane+lane padding
+    (12, 10, 32, {"zap_frac": 0.9}),       # nearly-dead plane
+    (12, 10, 32, {"nan_template": True}),  # NaN propagation
+])
+def test_fused_sweep_dedispersed_bit_equal(nsub, nchan, nbin, kw):
+    rng = np.random.default_rng(11)
+    ded, t, w, m = _case(rng, nsub, nchan, nbin, **kw)
+    win = jnp.ones((nbin,), jnp.float32)
+    diags = pk.cell_diagnostics_pallas_dedisp(ded, t, win, w, m)
+    scores_ref = scale_and_combine(diags, m, CH, ST, median_impl="pallas")
+    neww_ref = jnp.where(scores_ref >= 1.0, 0.0, w)
+    neww, scores, dstd = pk.fused_sweep_pallas_dedisp(
+        ded, t, win, w, m, CH, ST)
+    np.testing.assert_array_equal(np.asarray(dstd), np.asarray(diags[0]))
+    np.testing.assert_array_equal(np.asarray(scores),
+                                  np.asarray(scores_ref))
+    np.testing.assert_array_equal(np.asarray(neww), np.asarray(neww_ref))
+
+
+@pytest.mark.parametrize("nsub,nchan,nbin,apply_nyq,kw", [
+    (12, 10, 32, False, {}),
+    (12, 10, 32, True, {}),
+    (3, 5, 16, True, {"nan_template": True}),
+])
+def test_fused_sweep_dispersed_bit_equal(nsub, nchan, nbin, apply_nyq, kw):
+    rng = np.random.default_rng(13)
+    disp, t, w, m = _case(rng, nsub, nchan, nbin, **kw)
+    rot_t = jnp.asarray(rng.normal(size=(nchan, nbin)).astype(np.float32))
+    nyq_row = None
+    if apply_nyq:
+        nyq_row = jnp.asarray(
+            (rng.normal(size=(nchan, nbin)) * 0.01).astype(np.float32))
+    diags = pk.cell_diagnostics_pallas_disp(disp, rot_t, nyq_row, t, w, m)
+    scores_ref = scale_and_combine(diags, m, CH, ST, median_impl="pallas")
+    neww_ref = jnp.where(scores_ref >= 1.0, 0.0, w)
+    neww, scores, dstd = pk.fused_sweep_pallas(
+        disp, rot_t, nyq_row, t, w, m, CH, ST)
+    np.testing.assert_array_equal(np.asarray(dstd), np.asarray(diags[0]))
+    np.testing.assert_array_equal(np.asarray(scores),
+                                  np.asarray(scores_ref))
+    np.testing.assert_array_equal(np.asarray(neww), np.asarray(neww_ref))
+
+
+def test_fused_sweep_vmap_folds_batch_bit_equal():
+    """The custom_vmap rule folds the batch into the subint grid axis of a
+    single launch; every batch element must match its unbatched call."""
+    rng = np.random.default_rng(17)
+    batch, nsub, nchan, nbin = 2, 6, 7, 32
+    cases = [_case(rng, nsub, nchan, nbin) for _ in range(batch)]
+    ded, t, w, m = (jnp.stack([c[k] for c in cases]) for k in range(4))
+    win = jnp.ones((nbin,), jnp.float32)
+    f = jax.vmap(lambda d, tt, wgt, msk: pk.fused_sweep_pallas_dedisp(
+        d, tt, win, wgt, msk, CH, ST))
+    neww_b, scores_b, dstd_b = f(ded, t, w, m)
+    for b in range(batch):
+        neww, scores, dstd = pk.fused_sweep_pallas_dedisp(
+            ded[b], t[b], win, w[b], m[b], CH, ST)
+        np.testing.assert_array_equal(np.asarray(neww_b[b]),
+                                      np.asarray(neww))
+        np.testing.assert_array_equal(np.asarray(scores_b[b]),
+                                      np.asarray(scores))
+        np.testing.assert_array_equal(np.asarray(dstd_b[b]),
+                                      np.asarray(dstd))
+
+
+def test_fused_combine_bit_equal_and_rejects_f64():
+    """The standalone one-launch tail (exact streaming's combine) vs the
+    scaler + median + threshold composition, on already-computed planes."""
+    rng = np.random.default_rng(19)
+    ded, t, w, m = _case(rng, 12, 10, 32)
+    win = jnp.ones((32,), jnp.float32)
+    diags = pk.cell_diagnostics_pallas_dedisp(ded, t, win, w, m)
+    scores_ref = scale_and_combine(diags, m, CH, ST, median_impl="pallas")
+    neww_ref = jnp.where(scores_ref >= 1.0, 0.0, w)
+    neww, scores = pk.fused_combine_pallas(diags, m, w, CH, ST)
+    np.testing.assert_array_equal(np.asarray(scores),
+                                  np.asarray(scores_ref))
+    np.testing.assert_array_equal(np.asarray(neww), np.asarray(neww_ref))
+    with pytest.raises(TypeError, match="float32"):
+        pk.fused_combine_pallas(
+            tuple(d.astype(jnp.float64) for d in diags), m, w, CH, ST)
+
+
+def test_fused_sweep_eligibility_gate():
+    assert pk.fused_sweep_eligible(12, 10, 32)
+    assert pk.fused_sweep_eligible(64, 128, 256)
+    # scratch budget: 12 planes of (s_pad, nc) f32 must fit the cap
+    assert not pk.fused_sweep_eligible(20000, 4096, 64)
+    # nbin beyond the fused cell-stats ceiling disqualifies outright
+    assert not pk.fused_sweep_eligible(8, 8, 4 * pk.FUSED_STATS_MAX_NBIN)
+
+
+# --------------------------------------------------- knob resolution wiring
+
+def test_config_validates_fused_sweep_values():
+    from iterative_cleaner_tpu.config import CleanConfig
+
+    for v in (None, "auto", "on", "off"):
+        assert CleanConfig(fused_sweep=v).fused_sweep == v
+    with pytest.raises(ValueError, match="fused sweep"):
+        CleanConfig(fused_sweep="bogus")
+
+
+def test_resolve_fused_sweep_env_and_auto(monkeypatch):
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fused_sweep,
+    )
+
+    monkeypatch.delenv("ICLEAN_FUSED_SWEEP", raising=False)
+    assert resolve_fused_sweep("on", "xla") == "on"
+    assert resolve_fused_sweep("off", "fused") == "off"
+    # auto follows the RESOLVED stats_impl: fused kernels -> sweep on
+    assert resolve_fused_sweep("auto", "fused") == "on"
+    assert resolve_fused_sweep("auto", "xla") == "off"
+    assert resolve_fused_sweep(None, "fused") == "on"
+    monkeypatch.setenv("ICLEAN_FUSED_SWEEP", "off")
+    assert resolve_fused_sweep(None, "fused") == "off"
+    monkeypatch.setenv("ICLEAN_FUSED_SWEEP", "junk")
+    with pytest.raises(ValueError, match="fused sweep"):
+        resolve_fused_sweep(None, "fused")
+
+
+def test_checkpoint_identity_excludes_fused_sweep():
+    from iterative_cleaner_tpu.utils.checkpoint import _IDENTITY_EXCLUDE
+
+    assert "fused_sweep" in _IDENTITY_EXCLUDE
+
+
+# ----------------------------------------------------- engine-level parity
+
+def _engine_case():
+    rng = np.random.default_rng(11)
+    nsub, nchan, nbin = 12, 16, 64
+    cube = rng.normal(size=(nsub, nchan, nbin)).astype(np.float32)
+    cube[3, 5] += 40.0
+    cube[:, 9] += 10.0
+    w = np.ones((nsub, nchan), np.float32)
+    w[0, 0] = 0.0
+    freqs = np.linspace(1500.0, 1200.0, nchan)
+    return cube, w, (freqs, 26.0, 1400.0, 0.005)
+
+
+@pytest.mark.parametrize("stats_frame", ["auto", "dedispersed"])
+def test_engine_fused_sweep_masks_bit_equal(stats_frame):
+    """clean_cube with --fused-sweep on/auto vs off: final weights,
+    scores, loop count and per-iteration metrics all bit-equal — `off` is
+    the escape hatch, never a different answer."""
+    from iterative_cleaner_tpu.backends.jax_backend import clean_cube
+    from iterative_cleaner_tpu.config import CleanConfig
+
+    cube, w, args = _engine_case()
+
+    def run(fused_sweep):
+        cfg = CleanConfig(
+            backend="jax", stats_impl="fused", fft_mode="dft",
+            median_impl="sort", fused_sweep=fused_sweep,
+            stats_frame=stats_frame, max_iter=4, chanthresh=2.0,
+            subintthresh=2.0)
+        return clean_cube(cube.copy(), w.copy(), *args, config=cfg)
+
+    off = run("off")
+    assert int((np.asarray(off.final_weights) == 0).sum()) > 1
+    for fused_sweep in ("on", "auto"):  # auto: stats_impl fused -> on
+        got = run(fused_sweep)
+        np.testing.assert_array_equal(got.final_weights, off.final_weights)
+        np.testing.assert_array_equal(got.scores, off.scores)
+        assert got.loops == off.loops and got.converged == off.converged
+        np.testing.assert_array_equal(got.iter_metrics, off.iter_metrics)
+
+
+def test_cli_fused_sweep_flag_round_trips():
+    """--fused-sweep lands on CleanConfig; bad values die in argparse."""
+    from iterative_cleaner_tpu.cli import build_parser, config_from_args
+
+    parser = build_parser()
+    args = parser.parse_args(["in.ar", "--fused-sweep", "on"])
+    assert config_from_args(args).fused_sweep == "on"
+    assert config_from_args(parser.parse_args(["in.ar"])).fused_sweep \
+        is None
+    with pytest.raises(SystemExit):
+        parser.parse_args(["in.ar", "--fused-sweep", "sideways"])
+
+
+# ----------------------------------------- streaming / online route parity
+
+def test_streaming_exact_fused_combine_bit_equal_and_fewer_h2d_bytes():
+    """Exact streaming with the fused one-launch combine: masks/scores
+    bit-equal to the compact-scaler route, and per-run stream_h2d_bytes
+    strictly lower (the four diagnostic planes are never re-uploaded)."""
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+    from iterative_cleaner_tpu.parallel import clean_streaming_exact
+    from iterative_cleaner_tpu.telemetry import MetricsRegistry
+
+    def run(fused_sweep, nsub=20, chunk=8):
+        ar, _ = make_synthetic_archive(nsub=nsub, nchan=16, nbin=32,
+                                       seed=7, n_rfi_cells=8,
+                                       n_prezapped=6)
+        cfg = CleanConfig(backend="jax", dtype="float32",
+                          stats_impl="fused", fft_mode="dft",
+                          median_impl="sort", fused_sweep=fused_sweep,
+                          chanthresh=2.5, subintthresh=2.5, max_iter=4)
+        reg = MetricsRegistry()
+        res = clean_streaming_exact(ar, chunk, cfg, registry=reg)
+        return res, reg.snapshot()["counters"].get("stream_h2d_bytes", 0)
+
+    off, h2d_off = run("off")
+    on, h2d_on = run("on")
+    np.testing.assert_array_equal(off.final_weights, on.final_weights)
+    np.testing.assert_array_equal(off.scores, on.scores)
+    assert off.loops == on.loops and off.converged == on.converged
+    assert h2d_on < h2d_off
+    # single-tile degenerate geometry
+    off1, _ = run("off", nsub=6, chunk=8)
+    on1, _ = run("on", nsub=6, chunk=8)
+    np.testing.assert_array_equal(off1.final_weights, on1.final_weights)
+    np.testing.assert_array_equal(off1.scores, on1.scores)
+
+
+def test_online_session_fused_sweep_reconciles_bit_equal():
+    """Per-subint fused sweep step: the provisional mask may change
+    flavour (DFT-flavoured diagnostics), but the contractual reconcile
+    masks stay bit-equal to the batch clean and to the unfused session,
+    with zero steady-state recompiles."""
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io import make_synthetic_archive
+    from iterative_cleaner_tpu.online import OnlineSession, StreamMeta
+
+    ar, _ = make_synthetic_archive(nsub=6, nchan=8, nbin=16, seed=21)
+    cube = np.asarray(ar.total_intensity(), dtype=np.float64).copy()
+    cube[1, 2, ::2] += 40.0  # structured RFI (survives baseline removal)
+    meta = StreamMeta.from_archive(ar)
+
+    def run(fused_sweep):
+        cfg = CleanConfig(backend="jax", dtype="float32",
+                          stats_impl="fused", fft_mode="dft",
+                          median_impl="sort", max_iter=2,
+                          fused_sweep=fused_sweep,
+                          stream_reconcile_every=0)
+        s = OnlineSession(meta, cfg)
+        for i in range(cube.shape[0]):
+            s.ingest(cube[i])
+        assembled = s.assembled()
+        return assembled, cfg, s.close()
+
+    _, _, off = run("off")
+    assembled, cfg, on = run("on")
+    np.testing.assert_array_equal(off.archive.weights, on.archive.weights)
+    ref = clean_archive(assembled, cfg)
+    np.testing.assert_array_equal(on.archive.weights == 0,
+                                  np.asarray(ref.final_weights) == 0)
+    assert on.recompiles_steady == 0
+    assert on.warmup_compiles >= 1
+
+
+def test_fused_sweep_hot_program_contract_green():
+    """The registered fused_sweep contract: program strictly smaller than
+    the multi-kernel route AND a single cube-tile read per sweep kernel
+    (the bandwidth budget --selfcheck guards)."""
+    from iterative_cleaner_tpu.analysis.jaxpr_contracts import (
+        verify_hot_programs,
+    )
+
+    (report,) = verify_hot_programs(["fused_sweep"])
+    # the pytest session runs x64-on (conftest), which weak-promotes
+    # python scalars and trips no-f64 on EVERY hot program; that contract
+    # is guarded in the deployment config (x64 off) by the selfcheck CLI
+    # subprocess test in test_analysis.py.  Here: the fused-specific ones.
+    bad = [v for v in report.violations if v.contract != "no-f64"]
+    assert not bad, [v.render() for v in bad]
+    assert report.eqn_count > 0

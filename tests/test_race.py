@@ -149,7 +149,7 @@ def test_daemon_guards_its_cross_thread_maps_with_one_lock(tmp_path):
     from iterative_cleaner_tpu.serve.daemon import ServeDaemon
 
     cfg = ServeConfig(journal_path=str(tmp_path / "j.jsonl"),
-                      http_port=0)
+                      http_port=0, flight_recorder="")
     d = ServeDaemon(cfg, CleanConfig(backend="numpy", max_iter=2),
                     quiet=True)
     assert isinstance(d._state_lock, type(threading.Lock()))
