@@ -77,20 +77,13 @@ def oracle_full_rate():
             "vs_baseline denominator is single-sourced there")
     return 1024 * 4096 / float(m.group(1))
 
-# Peak HBM bandwidth by device_kind substring, bytes/s (public chip specs).
-_HBM_PEAK = {
-    "v5 lite": 819e9,   # TPU v5e
-    "v5p": 2765e9,
-    "v4": 1228e9,
-    "v6 lite": 1640e9,  # Trillium
-}
-
-
-def _hbm_peak(device_kind: str):
-    for key, bw in _HBM_PEAK.items():
-        if key in device_kind.lower():
-            return bw
-    return None
+# Peak HBM bandwidth by device_kind substring, bytes/s — single-sourced
+# from the profiler's DEVICE_PEAKS table (telemetry/profiling.py), which
+# is also the denominator behind prof_hbm_util on /metrics: the bench's
+# hbm_util column and the live gauge must agree by construction.
+from iterative_cleaner_tpu.telemetry.profiling import (  # noqa: E402
+    hbm_peak as _hbm_peak,
+)
 
 
 def _cube_passes(stats_impl, stats_frame, baseline_mode="integration",

@@ -210,6 +210,31 @@ def build_parser() -> argparse.ArgumentParser:
                              "Engine phases appear as icln_template / "
                              "icln_residual_stats / icln_scores / icln_zap "
                              "scopes; host phases as icln:load etc.")
+    parser.add_argument("--profile-dir", "--profile_dir", type=str,
+                        default="", dest="profile_dir", metavar="DIR",
+                        help="Enable roofline profiling: capture compiled-"
+                             "program cost/memory analyses as "
+                             "prof_roofline_frac / prof_hbm_gbps gauges "
+                             "and write a jax.profiler trace of the run "
+                             "into DIR (atomic publish; "
+                             "telemetry/profiling.py). Under --serve the "
+                             "DIR arms POST /profile on-demand captures "
+                             "instead. Default: the ICLEAN_PROFILE_DIR "
+                             "env var, else off.")
+    parser.add_argument("--quality-window", "--quality_window", type=int,
+                        default=None, dest="quality_window", metavar="K",
+                        help="Online mode: trailing-window length (subints) "
+                             "for the zap-occupancy drift detector behind "
+                             "quality_drift_alerts (telemetry/quality.py; "
+                             "observability only — never changes a mask). "
+                             "Default: ICLEAN_QUALITY_WINDOW env var, "
+                             "else 16.")
+    parser.add_argument("--quality-drift", "--quality_drift", type=float,
+                        default=None, dest="quality_drift", metavar="F",
+                        help="Online mode: absolute zap-fraction departure "
+                             "from the trailing-window median that raises "
+                             "quality_drift_alerts (default: "
+                             "ICLEAN_QUALITY_DRIFT env var, else 0.15).")
     parser.add_argument("--metrics-json", "--metrics_json", type=str,
                         default="", dest="metrics_json", metavar="PATH",
                         help="Write a JSON run report (counters, phase "
@@ -561,6 +586,8 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         stream_hbm_mb=getattr(args, "stream_hbm_mb", None),
         stream_reconcile_every=getattr(args, "stream_reconcile_every", None),
         stream_ew_alpha=getattr(args, "stream_ew_alpha", None),
+        quality_window=getattr(args, "quality_window", None),
+        quality_drift=getattr(args, "quality_drift", None),
         fleet_bucket_pad=tuple(getattr(args, "bucket_pad", (0, 0))),
         # --fleet reuses --batch B as its group size (same knob, same
         # meaning: archives per compiled program)
@@ -758,8 +785,20 @@ def run_session(args):
     telemetry = RunTelemetry.from_args(args)
     if telemetry.events is not None:
         telemetry.events.emit("run_start", n_archives=len(args.archive))
+    # --profile-dir (or ICLEAN_PROFILE_DIR): wrap the whole run in a
+    # published jax.profiler capture.  --trace already owns the (single)
+    # profiler trace slot, so with both set --trace wins and --profile-dir
+    # contributes only the cost/roofline gauges.
+    prof_dir = (getattr(args, "profile_dir", "")
+                or os.environ.get("ICLEAN_PROFILE_DIR", ""))
+    profile_cm = contextlib.nullcontext()
+    if prof_dir and not args.trace and not getattr(args, "serve", False):
+        from iterative_cleaner_tpu.telemetry import profiling
+
+        profile_cm = profiling.trace_capture(
+            prof_dir, registry=telemetry.registry, label="run")
     try:
-        with device_trace(args.trace):
+        with profile_cm, device_trace(args.trace):
             yield telemetry
     finally:
         telemetry.finalize()
@@ -1077,6 +1116,7 @@ def _run_serve(args, telemetry=None) -> int:
             result_cache=args.result_cache or None,
             # None = not passed (env/default applies); '' disables
             flight_recorder=args.flight_recorder,
+            profile_dir=getattr(args, "profile_dir", "") or None,
         )
     except ValueError as exc:
         build_parser().error(f"--serve: {exc}")
@@ -1147,7 +1187,11 @@ def _run_stream(args, telemetry=None) -> int:
                       file=sys.stderr)
                 continue
             if session is None:
-                session = OnlineSession(meta, cfg, registry=registry)
+                session = OnlineSession(
+                    meta, cfg, registry=registry,
+                    stream_id=os.path.basename(d) or "stream",
+                    profile=(True if getattr(args, "profile_dir", "")
+                             else None))
             n = session.ingest(data, weights, label=name)
             progressed = True
             if not args.quiet:

@@ -102,6 +102,15 @@ class CleanConfig:
     # sees the EW template.  None defers to ICLEAN_STREAM_EW_ALPHA,
     # then 0.2.
     stream_ew_alpha: Optional[float] = None
+    # quality observability (telemetry/quality.py): trailing-window
+    # length (subints) and the absolute zap-fraction departure from the
+    # window median that raises quality_drift_alerts{stream=} on a live
+    # stream.  None defers to ICLEAN_QUALITY_WINDOW / ICLEAN_QUALITY_DRIFT,
+    # then 16 / 0.15.  Pure observers over host-side mask copies — they
+    # can never change a mask, so both are excluded from the
+    # checkpoint/journal config identity.
+    quality_window: Optional[int] = None
+    quality_drift: Optional[float] = None
     # fleet scheduler (parallel/fleet.py) pad-to-bucket geometry
     # quantization: (nsub_step, nchan_step) grid the planner rounds raw
     # shapes up to, merging near-miss geometries into one compiled bucket.
@@ -236,6 +245,13 @@ class CleanConfig:
             raise ValueError(
                 f"stream_ew_alpha must be in (0, 1], got "
                 f"{self.stream_ew_alpha}")
+        if self.quality_window is not None and self.quality_window < 2:
+            raise ValueError(
+                f"quality_window must be >= 2 (a drift baseline needs at "
+                f"least two subints), got {self.quality_window}")
+        if self.quality_drift is not None and self.quality_drift <= 0:
+            raise ValueError(
+                f"quality_drift must be > 0, got {self.quality_drift}")
         if (len(tuple(self.fleet_bucket_pad)) != 2
                 or any(int(v) < 0 for v in self.fleet_bucket_pad)):
             raise ValueError(
@@ -331,6 +347,10 @@ class ServeConfig:
     # from journaled 'cache' lines with zero device work (entries are
     # signature-verified before reuse; failures fall through to a clean)
     result_cache: bool = False
+    # jax.profiler capture directory for POST /profile and the online
+    # sessions' AOT cost capture (``--profile-dir`` /
+    # ``ICLEAN_PROFILE_DIR``); None disables on-demand trace capture
+    profile_dir: Optional[str] = None
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -351,6 +371,7 @@ class ServeConfig:
             "join": env("ICLEAN_JOIN", flag, False),
             "member_ttl_s": env("ICLEAN_MEMBER_TTL", float, 15.0),
             "result_cache": env("ICLEAN_RESULT_CACHE", flag, False),
+            "profile_dir": env("ICLEAN_PROFILE_DIR", str, None),
         }
         # "" is a meaningful override here (recorder OFF), so resolve it
         # outside the none-filtered update below

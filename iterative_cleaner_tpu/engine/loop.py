@@ -63,6 +63,32 @@ from iterative_cleaner_tpu.stats.masked_jax import (
 ITER_METRICS_WIDTH = 4  # zap_count, mask_churn, residual_std, template_peak
 
 
+def iter_quality_series(iter_metrics, n_cells: int) -> dict:
+    """The quality-observability view of one run's ``iter_metrics``
+    carry: named host-side series normalised to the archive's REAL cell
+    count (batched runs pad geometry, so the caller passes the cropped
+    ``n_cells`` — raw zap counts would include pad zeros).
+
+    Returns ``{"zap_frac": [...], "mask_churn": [...],
+    "residual_std": [...], "template_peak": [...]}``, one entry per
+    executed iteration.  Consumed by
+    :func:`iterative_cleaner_tpu.telemetry.quality.observe_result`; kept
+    here, next to the carry that produces the columns, so the column
+    order has exactly one authority."""
+    im = np.asarray(iter_metrics, dtype=np.float64)
+    if im.ndim != 2 or im.shape[1] != ITER_METRICS_WIDTH:
+        raise ValueError(
+            f"iter_metrics must be (loops, {ITER_METRICS_WIDTH}), got "
+            f"{im.shape}")
+    cells = float(max(int(n_cells), 1))
+    return {
+        "zap_frac": [float(v) / cells for v in im[:, 0]],
+        "mask_churn": [float(v) for v in im[:, 1]],
+        "residual_std": [float(v) for v in im[:, 2]],
+        "template_peak": [float(v) for v in im[:, 3]],
+    }
+
+
 def _pulse_window(nbin, pulse_slice, pulse_scale, pulse_active, dtype):
     """(nbin,) multiplier the reference applies to the residual's on-pulse
     bins (reference :280-283): 1 everywhere, ``pulse_scale`` on

@@ -36,3 +36,26 @@ def atomic_output(path: str) -> Iterator[str]:
     finally:
         if os.path.exists(tmp):  # failed mid-write: don't litter the dir
             os.unlink(tmp)
+
+
+@contextlib.contextmanager
+def atomic_output_dir(path: str) -> Iterator[str]:
+    """Directory flavour of :func:`atomic_output`: yield a private temp
+    directory next to ``path``; on clean exit, rename it over ``path``
+    in one ``os.replace``; on error, remove the whole tree.
+
+    For multi-file outputs published as a unit (e.g. a profiler capture:
+    trace files plus manifest) — a watcher of the parent directory sees
+    the finished tree appear atomically or not at all.  ``path`` must
+    not already exist (directory renames cannot clobber non-empty
+    targets), which writers guarantee by minting fresh names."""
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    os.makedirs(tmp)
+    try:
+        yield tmp
+        os.replace(tmp, path)
+    finally:
+        if os.path.isdir(tmp):  # failed mid-write: don't litter the dir
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)

@@ -122,7 +122,9 @@ class OnlineSession:
     def __init__(self, meta: StreamMeta, config: CleanConfig, *,
                  reconcile_every: Optional[int] = None, registry=None,
                  tracer=None, trace_id: Optional[str] = None,
-                 parent_span_id: Optional[str] = None):
+                 parent_span_id: Optional[str] = None,
+                 stream_id: Optional[str] = None,
+                 profile: Optional[bool] = None):
         self.meta = meta
         self.config = config
         self.alpha = resolve_ew_alpha(config.stream_ew_alpha)
@@ -136,6 +138,27 @@ class OnlineSession:
         self.tracer = tracer
         self.trace_id = trace_id
         self.parent_span_id = parent_span_id
+        # quality observability rides the registry: the monitor reads
+        # host-side numpy copies only — it can never change a mask
+        # (tests/test_quality_monitor.py asserts bit-equality on/off)
+        self.quality = None
+        if registry is not None:
+            from iterative_cleaner_tpu.telemetry.quality import (
+                QualityMonitor,
+            )
+
+            self.quality = QualityMonitor(
+                stream=stream_id or "local",
+                window=config.quality_window,
+                drift=config.quality_drift, registry=registry)
+        # opt-in roofline capture of the fixed-shape step: costs one
+        # extra AOT compile at warm-up, so it is off unless explicitly
+        # requested or ICLEAN_PROFILE_DIR is set
+        from iterative_cleaner_tpu.telemetry.profiling import (
+            profiling_enabled,
+        )
+
+        self._profile = profiling_enabled(profile)
         self.closed = False
         # host capacity ring: raw tiles + as-ingested weights (what the
         # reconciles clean) and the provisional EW-zapped view
@@ -291,7 +314,32 @@ class OnlineSession:
 
         self._dtype = dtype
         self._template = jnp.zeros((meta.nbin,), dtype)
-        return jax.jit(step)
+        step_fn = jax.jit(step)
+        if self._profile:
+            # AOT-compile the same program once for its cost_analysis /
+            # memory_analysis (jit(...).lower().compile() does not
+            # populate the wrapper's per-shape cache — see batch.py's
+            # _AOT_MEMO note — so the warm-up/recompile accounting around
+            # the first real call is untouched)
+            from iterative_cleaner_tpu.telemetry import profiling
+
+            avals = (
+                jax.ShapeDtypeStruct((1, meta.nchan, meta.nbin), dtype),
+                jax.ShapeDtypeStruct((1, meta.nchan), dtype),
+                jax.ShapeDtypeStruct((meta.nbin,), dtype),
+                jax.ShapeDtypeStruct((), jnp.int32),
+            )
+            t0 = time.perf_counter()
+            try:
+                compiled = step_fn.lower(*avals).compile()
+            except Exception:  # icln: ignore[broad-except] -- profiling is advisory: an AOT refusal must never take down a live stream
+                profiling.capture_compiled("online_step", None,
+                                           registry=self.registry)
+            else:
+                profiling.capture_compiled(
+                    "online_step", compiled, registry=self.registry,
+                    compile_s=time.perf_counter() - t0)
+        return step_fn
 
     def ingest(self, data, weights=None, *, label: str = "") -> int:
         """Feed one chunk: ``(nchan, nbin)`` or ``(k, nchan, nbin)`` total
@@ -357,6 +405,17 @@ class OnlineSession:
             self.registry.gauge_set("online_nsub", self._n)
             self.registry.histogram_observe("online_subint_s", dt,
                                             buckets=SECONDS)
+        if self._n > 1:
+            # warm walltimes only: the first subint's dt is dominated by
+            # the warm-up compile and would poison the roofline pairing
+            from iterative_cleaner_tpu.telemetry import profiling
+
+            profiling.record_walltime("online_step", dt,
+                                      registry=self.registry)
+        if self.quality is not None:
+            self.quality.observe_subint(
+                self._pweights[self._n - 1],
+                template=np.asarray(self._template))
         if span is not None:
             span.set("nsub", self._n)
             span.set("zapped", int(np.sum(self._pweights[self._n - 1] == 0)))
@@ -394,6 +453,8 @@ class OnlineSession:
             self.registry.counter_inc("online_reconciles")
             if drift:
                 self.registry.counter_inc("online_mask_drift", drift)
+        if self.quality is not None:
+            self.quality.observe_reconcile(drift)
         if span is not None:
             span.set("drift", drift)
             span.end()
@@ -425,6 +486,9 @@ class OnlineSession:
         self._pweights[:self._n] = final_w
         self._pscores[:self._n] = np.asarray(result.scores, np.float64)
         cleaned = dataclasses.replace(ar, weights=final_w)
+        if self.quality is not None:
+            self.quality.observe_reconcile(final_drift)
+            self.quality.observe_close(final_w)
         if span is not None:
             span.set("final_drift", final_drift)
             span.end()

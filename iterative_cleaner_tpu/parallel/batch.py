@@ -276,10 +276,22 @@ def clear_precompile_memo() -> None:
         _AOT_MEMO.clear()
 
 
+def _program_label(build_args, override=None) -> str:
+    """The roofline profiler's program name for a batched executable:
+    an explicit caller label (the fleet's ``fleet_bucket``), else the
+    resolved kernel route — ``fused_sweep`` when the one-launch sweep is
+    on, ``batch`` otherwise.  build_args[-1] is the resolved fused_sweep
+    (see :func:`resolve_batch_build_args`)."""
+    if override:
+        return str(override)
+    return "fused_sweep" if build_args[-1] == "on" else "batch"
+
+
 def precompile_batched_executable(config: CleanConfig, nsub: int, nchan: int,
                                   nbin: int, dedispersed: bool,
                                   batch_dim: int, mesh=None, specs=None,
-                                  registry=None, stats_out=None):
+                                  registry=None, stats_out=None,
+                                  program=None):
     """AOT-compile the batched cleaner for one bucket geometry and return
     the callable ``Compiled`` executable.
 
@@ -326,12 +338,12 @@ def precompile_batched_executable(config: CleanConfig, nsub: int, nchan: int,
                                   mesh=mesh, specs=specs)
     t0 = time.perf_counter()
     compiled = fn.lower(*avals).compile()
+    compile_s = time.perf_counter() - t0
     if registry is not None:
         from iterative_cleaner_tpu.telemetry.registry import SECONDS
 
         registry.counter_inc("batch_compiles")
-        registry.histogram_observe("batch_precompile_s",
-                                   time.perf_counter() - t0,
+        registry.histogram_observe("batch_precompile_s", compile_s,
                                    buckets=SECONDS)
         try:
             ma = compiled.memory_analysis()
@@ -346,6 +358,14 @@ def precompile_batched_executable(config: CleanConfig, nsub: int, nchan: int,
             # its absence should be visible: the bench's HBM columns read
             # 0 and this counter says why
             registry.counter_inc("batch_memory_analysis_errors")
+    # every AOT-compiled hot program registers with the roofline
+    # profiler; the execute path's measured warm walltimes pair with
+    # these static costs to publish prof_roofline_frac{program=} etc.
+    from iterative_cleaner_tpu.telemetry import profiling
+
+    profiling.capture_compiled(_program_label(build_args, program),
+                               compiled, registry=registry,
+                               compile_s=compile_s)
     if stats_out is not None:
         stats_out["fresh"] = True
     with _AOT_MEMO_LOCK:
@@ -450,8 +470,8 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
                            pad_to: Optional[int] = None,
                            raw_shapes: Optional[Sequence[Tuple[int, int]]]
                            = None, executable=None,
-                           stats_out: Optional[dict] = None
-                           ) -> List[CleanResult]:
+                           stats_out: Optional[dict] = None,
+                           program=None) -> List[CleanResult]:
     """Clean a batch of equal-shaped archives in one compiled call.
 
     With ``mesh`` (a 1-D ('batch',) mesh from
@@ -520,6 +540,7 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
         registry.counter_inc("batch_archives", n)
 
     fn = None
+    build_args = None
     if executable is None:
         build_args, use_shardmap = resolve_batch_build_args(
             config, archives[0].nbin, bool(archives[0].dedispersed),
@@ -538,6 +559,21 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
     want_compiles = registry is not None or stats_out is not None
     exec_before = _jit_cache_size(fn) \
         if (fn is not None and want_compiles) else None
+    # roofline pairing: when this program's static cost was captured at
+    # its AOT compile, time the warm call (one explicit sync — the
+    # results are consumed host-side immediately after anyway)
+    prog = None
+    if registry is not None:
+        from iterative_cleaner_tpu.telemetry import profiling
+
+        if build_args is None:
+            build_args = resolve_batch_build_args(
+                config, archives[0].nbin, bool(archives[0].dedispersed),
+                mesh=mesh, has_specs=specs is not None)[0]
+        prog = _program_label(build_args, program)
+        if not profiling.has_cost(prog):
+            prog = None
+    t_exec = 0.0
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -552,6 +588,7 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
             jax.device_put(x, NamedSharding(mesh, spec))
             for x, spec in zip(args, specs)
         )
+        t_exec = time.perf_counter()
         with mesh:
             outs = (executable if executable is not None else fn)(*args)
         # meshes spanning processes: gather outputs before host reads
@@ -559,7 +596,14 @@ def clean_archives_batched(archives: Sequence[Archive], config: CleanConfig,
 
         outs = host_fetch(outs)
     else:
+        t_exec = time.perf_counter()
         outs = (executable if executable is not None else fn)(*args)
+    if prog is not None:
+        from iterative_cleaner_tpu.telemetry import profiling
+
+        jax.block_until_ready(outs)
+        profiling.record_walltime(prog, time.perf_counter() - t_exec,
+                                  registry=registry)
 
     compiled_n = 0
     if exec_before is not None:

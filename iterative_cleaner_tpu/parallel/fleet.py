@@ -309,7 +309,8 @@ class BucketPrecompiler:
         stats: Dict[str, bool] = {}
         exe = precompile_batched_executable(
             self._config, nsub, nchan, nbin, ded, bucket.batch_dim,
-            mesh=self._mesh, registry=self._registry, stats_out=stats)
+            mesh=self._mesh, registry=self._registry, stats_out=stats,
+            program="fleet_bucket")
         if self._registry is not None and stats.get("fresh"):
             self._registry.counter_inc("fleet_compiles")
         return exe
@@ -1040,7 +1041,9 @@ def _serve_groups(groups, config, mesh, reg, report, fail, precompiler,
                         [padded[i] for i in idx], config, mesh,
                         registry=reg, pad_to=pad_to,
                         raw_shapes=[raw_shapes[i] for i in idx],
-                        executable=exe, stats_out=stats)
+                        executable=exe, stats_out=stats,
+                        program="fleet_bucket" if exe is not None
+                        else None)
 
                 try:
                     return call_with_deadline(run, res.stage_timeout_s,

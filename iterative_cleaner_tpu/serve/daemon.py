@@ -215,6 +215,9 @@ class ServeDaemon:
         # open online streams by request id (kind: "stream"); entries
         # leave at finalize (worker pop after close) or terminal failure
         self._streams: Dict[str, _StreamState] = {}
+        # POST /profile serialization: jax.profiler supports one trace at
+        # a time, so a second capture while one runs is a 409, not a queue
+        self._profile_lock = threading.Lock()
 
     # ------------------------------------------------------------- intake
     def admit(self, req: ServeRequest, source: str) -> None:
@@ -652,7 +655,64 @@ class ServeDaemon:
             "recent_spans": self.tracer.recent(50),
             "flight_recorder": getattr(self.recorder, "path", None),
             "trace_out": self.trace_out,
+            "program_costs": self._program_costs(),
         }
+
+    @staticmethod
+    def _program_costs() -> dict:
+        from iterative_cleaner_tpu.telemetry import profiling
+
+        return profiling.costs_snapshot()  # already plain dicts
+
+    def profile_capture(self, seconds: float) -> dict:
+        """POST /profile: capture ``seconds`` of ``jax.profiler`` trace
+        into the configured ``profile_dir`` and publish it atomically.
+        Runs on the handler's own thread (ThreadingHTTPServer), so other
+        scrapes keep flowing while the capture sleeps; a concurrent
+        second capture is refused (jax.profiler allows one trace at a
+        time), not queued."""
+        from iterative_cleaner_tpu.telemetry import profiling
+
+        if not self.serve_config.profile_dir:
+            raise RequestError(
+                "profiling is disabled: start the daemon with "
+                "--profile-dir/ICLEAN_PROFILE_DIR to enable POST /profile")
+        if not 0 < seconds <= 60:
+            raise RequestError(
+                f"seconds must be in (0, 60], got {seconds}")
+        if not self._profile_lock.acquire(blocking=False):
+            raise Rejection("profile_busy",
+                            "a profile capture is already in progress")
+        try:
+            out_dir = profiling.capture_for(
+                self.serve_config.profile_dir, seconds,
+                registry=self.registry, label="on-demand")
+        finally:
+            self._profile_lock.release()
+        self.registry.counter_inc("serve_profile_captures")
+        return {"profile_dir": out_dir, "seconds": seconds}
+
+    def quality_view(self) -> dict:
+        """GET /quality: per-stream quality summaries (zap fraction,
+        drift baseline, alerts) for every open online session, plus the
+        registry's quality_* series.  Stream list is copied under the
+        state lock; each session's summary is read without holding any
+        daemon lock (QualityMonitor methods only touch its own state)."""
+        with self._state_lock:
+            streams = list(self._streams.items())
+        per_stream = {}
+        for rid, st in streams:
+            sess = st.session
+            mon = getattr(sess, "quality", None) if sess else None
+            if mon is not None:
+                per_stream[rid] = mon.summary()
+        snap = self.registry.snapshot()
+        series = {}
+        for group in ("counters", "gauges"):
+            for k, v in snap.get(group, {}).items():
+                if k.startswith("quality_"):
+                    series[k] = v
+        return {"streams": per_stream, "series": series}
 
     def _say(self, msg: str) -> None:
         if not self.quiet:
@@ -873,7 +933,9 @@ class ServeDaemon:
             st.session = OnlineSession(
                 meta, cfg, registry=self.registry, tracer=self.tracer,
                 trace_id=st.req.trace_id,
-                parent_span_id=st.req.root_span_id)
+                parent_span_id=st.req.root_span_id,
+                stream_id=st.req.request_id,
+                profile=(True if self.serve_config.profile_dir else None))
         return st.session.ingest(
             data, weights, label=os.path.basename(chunk_path))
 

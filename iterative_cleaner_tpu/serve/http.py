@@ -24,7 +24,16 @@ proxy owns TLS/authn, exactly like node_exporter's model).  Endpoints::
                         (the daemon's bounded in-memory span store; no
                         --trace-out required)
     GET  /debug/vars    200 one-scrape debugging state: health, config,
-                        counters, the most recent spans
+                        counters, program costs, the most recent spans
+    GET  /quality       200 {"streams": {id: quality summary}, "series":
+                        {...}} — per-stream zap/drift state for every
+                        open online session plus the registry's
+                        quality_* series
+    POST /profile?seconds=N  capture N seconds (default 1, max 60) of
+                        jax.profiler trace into the daemon's
+                        --profile-dir; 200 {"profile_dir": ...}, 400
+                        without --profile-dir or bad N, 409 while a
+                        capture is already running
 
 The server runs on daemon threads (`ThreadingHTTPServer`): submissions
 land in the scheduler under its own lock, so the single worker loop never
@@ -49,6 +58,9 @@ _REJECTION_STATUS = {
     "tenant_limit": 429,
     "duplicate": 409,
     "draining": 503,
+    # one jax.profiler trace at a time: a concurrent capture conflicts
+    # rather than queueing (the client retries after the first finishes)
+    "profile_busy": 409,
 }
 
 
@@ -112,6 +124,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, view)
         elif path == "/debug/vars":
             self._send_json(200, daemon.debug_vars())
+        elif path == "/quality":
+            self._send_json(200, daemon.quality_view())
         else:
             self._send_json(404, {"error": f"no route {path!r}"})
 
@@ -120,6 +134,9 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0].rstrip("/")
         if path.startswith("/stream/"):
             self._post_stream(daemon, path)
+            return
+        if path == "/profile":
+            self._post_profile(daemon)
             return
         if path != "/submit":
             self._send_json(404, {"error": f"no route {path!r}"})
@@ -160,6 +177,31 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200, {"accepted": True, "id": req.request_id,
                               "tenant": req.tenant})
+
+    def _post_profile(self, daemon) -> None:
+        """POST /profile?seconds=N — on-demand jax.profiler capture.
+        The duration rides the query string (the dispatch above discards
+        it from ``path``, so it is re-parsed here); the capture blocks
+        THIS handler thread only — ThreadingHTTPServer keeps /metrics
+        and the stream endpoints live for the duration."""
+        from urllib.parse import parse_qs, urlsplit
+
+        query = parse_qs(urlsplit(self.path).query)
+        raw = query.get("seconds", ["1"])[-1]
+        try:
+            seconds = float(raw)
+        except ValueError:
+            self._send_json(400, {"error": f"seconds must be a number, "
+                                           f"got {raw!r}"})
+            return
+        try:
+            self._send_json(200, daemon.profile_capture(seconds))
+        except RequestError as exc:
+            self._send_json(400, {"error": str(exc)})
+        except Rejection as exc:
+            status = _REJECTION_STATUS.get(exc.reason, 429)
+            self._send_json(status, {"rejected": True, "reason": exc.reason,
+                                     "error": exc.detail})
 
     def _post_stream(self, daemon, path: str) -> None:
         """POST /stream/<id>/subint and /stream/<id>/close — the online

@@ -32,6 +32,18 @@ boundary:
   ``jax.profiler.TraceAnnotation`` spans on the host phases, so
   ``--trace`` captures read as template/diagnostics/scalers/zap in
   Perfetto instead of a wall of fused HLO names.
+- :mod:`iterative_cleaner_tpu.telemetry.profiling` — compile-time
+  ``cost_analysis``/``memory_analysis`` capture per hot program paired
+  with measured warm walltimes into roofline gauges
+  (``prof_roofline_frac{program=}``, ``prof_hbm_gbps{program=}``), plus
+  on-demand ``jax.profiler`` trace capture (``--profile-dir`` /
+  ``POST /profile``).
+- :mod:`iterative_cleaner_tpu.telemetry.benchtrack` — committed
+  ``BENCH_r*.json`` series regression gate (``icln-bench --check``),
+  exported as ``bench_regressions{key=}``.
+- :mod:`iterative_cleaner_tpu.telemetry.quality` — zap-occupancy
+  histograms, mask-churn/EW-drift series and the trailing-window drift
+  detector behind ``quality_drift_alerts{stream=}``.
 
 Everything here is jax-free (importable by the numpy-oracle path); the
 device-side recording lives in the engine.
@@ -57,6 +69,19 @@ from iterative_cleaner_tpu.telemetry.exporters import (  # noqa: E402,F401
     parse_prometheus_text,
     write_metrics_json,
     write_prometheus_textfile,
+)
+from iterative_cleaner_tpu.telemetry.profiling import (  # noqa: E402,F401
+    ProgramCost,
+    capture_compiled,
+    costs_snapshot,
+    profiling_enabled,
+    record_walltime,
+    trace_capture,
+)
+from iterative_cleaner_tpu.telemetry.quality import (  # noqa: E402,F401
+    QualityMonitor,
+    observe_mask,
+    observe_result,
 )
 from iterative_cleaner_tpu.telemetry.recorder import (  # noqa: E402,F401
     FlightRecorder,

@@ -1,0 +1,273 @@
+"""Bench-history regression tracking: the ``icln-bench --check`` gate.
+
+Every growth round commits one ``BENCH_r*.json`` (bench.py's one-line
+JSON under ``parsed``).  Until now the trajectory was eyeballed; this
+module loads the committed series, applies per-key tolerance bands, and
+emits a pass/fail verdict so CI catches ``streaming_vs_whole`` or
+``fused_vs_unfused`` drifting between rounds mechanically.
+
+Rules of the gate:
+
+* Only keys in :data:`TRACKED` are gated — bench output grows new keys
+  every round, and an unknown numeric key must never fail CI.
+* Rounds are only comparable on the same platform: each tracked key
+  names the platform field that qualifies it (a TPU capture never gates
+  against CPU fallback numbers and vice versa).
+* The baseline is the **median** of the prior same-platform rounds, not
+  the best — single-round noise (committed CPU numbers wobble ±15%)
+  must not ratchet the bar.
+* A key seen in fewer than two comparable rounds is informational
+  (``"new"``), never a failure.
+
+Verdicts export through the ordinary registry as
+``bench_regressions{key=}`` (1 fail / 0 pass), so a serve daemon or CI
+scrape sees the same answer the CLI prints.  The console script::
+
+    icln-bench --check [--history DIR] [--json]
+
+exits 0 when every tracked key holds its band, 1 on any regression,
+2 on usage errors / unreadable history.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_HISTORY_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Track:
+    """Tolerance band for one bench key.
+
+    ``direction`` — ``"higher"`` (a speedup/throughput: regression means
+    the latest fell below ``baseline * (1 - tol)``) or ``"lower"`` (a
+    latency: regression means above ``baseline * (1 + tol)``).
+    ``platform_key`` — the parsed field naming the platform that
+    qualifies this number for cross-round comparison (falls back to
+    ``"platform"`` when the row predates per-stage platform fields).
+    """
+
+    direction: str
+    tol: float
+    platform_key: str = "platform"
+
+
+# The gated keys.  Tolerances are deliberately loose (25-35%): committed
+# rounds mix machines and CPU fallback numbers wobble; the gate exists to
+# catch step-function regressions (a kernel route silently disabled, a
+# ratio collapsing), not single-digit noise.
+TRACKED: Dict[str, Track] = {
+    "value": Track("higher", 0.35),
+    "vs_baseline": Track("higher", 0.35),
+    "ms_per_iter": Track("lower", 0.35),
+    "streaming_vs_whole": Track("higher", 0.30, "streaming_platform"),
+    "streaming_tile_passes_per_s": Track("higher", 0.35,
+                                         "streaming_platform"),
+    "fused_vs_unfused": Track("higher", 0.30, "fused_platform"),
+    "online_subint_p99_ms": Track("lower", 0.50, "online_platform"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyVerdict:
+    key: str
+    status: str                    # "pass" | "fail" | "new" | "absent"
+    latest: Optional[float] = None
+    baseline: Optional[float] = None
+    bound: Optional[float] = None
+    rounds: int = 0
+    platform: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckResult:
+    ok: bool
+    verdicts: Tuple[KeyVerdict, ...]
+    rounds: Tuple[int, ...]
+
+    def failures(self) -> List[KeyVerdict]:
+        return [v for v in self.verdicts if v.status == "fail"]
+
+
+def default_history_dir() -> str:
+    """The repo root (two levels above this package) — where the
+    ``BENCH_r*.json`` series is committed."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_history(history_dir: Optional[str] = None
+                 ) -> List[Tuple[int, dict]]:
+    """The committed bench series as ``[(round, parsed), ...]`` sorted by
+    round.  Rounds whose bench run failed (``rc != 0``) or carry no
+    parsed payload are skipped — a failed round must not poison the
+    baseline.  Raises FileNotFoundError when the directory has no
+    history at all."""
+    d = history_dir or default_history_dir()
+    rows = []
+    for path in sorted(glob.glob(os.path.join(d, "BENCH_r*.json"))):
+        m = _HISTORY_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as exc:
+            raise ValueError(f"unreadable bench history {path}: {exc}")
+        parsed = doc.get("parsed")
+        if doc.get("rc", 1) != 0 or not isinstance(parsed, dict):
+            continue
+        rows.append((int(m.group(1)), parsed))
+    if not rows:
+        raise FileNotFoundError(
+            f"no readable BENCH_r*.json history under {d!r}")
+    rows.sort()
+    return rows
+
+
+def _median(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _series(history: Sequence[Tuple[int, dict]], key: str,
+            platform_key: str) -> List[Tuple[int, float, str]]:
+    """(round, value, platform) rows where ``key`` is a finite number."""
+    out = []
+    for n, parsed in history:
+        v = parsed.get(key)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        plat = parsed.get(platform_key) or parsed.get("platform") or ""
+        out.append((n, float(v), str(plat)))
+    return out
+
+
+def check_history(history: Sequence[Tuple[int, dict]],
+                  tracked: Optional[Dict[str, Track]] = None
+                  ) -> CheckResult:
+    """Apply the tolerance bands to a loaded history."""
+    tracked = TRACKED if tracked is None else tracked
+    verdicts = []
+    for key in sorted(tracked):
+        t = tracked[key]
+        series = _series(history, key, t.platform_key)
+        if not series:
+            verdicts.append(KeyVerdict(key=key, status="absent"))
+            continue
+        last_round, latest, platform = series[-1]
+        prior = [v for (n, v, p) in series[:-1] if p == platform]
+        if not prior:
+            verdicts.append(KeyVerdict(
+                key=key, status="new", latest=latest, rounds=len(series),
+                platform=platform))
+            continue
+        baseline = _median(prior)
+        if t.direction == "higher":
+            bound = baseline * (1.0 - t.tol)
+            ok = latest >= bound
+        else:
+            bound = baseline * (1.0 + t.tol)
+            ok = latest <= bound
+        verdicts.append(KeyVerdict(
+            key=key, status="pass" if ok else "fail", latest=latest,
+            baseline=baseline, bound=bound, rounds=len(prior) + 1,
+            platform=platform))
+    return CheckResult(
+        ok=not any(v.status == "fail" for v in verdicts),
+        verdicts=tuple(verdicts),
+        rounds=tuple(n for n, _ in history))
+
+
+def export_verdicts(result: CheckResult, registry) -> None:
+    """Publish ``bench_regressions{key=}`` (1 fail / 0 pass) for every
+    tracked key that produced a comparable verdict, plus the summary
+    gauge ``bench_regressions_total``."""
+    from iterative_cleaner_tpu.telemetry.registry import labeled
+
+    fails = 0
+    for v in result.verdicts:
+        if v.status in ("pass", "fail"):
+            registry.gauge_set(labeled("bench_regressions", key=v.key),
+                               0.0 if v.status == "pass" else 1.0)
+            fails += v.status == "fail"
+    registry.gauge_set("bench_regressions_total", float(fails))
+    registry.gauge_set("bench_rounds_checked", float(len(result.rounds)))
+
+
+def _render_text(result: CheckResult) -> str:
+    lines = ["bench-check: rounds %s" %
+             ",".join("r%02d" % n for n in result.rounds)]
+    for v in result.verdicts:
+        if v.status == "absent":
+            lines.append("  %-28s absent" % v.key)
+        elif v.status == "new":
+            lines.append("  %-28s new     latest=%.4g (%s; no prior "
+                         "comparable round)"
+                         % (v.key, v.latest, v.platform or "?"))
+        else:
+            lines.append(
+                "  %-28s %-7s latest=%.4g baseline=%.4g bound=%.4g "
+                "(%d rounds, %s)"
+                % (v.key, v.status.upper() if v.status == "fail"
+                   else v.status, v.latest, v.baseline, v.bound,
+                   v.rounds, v.platform or "?"))
+    n_fail = len(result.failures())
+    lines.append("bench-check: %s (%d regression%s)"
+                 % ("PASS" if result.ok else "FAIL", n_fail,
+                    "" if n_fail == 1 else "s"))
+    return "\n".join(lines)
+
+
+def _render_json(result: CheckResult) -> str:
+    return json.dumps({
+        "ok": result.ok,
+        "rounds": list(result.rounds),
+        "verdicts": [dataclasses.asdict(v) for v in result.verdicts],
+    }, sort_keys=True, indent=2)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="icln-bench",
+        description="Bench-history regression gate over the committed "
+                    "BENCH_r*.json series.")
+    p.add_argument("--check", action="store_true",
+                   help="apply the tolerance bands and exit 0 (pass) / "
+                        "1 (regression)")
+    p.add_argument("--history", metavar="DIR", default=None,
+                   help="directory holding BENCH_r*.json "
+                        "(default: the repo root)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable verdict instead of text")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    if not args.check:
+        print("icln-bench: nothing to do (did you mean --check?)",
+              file=sys.stderr)
+        return 2
+    try:
+        history = load_history(args.history)
+    except (FileNotFoundError, ValueError) as exc:
+        print(f"icln-bench: {exc}", file=sys.stderr)
+        return 2
+    result = check_history(history)
+    print(_render_json(result) if args.as_json else _render_text(result))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,16 @@ import re
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
+def _escape_label_value(v) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote and newline become ``\\\\``, ``\\"`` and
+    ``\\n``.  Backslash first — escaping it last would re-escape the
+    escapes just introduced for the other two."""
+    return (str(v).replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_name(name: str, prefix: str) -> str:
     """Sanitise to the Prometheus metric-name charset."""
     n = _NAME_RE.sub("_", name)
@@ -36,7 +46,7 @@ def _prom_parts(name: str, prefix: str, suffix: str = ""):
     if suffix and not m.endswith(suffix):
         m += suffix
     body = ",".join('%s="%s"' % (_NAME_RE.sub("_", k),
-                                 str(v).replace('"', "'"))
+                                 _escape_label_value(v))
                     for k, v in sorted(labels.items()))
     return m, body
 
@@ -102,7 +112,8 @@ def metrics_to_prometheus(snapshot: dict, prefix: str = "icln") -> str:
         lines.append(f"# TYPE {m} counter")
         for name in sorted(phases):
             lines.append('%s{phase="%s"} %s'
-                         % (m, name, _prom_num(phases[name])))
+                         % (m, _escape_label_value(name),
+                            _prom_num(phases[name])))
 
     for name in sorted(snapshot.get("histograms", {})):
         h = snapshot["histograms"][name]

@@ -1,0 +1,328 @@
+"""Compile-time cost capture and roofline attribution for hot programs.
+
+The bench's last real-TPU capture put ``hbm_util`` at 0.28 with no
+per-stage attribution of the other 72% — this module closes that gap
+from INSIDE a running process.  Every hot program (the batch builder,
+the fused sweep route, the online per-subint step, the fleet's bucket
+executables) registers its XLA ``cost_analysis()`` FLOPs/bytes and
+``memory_analysis()`` peaks at compile time (:func:`capture_compiled`);
+measured warm walltimes then pair with those static costs
+(:func:`record_walltime`) to publish achieved-throughput and
+roofline-fraction gauges through the ordinary metrics registry:
+
+    prof_flops{program=}          static FLOPs per program invocation
+    prof_bytes{program=}          static HBM bytes accessed per invocation
+    prof_peak_bytes{program=}     executable peak live bytes (donation-aware)
+    prof_step_s{program=}         last measured warm walltime
+    prof_flops_util{program=}     achieved FLOP/s over the device peak
+    prof_hbm_gbps{program=}       achieved HBM GB/s
+    prof_hbm_util{program=}       achieved bandwidth over the device peak
+    prof_roofline_frac{program=}  achieved FLOP/s over the roofline bound
+                                  min(peak_flops, intensity * peak_bw)
+
+The registry keys use the PR 9 label-suffix convention, so ``/metrics``
+renders them as real Prometheus labels.  Cost capture is advisory by
+design: a runtime without cost/memory analysis increments
+``prof_capture_errors`` and every downstream gauge simply stays absent —
+cleaning results never depend on any of this.
+
+On-demand ``jax.profiler`` trace capture rides the same module:
+:func:`trace_capture` wraps a region (the CLI's ``--profile-dir`` /
+``ICLEAN_PROFILE_DIR``), :func:`capture_for` blocks for N seconds (the
+serve daemon's ``POST /profile?seconds=N``).  Captures write into a
+private temp directory that is renamed into place only after
+``stop_trace`` and the manifest land — a scraper of the profile
+directory never sees a torn capture.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, Optional, Tuple
+
+# Peak dense FLOP/s (bf16) and HBM bandwidth (bytes/s) by device_kind
+# substring — public chip specs.  bench.py's hbm_util column reads its
+# denominator from here too (single-sourced).
+DEVICE_PEAKS = {
+    "v5 lite": (197e12, 819e9),   # TPU v5e
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "v6 lite": (918e12, 1640e9),  # Trillium
+}
+
+# Off-accelerator fallback so the fraction gauges stay well-defined in
+# CPU CI runs: a nominal host (order-of-magnitude, clearly not a real
+# roofline — the ``prof_peak_nominal`` gauge says so on /metrics).
+NOMINAL_PEAKS = (5e10, 2e10)
+
+
+def device_kind() -> str:
+    """The backing device's ``device_kind`` string, or ``"cpu"`` when jax
+    is unavailable/uninitialised (the numpy-oracle path stays jax-free)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "cpu"
+    try:
+        return str(jax.devices()[0].device_kind)
+    except Exception:  # icln: ignore[broad-except] -- device enumeration can fail on unconfigured runtimes; profiling must degrade, not raise
+        return "cpu"
+
+
+def device_peaks(kind: Optional[str] = None) -> Tuple[float, float, bool]:
+    """``(peak_flops, peak_hbm_bytes_per_s, nominal)`` for ``kind``
+    (default: the current device).  ``nominal`` flags the CPU/unknown
+    fallback numbers."""
+    k = (device_kind() if kind is None else kind).lower()
+    for key, (fl, bw) in DEVICE_PEAKS.items():
+        if key in k:
+            return fl, bw, False
+    return NOMINAL_PEAKS[0], NOMINAL_PEAKS[1], True
+
+
+def hbm_peak(kind: str) -> Optional[float]:
+    """Peak HBM bandwidth for a device kind, or None when unknown —
+    bench.py's ``hbm_util`` denominator (kept None-on-unknown so the
+    bench's off-TPU rows honestly report no utilisation figure)."""
+    for key, (_, bw) in DEVICE_PEAKS.items():
+        if key in kind.lower():
+            return bw
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramCost:
+    """One hot program's static compile-time cost analysis."""
+
+    program: str
+    flops: float           # cost_analysis FLOPs per invocation
+    bytes_accessed: float  # cost_analysis HBM bytes per invocation
+    peak_bytes: int        # memory_analysis peak live bytes (0 if absent)
+    alias_bytes: int       # donated-alias bytes (0 if absent)
+    compile_s: float
+    device_kind: str
+
+
+# Process-global cost table, like batch.py's AOT executable memo: one
+# compile serves many calls (and many registries) in a long-lived server.
+_COSTS: Dict[str, ProgramCost] = {}
+_COSTS_LOCK = threading.Lock()
+
+
+def clear_costs() -> None:
+    """Drop every captured program cost (test isolation)."""
+    with _COSTS_LOCK:
+        _COSTS.clear()
+
+
+def costs_snapshot() -> Dict[str, dict]:
+    """Plain-dict view of the captured costs (``/debug/vars``, capture
+    manifests)."""
+    with _COSTS_LOCK:
+        return {k: dataclasses.asdict(v) for k, v in sorted(_COSTS.items())}
+
+
+def _cost_analysis(compiled) -> Tuple[float, float]:
+    """(flops, bytes_accessed) from a Compiled's ``cost_analysis()``,
+    tolerating the dict / list-of-dicts shapes different jax versions
+    return.  Missing keys read 0.0 — XLA:CPU reports no byte counts."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+    return flops, nbytes
+
+
+def _memory_analysis(compiled) -> Tuple[int, int]:
+    """(peak_bytes, alias_bytes) from ``memory_analysis()`` — the same
+    donation-aware peak model parallel/batch.py publishes as
+    ``batch_exec_peak_bytes``."""
+    ma = compiled.memory_analysis()
+    alias = int(ma.alias_size_in_bytes)
+    peak = (int(ma.argument_size_in_bytes) + int(ma.output_size_in_bytes)
+            + int(ma.temp_size_in_bytes) - alias)
+    return peak, alias
+
+
+def capture_compiled(program: str, compiled, registry=None,
+                     compile_s: float = 0.0) -> Optional[ProgramCost]:
+    """Record one compiled program's static costs and publish the
+    compile-time gauges.  Returns the captured :class:`ProgramCost`, or
+    None when the runtime exposes neither analysis (counted as
+    ``prof_capture_errors{program=}``)."""
+    from iterative_cleaner_tpu.telemetry.registry import labeled
+
+    flops = nbytes = 0.0
+    peak = alias = 0
+    got = False
+    try:
+        flops, nbytes = _cost_analysis(compiled)
+        got = True
+    except Exception:  # icln: ignore[broad-except] -- cost analysis is advisory; any runtime refusal degrades to the error counter
+        if registry is not None:
+            registry.counter_inc(
+                labeled("prof_capture_errors", program=program))
+    try:
+        peak, alias = _memory_analysis(compiled)
+        got = True
+    except Exception:  # icln: ignore[broad-except] -- memory analysis is advisory on runtimes without it; the peak gauges just stay absent
+        if registry is not None:
+            registry.counter_inc(
+                labeled("prof_capture_errors", program=program))
+    if not got:
+        return None
+    cost = ProgramCost(program=program, flops=flops, bytes_accessed=nbytes,
+                       peak_bytes=peak, alias_bytes=alias,
+                       compile_s=float(compile_s),
+                       device_kind=device_kind())
+    with _COSTS_LOCK:
+        _COSTS[program] = cost
+    if registry is not None:
+        registry.counter_inc(labeled("prof_captures", program=program))
+        registry.gauge_set(labeled("prof_flops", program=program), flops)
+        registry.gauge_set(labeled("prof_bytes", program=program), nbytes)
+        registry.gauge_set(labeled("prof_peak_bytes", program=program),
+                           peak)
+        if compile_s:
+            registry.gauge_set(labeled("prof_compile_s", program=program),
+                               float(compile_s))
+    return cost
+
+
+def has_cost(program: str) -> bool:
+    """Whether ``program`` has a captured cost — callers use this to
+    skip a device sync that would only feed :func:`record_walltime`."""
+    with _COSTS_LOCK:
+        return program in _COSTS
+
+
+def roofline(cost: ProgramCost, seconds: float) -> dict:
+    """Achieved-throughput and roofline fractions for one measured warm
+    walltime of a captured program."""
+    fl_peak, bw_peak, nominal = device_peaks(cost.device_kind)
+    s = max(float(seconds), 1e-9)
+    achieved_flops = cost.flops / s
+    achieved_bw = cost.bytes_accessed / s
+    intensity = cost.flops / max(cost.bytes_accessed, 1.0)
+    attainable = min(fl_peak, intensity * bw_peak)
+    return {
+        "step_s": s,
+        "flops_util": achieved_flops / fl_peak,
+        "hbm_gbps": achieved_bw / 1e9,
+        "hbm_util": achieved_bw / bw_peak,
+        "roofline_frac": achieved_flops / max(attainable, 1.0),
+        "intensity": intensity,
+        "nominal_peaks": nominal,
+    }
+
+
+def record_walltime(program: str, seconds: float,
+                    registry=None) -> Optional[dict]:
+    """Pair one measured warm walltime with the program's captured static
+    cost and publish the achieved-throughput/roofline gauges.  A no-op
+    (returns None) when the program was never captured — callers can
+    time unconditionally and stay inert without profiling."""
+    with _COSTS_LOCK:
+        cost = _COSTS.get(program)
+    if cost is None:
+        return None
+    frac = roofline(cost, seconds)
+    if registry is not None:
+        from iterative_cleaner_tpu.telemetry.registry import labeled
+
+        registry.gauge_set(labeled("prof_step_s", program=program),
+                           frac["step_s"])
+        registry.gauge_set(labeled("prof_flops_util", program=program),
+                           frac["flops_util"])
+        registry.gauge_set(labeled("prof_hbm_gbps", program=program),
+                           frac["hbm_gbps"])
+        registry.gauge_set(labeled("prof_hbm_util", program=program),
+                           frac["hbm_util"])
+        registry.gauge_set(labeled("prof_roofline_frac", program=program),
+                           frac["roofline_frac"])
+        registry.gauge_set("prof_peak_nominal", float(frac["nominal_peaks"]))
+    return frac
+
+
+def profiling_enabled(explicit: Optional[bool] = None) -> bool:
+    """Whether opt-in cost capture (the paths that cost an extra compile,
+    e.g. the online step's AOT lowering) should run: an explicit caller
+    decision wins, else ``ICLEAN_PROFILE_DIR`` being set enables it."""
+    if explicit is not None:
+        return bool(explicit)
+    return bool(os.environ.get("ICLEAN_PROFILE_DIR"))
+
+
+# --------------------------------------------------------- trace capture
+_CAPTURE_SEQ = 0
+_CAPTURE_SEQ_LOCK = threading.Lock()
+
+
+def _next_capture_dir(profile_dir: str) -> str:
+    global _CAPTURE_SEQ
+    with _CAPTURE_SEQ_LOCK:
+        _CAPTURE_SEQ += 1
+        n = _CAPTURE_SEQ
+    stamp = time.strftime("%Y%m%dT%H%M%S")
+    return os.path.join(profile_dir,
+                        "capture-%s-%d-%03d" % (stamp, os.getpid(), n))
+
+
+@contextlib.contextmanager
+def trace_capture(profile_dir: str, registry=None,
+                  label: str = "capture") -> Iterator[str]:
+    """Capture a ``jax.profiler`` trace of the wrapped region into a
+    fresh subdirectory of ``profile_dir``.
+
+    The capture lands in a private ``.tmp`` directory first and is
+    renamed into place only after ``stop_trace`` and the manifest are
+    written — the publish is a single ``os.replace``, so a consumer
+    watching ``profile_dir`` never sees a partial capture.  Yields the
+    final (post-rename) capture path.
+    """
+    import jax
+
+    from iterative_cleaner_tpu.io.atomic import atomic_output, atomic_output_dir
+
+    final = _next_capture_dir(profile_dir)
+    os.makedirs(profile_dir, exist_ok=True)
+    dt = 0.0
+    with atomic_output_dir(final) as tmp:
+        t0 = time.perf_counter()
+        jax.profiler.start_trace(tmp)
+        try:
+            yield final
+        finally:
+            jax.profiler.stop_trace()
+            dt = time.perf_counter() - t0
+            manifest = {
+                "label": label,
+                "seconds": round(dt, 6),
+                "device_kind": device_kind(),
+                "programs": costs_snapshot(),
+            }
+            mpath = os.path.join(tmp, "profile_manifest.json")
+            with atomic_output(mpath) as mtmp:
+                with open(mtmp, "w") as f:
+                    json.dump(manifest, f, sort_keys=True, indent=2)
+                    f.write("\n")
+    if registry is not None:
+        registry.counter_inc("prof_trace_captures")
+        registry.gauge_set("prof_trace_capture_s", dt)
+
+
+def capture_for(profile_dir: str, seconds: float, registry=None,
+                label: str = "on-demand") -> str:
+    """Blocking on-demand capture: trace for ``seconds`` of wall clock
+    (whatever the process is doing meanwhile) and return the finished
+    capture path — the serve daemon's ``POST /profile`` body."""
+    with trace_capture(profile_dir, registry=registry, label=label) as path:
+        time.sleep(float(seconds))
+    return path

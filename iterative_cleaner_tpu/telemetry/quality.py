@@ -1,0 +1,236 @@
+"""Cleaning-quality observables: zap occupancy, churn, drift alerts.
+
+:mod:`iterative_cleaner_tpu.utils.quality` scores a clean against
+synthetic ground truth — available only when the truth is known.  This
+module is the production-side complement: observables computable from
+the masks alone, wired through the live registry so ``/metrics`` and
+``GET /quality`` answer "is this stream cleaning like it was a minute
+ago?" without any ground truth.
+
+Three families:
+
+* **Occupancy histograms.**  Per-channel and per-subint zapped
+  fractions of a finished mask (:func:`observe_mask`, called from the
+  online close path and available to batch result plumbing) land in
+  ``quality_chan_occupancy`` / ``quality_subint_occupancy`` histograms
+  over :data:`FRACTION_BUCKETS` — the operator's "which channels are
+  dying" distribution at a glance.
+
+* **Churn / template-drift series.**  :class:`QualityMonitor` follows
+  one live stream: per-subint provisional zap fraction
+  (``quality_zap_frac{stream=}``), reconcile-repaired cells
+  (``quality_mask_churn{stream=}``), and the relative step-to-step
+  movement of the EW template (``quality_ew_drift{stream=}``).
+
+* **Drift alerts.**  The monitor keeps a trailing window of per-subint
+  zap fractions; once the window is full, a subint whose fraction
+  departs the window median by more than the configured threshold
+  raises ``quality_drift_alerts{stream=}`` — the "RFI environment just
+  stepped" pager signal.
+
+Everything here READS numpy copies the session already made: the
+monitor can never perturb a mask, and the bit-equality contract
+(observability on == observability off) is asserted by
+tests/test_quality_monitor.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+# Trailing-window length (subints) and the absolute zap-fraction
+# departure that raises a drift alert.  CleanConfig's quality_window /
+# quality_drift override; the env mirrors cover daemon deployments.
+DEFAULT_QUALITY_WINDOW = 16
+DEFAULT_QUALITY_DRIFT = 0.15
+
+# Occupancy is a fraction in [0, 1]; these bounds resolve both the
+# "healthy" tail (a few percent) and the saturated end.
+FRACTION_BUCKETS = (0.01, 0.02, 0.05, 0.1, 0.2, 0.35, 0.5, 0.75, 1.0)
+
+
+def resolve_quality_window(value: Optional[int]) -> int:
+    """Explicit config value, else ICLEAN_QUALITY_WINDOW, else
+    :data:`DEFAULT_QUALITY_WINDOW`."""
+    if value is not None:
+        return int(value)
+    raw = os.environ.get("ICLEAN_QUALITY_WINDOW", "")
+    return int(raw) if raw else DEFAULT_QUALITY_WINDOW
+
+
+def resolve_quality_drift(value: Optional[float]) -> float:
+    """Explicit config value, else ICLEAN_QUALITY_DRIFT, else
+    :data:`DEFAULT_QUALITY_DRIFT`."""
+    if value is not None:
+        return float(value)
+    raw = os.environ.get("ICLEAN_QUALITY_DRIFT", "")
+    return float(raw) if raw else DEFAULT_QUALITY_DRIFT
+
+
+def observe_mask(weights, registry, *, stream: Optional[str] = None
+                 ) -> dict:
+    """Fold one finished (nsub, nchan) mask into the occupancy
+    histograms and return the summary (total zap fraction plus the
+    extreme channels/subints).  ``stream`` labels the series for live
+    sessions; batch runs leave it None (unlabelled process-wide
+    histograms)."""
+    from iterative_cleaner_tpu.telemetry.registry import labeled
+
+    zapped = np.asarray(weights) == 0
+    nsub, nchan = zapped.shape
+    chan_occ = zapped.mean(axis=0)      # (nchan,) fraction of subints
+    sub_occ = zapped.mean(axis=1)       # (nsub,) fraction of channels
+    label = {} if stream is None else {"stream": stream}
+    if registry is not None:
+        for f in chan_occ:
+            registry.histogram_observe(
+                labeled("quality_chan_occupancy", **label), float(f),
+                buckets=FRACTION_BUCKETS)
+        for f in sub_occ:
+            registry.histogram_observe(
+                labeled("quality_subint_occupancy", **label), float(f),
+                buckets=FRACTION_BUCKETS)
+        registry.gauge_set(labeled("quality_zap_frac_final", **label),
+                           float(zapped.mean()))
+    return {
+        "zap_frac": float(zapped.mean()),
+        "nsub": int(nsub),
+        "nchan": int(nchan),
+        "worst_channel": int(np.argmax(chan_occ)),
+        "worst_channel_frac": float(chan_occ.max()),
+        "worst_subint": int(np.argmax(sub_occ)),
+        "worst_subint_frac": float(sub_occ.max()),
+    }
+
+
+def observe_result(result, registry, *, n_cells: Optional[int] = None
+                   ) -> dict:
+    """Batch-side result plumbing: occupancy histograms from a
+    :class:`CleanResult`'s final mask plus the per-iteration churn
+    series (:func:`engine.loop.iter_quality_series`) as
+    ``quality_iter_churn`` observations.  Returns the mask summary
+    (the run report's per-archive ``quality`` entry)."""
+    from iterative_cleaner_tpu.engine.loop import iter_quality_series
+    from iterative_cleaner_tpu.telemetry.registry import COUNTS
+
+    summary = observe_mask(result.final_weights, registry)
+    im = getattr(result, "iter_metrics", None)
+    if im is None or registry is None:
+        return summary
+    w = np.asarray(result.final_weights)
+    series = iter_quality_series(im, n_cells or int(w.size))
+    for churn in series.get("mask_churn", ()):
+        registry.histogram_observe("quality_iter_churn", float(churn),
+                                   buckets=COUNTS)
+    return summary
+
+
+class QualityMonitor:
+    """Per-stream cleaning-quality follower (see module docstring).
+
+    One instance per :class:`~iterative_cleaner_tpu.online.session.\
+OnlineSession`; every method reads host-side numpy copies only.
+    """
+
+    def __init__(self, *, stream: str = "local",
+                 window: Optional[int] = None,
+                 drift: Optional[float] = None, registry=None) -> None:
+        self.stream = str(stream)
+        self.window = resolve_quality_window(window)
+        if self.window < 2:
+            raise ValueError(
+                f"quality window must be >= 2 subints, got {self.window}")
+        self.drift = resolve_quality_drift(drift)
+        if not self.drift > 0:
+            raise ValueError(
+                f"quality drift threshold must be > 0, got {self.drift}")
+        self.registry = registry
+        self._fracs = collections.deque(maxlen=self.window)
+        self._prev_template: Optional[np.ndarray] = None
+        self.n_subints = 0
+        self.alerts = 0
+        self.mask_churn = 0
+        self.last_zap_frac = 0.0
+        self.last_baseline: Optional[float] = None
+        self.last_ew_drift = 0.0
+        self.last_alert_subint: Optional[int] = None
+        self.last_alert_ts: Optional[float] = None
+
+    # ------------------------------------------------------------ labels
+    def _labeled(self, name: str) -> str:
+        from iterative_cleaner_tpu.telemetry.registry import labeled
+
+        return labeled(name, stream=self.stream)
+
+    # ------------------------------------------------------------ hooks
+    def observe_subint(self, mask_row, template=None) -> bool:
+        """One provisional per-subint mask row (and optionally the
+        current EW template).  Returns True when this subint raised a
+        drift alert."""
+        frac = float(np.mean(np.asarray(mask_row) == 0))
+        alerted = False
+        baseline = None
+        if len(self._fracs) == self.window:
+            baseline = float(np.median(self._fracs))
+            if abs(frac - baseline) > self.drift:
+                alerted = True
+                self.alerts += 1
+                self.last_alert_subint = self.n_subints
+                self.last_alert_ts = time.time()
+        self._fracs.append(frac)
+        self.n_subints += 1
+        self.last_zap_frac = frac
+        self.last_baseline = baseline
+        if template is not None:
+            t = np.asarray(template, dtype=np.float64)
+            if self._prev_template is not None:
+                denom = float(np.linalg.norm(self._prev_template)) or 1.0
+                self.last_ew_drift = float(
+                    np.linalg.norm(t - self._prev_template)) / denom
+            self._prev_template = t
+        if self.registry is not None:
+            self.registry.gauge_set(self._labeled("quality_zap_frac"), frac)
+            self.registry.histogram_observe(
+                self._labeled("quality_subint_occupancy"), frac,
+                buckets=FRACTION_BUCKETS)
+            if template is not None:
+                self.registry.gauge_set(
+                    self._labeled("quality_ew_drift"), self.last_ew_drift)
+            if alerted:
+                self.registry.counter_inc(
+                    self._labeled("quality_drift_alerts"))
+        return alerted
+
+    def observe_reconcile(self, drift_cells: int) -> None:
+        """Reconcile-repaired provisional cells — the mask-churn series."""
+        self.mask_churn += int(drift_cells)
+        if self.registry is not None and drift_cells:
+            self.registry.counter_inc(self._labeled("quality_mask_churn"),
+                                      int(drift_cells))
+
+    def observe_close(self, final_weights) -> dict:
+        """The finished mask's occupancy histograms + summary."""
+        return observe_mask(final_weights, self.registry,
+                            stream=self.stream)
+
+    # ----------------------------------------------------------- summary
+    def summary(self) -> dict:
+        """One JSON-ready view for ``GET /quality``."""
+        return {
+            "stream": self.stream,
+            "n_subints": self.n_subints,
+            "window": self.window,
+            "drift_threshold": self.drift,
+            "zap_frac": self.last_zap_frac,
+            "baseline": self.last_baseline,
+            "ew_drift": self.last_ew_drift,
+            "mask_churn": self.mask_churn,
+            "alerts": self.alerts,
+            "last_alert_subint": self.last_alert_subint,
+            "last_alert_ts": self.last_alert_ts,
+        }

@@ -32,7 +32,12 @@ DEFAULT_BUCKETS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0)
 # Named presets: pass as ``buckets=`` so a latency histogram resolves
 # sub-second work and a size histogram spans KiB→GiB, instead of both
 # collapsing into one ill-fitting vector.
-SECONDS = (0.005, 0.025, 0.1, 0.5, 1.0, 2.5, 10.0, 30.0, 120.0)
+# Sub-millisecond bounds lead: the online per-subint step lands well
+# under 5 ms warm, and without them its p50 collapsed into the first
+# bucket.  Appending finer bounds only adds ``le`` series — existing
+# series keys (histogram names and the coarser ``le`` rows) are stable.
+SECONDS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+           0.005, 0.025, 0.1, 0.5, 1.0, 2.5, 10.0, 30.0, 120.0)
 COUNTS = DEFAULT_BUCKETS
 BYTES = (1024.0, 16384.0, 262144.0, 1048576.0, 16777216.0,
          268435456.0, 1073741824.0)

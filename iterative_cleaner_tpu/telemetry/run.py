@@ -101,6 +101,9 @@ class RunTelemetry:
         from iterative_cleaner_tpu.telemetry.registry import COUNTS
         r.histogram_observe("loops_per_archive", loops, buckets=COUNTS)
 
+        from iterative_cleaner_tpu.telemetry.quality import observe_result
+
+        quality = observe_result(result, r)
         history = iter_metrics_dict(getattr(result, "iter_metrics", None))
         entry = {
             "path": str(path),
@@ -109,6 +112,7 @@ class RunTelemetry:
             "cells_zapped": zapped,
             "rfi_fraction": float(result.rfi_fraction),
             "iter_history": history,
+            "quality": quality,
         }
         self.archives.append(entry)
 

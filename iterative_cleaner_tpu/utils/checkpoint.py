@@ -44,7 +44,12 @@ _IDENTITY_EXCLUDE = {"unload_res", "record_history",
                      # host placement/lease knobs: which process serves a
                      # bucket never changes its mask — stolen work must
                      # satisfy the original host's journal entries
-                     "fleet_hosts", "fleet_host_id", "fleet_claim_ttl_s"}
+                     "fleet_hosts", "fleet_host_id", "fleet_claim_ttl_s",
+                     # quality observability knobs: the drift detector only
+                     # reads host-side mask copies (telemetry/quality.py) —
+                     # it can never change a mask, so a resume under a
+                     # different --quality-window/--quality-drift must match
+                     "quality_window", "quality_drift"}
 # The elastic-pool knobs (join/member_ttl_s/result_cache) are ServeConfig
 # fields, deliberately outside CleanConfig: pool membership and result
 # caching can never change a mask, and the cache/journal 'member'/'cache'

@@ -1,0 +1,122 @@
+"""Bench-history regression gate (telemetry/benchtrack, icln-bench).
+
+The committed BENCH_r*.json series must pass its own gate (the CI
+invariant), and a seeded regression must fire it — the test that proves
+the gate is not vacuously green.
+"""
+
+import json
+
+from iterative_cleaner_tpu.telemetry import MetricsRegistry
+from iterative_cleaner_tpu.telemetry.benchtrack import (
+    TRACKED,
+    check_history,
+    default_history_dir,
+    export_verdicts,
+    load_history,
+    main,
+)
+
+
+def _round_file(d, n, parsed, rc=0):
+    doc = {"n": n, "cmd": "bench", "rc": rc, "tail": "", "parsed": parsed}
+    (d / ("BENCH_r%02d.json" % n)).write_text(json.dumps(doc))
+
+
+def _parsed(**kw):
+    base = {"platform": "cpu"}
+    base.update(kw)
+    return base
+
+
+# ------------------------------------------------- the committed series
+
+def test_committed_history_passes_its_own_gate():
+    history = load_history(default_history_dir())
+    assert len(history) >= 2
+    result = check_history(history)
+    assert result.ok, [v for v in result.verdicts if v.status == "fail"]
+    # the flagship throughput key must actually be compared, not "new"
+    statuses = {v.key: v.status for v in result.verdicts}
+    assert statuses["value"] == "pass"
+    # every tracked key produced a verdict row
+    assert set(statuses) == set(TRACKED)
+
+
+# ------------------------------------------------------- seeded regressions
+
+def test_seeded_throughput_regression_fires(tmp_path):
+    for n in (1, 2, 3):
+        _round_file(tmp_path, n, _parsed(value=100.0 + n))
+    _round_file(tmp_path, 4, _parsed(value=50.0))   # -51% >> tol 35%
+    result = check_history(load_history(str(tmp_path)))
+    assert not result.ok
+    fail = {v.key: v for v in result.verdicts}["value"]
+    assert fail.status == "fail"
+    assert fail.baseline == 102.0                   # median of 101,102,103
+    assert fail.latest == 50.0
+
+    reg = MetricsRegistry()
+    export_verdicts(result, reg)
+    snap = reg.snapshot()["gauges"]
+    assert snap["bench_regressions{key=value}"] == 1.0
+    assert snap["bench_regressions_total"] == 1.0
+    assert snap["bench_rounds_checked"] == 4.0
+
+    assert main(["--check", "--history", str(tmp_path)]) == 1
+
+
+def test_latency_key_regresses_upward(tmp_path):
+    # "lower" direction: ms_per_iter growing past baseline*(1+tol) fails
+    for n in (1, 2):
+        _round_file(tmp_path, n, _parsed(ms_per_iter=10.0))
+    _round_file(tmp_path, 3, _parsed(ms_per_iter=20.0))
+    result = check_history(load_history(str(tmp_path)))
+    fail = {v.key: v for v in result.verdicts}["ms_per_iter"]
+    assert fail.status == "fail" and fail.bound == 13.5
+
+
+def test_wobble_within_band_passes(tmp_path):
+    # the committed series wobbles ~15% round to round; the median
+    # baseline plus the loose band must absorb that
+    for n, v in enumerate((100.0, 87.0, 113.0, 95.0), start=1):
+        _round_file(tmp_path, n, _parsed(value=v))
+    result = check_history(load_history(str(tmp_path)))
+    assert {v.key: v for v in result.verdicts}["value"].status == "pass"
+    assert main(["--check", "--history", str(tmp_path)]) == 0
+
+
+# ------------------------------------------------ qualification and hygiene
+
+def test_platform_change_resets_the_baseline(tmp_path):
+    # TPU rounds never gate a CPU fallback round (and vice versa)
+    for n in (1, 2):
+        _round_file(tmp_path, n, _parsed(value=100000.0, platform="tpu v4"))
+    _round_file(tmp_path, 3, _parsed(value=90.0, platform="cpu"))
+    result = check_history(load_history(str(tmp_path)))
+    v = {v.key: v for v in result.verdicts}["value"]
+    assert v.status == "new" and result.ok
+
+
+def test_failed_and_unparsed_rounds_are_skipped(tmp_path):
+    _round_file(tmp_path, 1, _parsed(value=100.0))
+    _round_file(tmp_path, 2, _parsed(value=1.0), rc=1)     # failed run
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps({"n": 3, "rc": 0, "parsed": None}))     # no payload
+    _round_file(tmp_path, 4, _parsed(value=95.0))
+    history = load_history(str(tmp_path))
+    assert [n for n, _ in history] == [1, 4]
+    assert check_history(history).ok
+
+
+def test_untracked_keys_never_gate(tmp_path):
+    _round_file(tmp_path, 1, _parsed(value=100.0, brand_new_metric=5.0))
+    _round_file(tmp_path, 2, _parsed(value=100.0, brand_new_metric=0.01))
+    result = check_history(load_history(str(tmp_path)))
+    assert result.ok
+    assert "brand_new_metric" not in {v.key for v in result.verdicts}
+
+
+def test_cli_exit_codes_for_empty_and_usage(tmp_path):
+    assert main(["--check", "--history", str(tmp_path)]) == 2  # no history
+    assert main(["--history", str(tmp_path)]) == 2             # no --check

@@ -593,3 +593,197 @@ def test_sigkilled_host_trace_stitches_in_survivor_subprocess(tmp_path):
     names = {m["args"]["name"] for m in doc["traceEvents"]
              if m["ph"] == "M" and m["name"] == "process_name"}
     assert {"host h0", "host h1"} <= names
+
+
+# ------------------------------- PR 16: profiler / quality / bench gauges
+
+def test_prometheus_label_value_escaping_round_trip():
+    """Satellite contract: backslash, double-quote and newline in label
+    values render per the text exposition spec instead of being mangled."""
+    from iterative_cleaner_tpu.telemetry.exporters import (
+        _escape_label_value,
+    )
+
+    assert _escape_label_value('a"b') == 'a\\"b'
+    assert _escape_label_value("a\\b") == "a\\\\b"
+    assert _escape_label_value("a\nb") == "a\\nb"
+    # backslash first: escaping it last would re-escape the others
+    assert _escape_label_value('\\"\n') == '\\\\\\"\\n'
+
+    reg = MetricsRegistry()
+    reg.counter_inc(labeled("esc_src", path='C:\\data "x"'), 2)
+    text = metrics_to_prometheus(reg.snapshot())
+    assert 'icln_esc_src_total{path="C:\\\\data \\"x\\""} 2' in text
+
+
+@pytest.mark.slow  # two AOT compiles (~5s): CI runs it in the
+# multi-host step's -m slow pass
+def test_metrics_expose_roofline_gauges_for_batch_and_fleet_programs():
+    """Acceptance: the hot programs publish prof_roofline_frac /
+    prof_hbm_gbps through the ordinary registry, so any /metrics scrape
+    renders them with a program label."""
+    from iterative_cleaner_tpu.io import make_synthetic_archive
+    from iterative_cleaner_tpu.parallel.batch import (
+        clean_archives_batched,
+        precompile_batched_executable,
+    )
+    from iterative_cleaner_tpu.telemetry import profiling
+
+    profiling.clear_costs()
+    cfg = CleanConfig(rotation="roll", fft_mode="dft", dtype="float64",
+                      max_iter=2)
+    reg = MetricsRegistry()
+    # distinct geometries per program: the AOT memo would otherwise
+    # short-circuit the second compile and skip its cost capture
+    for program, nbin in ((None, 16), ("fleet_bucket", 32)):
+        archives = [make_synthetic_archive(nsub=4, nchan=6, nbin=nbin,
+                                           seed=s)[0] for s in range(2)]
+        exe = precompile_batched_executable(
+            cfg, 4, 6, nbin, True, 2, registry=reg, program=program)
+        clean_archives_batched(archives, cfg, registry=reg,
+                               executable=exe, program=program)
+    assert profiling.has_cost("batch")
+    assert profiling.has_cost("fleet_bucket")
+    text = metrics_to_prometheus(reg.snapshot())
+    for prog in ("batch", "fleet_bucket"):
+        assert 'icln_prof_roofline_frac{program="%s"}' % prog in text
+        assert 'icln_prof_hbm_gbps{program="%s"}' % prog in text
+        assert 'icln_prof_flops{program="%s"}' % prog in text
+    # CPU runs flag their nominal (non-roofline) peak numbers honestly
+    assert "icln_prof_peak_nominal 1" in text
+
+
+def test_program_label_resolution():
+    from iterative_cleaner_tpu.parallel.batch import _program_label
+
+    assert _program_label(("x", "y", "on")) == "fused_sweep"
+    assert _program_label(("x", "y", "off")) == "batch"
+    assert _program_label(("x", "y", "off"), "fleet_bucket") \
+        == "fleet_bucket"
+
+
+def _post(url, expect=200):
+    req = urllib.request.Request(url, method="POST", data=b"")
+    try:
+        r = urllib.request.urlopen(req, timeout=30)
+        assert r.status == expect
+        return json.loads(r.read())
+    except urllib.error.HTTPError as exc:
+        assert exc.code == expect, (exc.code, exc.read())
+        return json.loads(exc.read())
+
+
+def test_profile_and_quality_endpoints_unarmed_daemon(tmp_path):
+    # one daemon WITHOUT --profile-dir: /profile refuses, /quality idles
+    daemon = _daemon(tmp_path)
+    t, base = _start(daemon)
+    try:
+        err = _post(base + "/profile?seconds=1", expect=400)["error"]
+        assert "profile-dir" in err or "ICLEAN_PROFILE_DIR" in err
+        assert _get(base + "/quality") == {"streams": {}, "series": {}}
+        # debug/vars carries the program cost table
+        assert "program_costs" in _get(base + "/debug/vars")
+    finally:
+        daemon._on_signal(signal.SIGTERM, None)
+        t.join(timeout=60)
+
+
+@pytest.mark.slow  # ~15s: jax.profiler start/stop dominates (CI runs it
+#                    in the multi-host step's -m slow pass)
+def test_concurrent_scrapes_race_mutation_and_profile_capture(tmp_path):
+    """Satellite contract: /metrics and /debug/vars scrapes racing
+    registry mutation, span spooling and an in-flight profiler capture —
+    every exposition parses (never torn), nothing deadlocks; a second
+    concurrent capture is refused with 409, never queued; the finished
+    capture publishes atomically with its manifest."""
+    from iterative_cleaner_tpu.telemetry.exporters import (
+        parse_prometheus_text,
+    )
+
+    prof = tmp_path / "prof"
+    prof.mkdir()
+    daemon = _daemon(tmp_path, profile_dir=str(prof),
+                     trace_out=str(tmp_path / "trace.json"))
+    t, base = _start(daemon)
+    stop = threading.Event()
+    errors = []
+    results = {}
+
+    def mutate():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            daemon.registry.counter_inc(labeled("race_hits",
+                                                tenant="t%d" % (i % 3)))
+            daemon.registry.histogram_observe("race_lat_s", 0.001 * i,
+                                              buckets=SECONDS)
+            span = daemon.tracer.start("race", subsystem="test",
+                                       lane="serve")
+            span.end()
+            time.sleep(0.001)  # keep cores free for the scrapers
+
+    def scrape(path):
+        while not stop.is_set():
+            try:
+                r = urllib.request.urlopen(base + path, timeout=10)
+                body = r.read().decode()
+                if path == "/metrics":
+                    parsed = parse_prometheus_text(body)
+                    assert isinstance(parsed, dict)
+                else:
+                    json.loads(body)
+            except Exception as exc:  # noqa: BLE001 - collected and failed below
+                errors.append((path, repr(exc)))
+                return
+
+    def capture():
+        results["first"] = _post(base + "/profile?seconds=0.3")
+
+    threads = [threading.Thread(target=mutate) for _ in range(2)]
+    threads += [threading.Thread(target=scrape, args=("/metrics",))
+                for _ in range(2)]
+    threads += [threading.Thread(target=scrape, args=("/debug/vars",))]
+    for th in threads:
+        th.start()
+    cap = threading.Thread(target=capture)
+    cap.start()
+    try:
+        # while the first capture holds the profiler, a concurrent one
+        # is rejected 409 profile_busy (never queued or deadlocked)
+        deadline = time.time() + 10
+        busy = None
+        while time.time() < deadline:
+            if daemon._profile_lock.locked():
+                busy = _post(base + "/profile?seconds=0.05", expect=409)
+                break
+            time.sleep(0.01)
+        cap.join(timeout=60)
+        assert busy is not None, "capture never took the profile lock"
+        assert busy["reason"] == "profile_busy"
+        # bad inputs 400 without touching the profiler
+        assert "seconds" in _post(base + "/profile?seconds=0",
+                                  expect=400)["error"]
+        assert "number" in _post(base + "/profile?seconds=nope",
+                                 expect=400)["error"]
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+            assert not th.is_alive(), "scrape/mutate thread wedged"
+        daemon._on_signal(signal.SIGTERM, None)
+        t.join(timeout=60)
+    assert not errors, errors
+    # the capture published atomically: finished dir + manifest, no
+    # torn .tmp tree left behind
+    out = results["first"]["profile_dir"]
+    assert os.path.isdir(out)
+    manifest = json.load(open(os.path.join(out, "profile_manifest.json")))
+    assert manifest["label"] == "on-demand"
+    assert manifest["seconds"] >= 0.3
+    assert not [n for n in os.listdir(prof) if n.endswith(".tmp")]
+    snap = daemon.registry.snapshot()
+    assert snap["counters"]["prof_trace_captures"] == 1.0
+    assert snap["counters"]["serve_profile_captures"] == 1.0
+    # the registry survived with consistent totals
+    hist = snap["histograms"]["race_lat_s"]
+    assert hist["count"] == hist["cumulative_counts"][-1]

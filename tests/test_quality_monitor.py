@@ -1,0 +1,230 @@
+"""Cleaning-quality observables (telemetry/quality).
+
+Two contracts dominate: the drift detector must raise
+``quality_drift_alerts`` within the configured window of a mid-stream
+occupancy step, and the whole observability layer must be a pure
+observer — masks bit-equal with the quality/profiling hooks on and off.
+"""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.engine.loop import iter_quality_series
+from iterative_cleaner_tpu.io import make_synthetic_archive
+from iterative_cleaner_tpu.online import OnlineSession, StreamMeta
+from iterative_cleaner_tpu.telemetry import MetricsRegistry
+from iterative_cleaner_tpu.telemetry.quality import (
+    DEFAULT_QUALITY_DRIFT,
+    DEFAULT_QUALITY_WINDOW,
+    QualityMonitor,
+    observe_mask,
+    observe_result,
+    resolve_quality_drift,
+    resolve_quality_window,
+)
+
+
+# ------------------------------------------------------------ resolution
+
+def test_quality_knob_resolution_order(monkeypatch):
+    monkeypatch.delenv("ICLEAN_QUALITY_WINDOW", raising=False)
+    monkeypatch.delenv("ICLEAN_QUALITY_DRIFT", raising=False)
+    assert resolve_quality_window(None) == DEFAULT_QUALITY_WINDOW
+    assert resolve_quality_drift(None) == DEFAULT_QUALITY_DRIFT
+    monkeypatch.setenv("ICLEAN_QUALITY_WINDOW", "7")
+    monkeypatch.setenv("ICLEAN_QUALITY_DRIFT", "0.4")
+    assert resolve_quality_window(None) == 7
+    assert resolve_quality_drift(None) == 0.4
+    # explicit config wins over the env mirror
+    assert resolve_quality_window(3) == 3
+    assert resolve_quality_drift(0.05) == 0.05
+
+
+def test_monitor_and_config_validation():
+    with pytest.raises(ValueError, match="window"):
+        QualityMonitor(window=1)
+    with pytest.raises(ValueError, match="drift"):
+        QualityMonitor(drift=0.0)
+    with pytest.raises(ValueError, match="quality_window"):
+        CleanConfig(quality_window=1)
+    with pytest.raises(ValueError, match="quality_drift"):
+        CleanConfig(quality_drift=-0.1)
+
+
+# ----------------------------------------------------------- drift alerts
+
+def test_drift_alert_fires_within_window_of_occupancy_step():
+    reg = MetricsRegistry()
+    mon = QualityMonitor(stream="s1", window=4, drift=0.1, registry=reg)
+    clean = np.ones(16)
+    rfi = np.ones(16)
+    rfi[:5] = 0.0                                   # occupancy 0.3125
+    for i in range(6):
+        assert not mon.observe_subint(clean)
+    # the very first stepped subint alerts: |0.3125 - 0| > 0.1
+    assert mon.observe_subint(rfi)
+    assert mon.alerts == 1
+    assert mon.last_alert_subint == 6
+    counters = reg.snapshot()["counters"]
+    assert counters["quality_drift_alerts{stream=s1}"] == 1.0
+    gauges = reg.snapshot()["gauges"]
+    assert gauges["quality_zap_frac{stream=s1}"] == pytest.approx(0.3125)
+    s = mon.summary()
+    assert s["alerts"] == 1 and s["baseline"] == 0.0
+    assert s["last_alert_subint"] == 6
+
+
+def test_no_alert_until_window_fills_or_within_tolerance():
+    mon = QualityMonitor(window=4, drift=0.2)
+    jumpy = np.ones(10)
+    jumpy[:9] = 0.0
+    # window not yet full: even a 90% subint is baseline-building, not
+    # alert-raising (a stream that STARTS dirty is its own baseline)
+    assert not mon.observe_subint(jumpy)
+    mild = np.ones(10)
+    mild[0] = 0.0
+    for _ in range(5):
+        assert not mon.observe_subint(mild)
+    # within the band: 0.2 departure threshold absorbs 0.1 steps
+    drift = np.ones(10)
+    drift[:2] = 0.0
+    assert not mon.observe_subint(drift)
+    assert mon.alerts == 0
+
+
+def test_ew_template_drift_series():
+    reg = MetricsRegistry()
+    mon = QualityMonitor(stream="s2", window=2, drift=0.5, registry=reg)
+    row = np.ones(8)
+    mon.observe_subint(row, template=np.array([1.0, 0.0]))
+    assert mon.last_ew_drift == 0.0                 # first template: no step
+    mon.observe_subint(row, template=np.array([1.0, 1.0]))
+    assert mon.last_ew_drift == pytest.approx(1.0)  # |Δ|/|prev| = 1/1
+    assert reg.snapshot()["gauges"][
+        "quality_ew_drift{stream=s2}"] == pytest.approx(1.0)
+
+
+# ------------------------------------------------------ occupancy folding
+
+def test_observe_mask_summary_and_histograms():
+    reg = MetricsRegistry()
+    w = np.ones((4, 8))
+    w[:, 3] = 0.0                                   # one dead channel
+    w[2, :] = 0.0                                   # one dead subint
+    s = observe_mask(w, reg, stream="s3")
+    assert s["worst_channel"] == 3
+    assert s["worst_channel_frac"] == 1.0
+    assert s["worst_subint"] == 2
+    assert s["worst_subint_frac"] == 1.0
+    assert s["zap_frac"] == pytest.approx(11 / 32)
+    h = reg.snapshot()["histograms"]
+    assert h["quality_chan_occupancy{stream=s3}"]["count"] == 8
+    assert h["quality_subint_occupancy{stream=s3}"]["count"] == 4
+    assert reg.snapshot()["gauges"][
+        "quality_zap_frac_final{stream=s3}"] == pytest.approx(11 / 32)
+
+
+def test_iter_quality_series_shapes_and_scaling():
+    im = np.array([[8.0, 8.0, 0.5, 2.0],
+                   [10.0, 2.0, 0.4, 2.1]])
+    s = iter_quality_series(im, n_cells=100)
+    assert s["zap_frac"] == [0.08, 0.10]
+    assert s["mask_churn"] == [8.0, 2.0]
+    assert s["residual_std"] == [0.5, 0.4]
+    assert s["template_peak"] == [2.0, 2.1]
+    with pytest.raises(ValueError):
+        iter_quality_series(np.zeros((2, 3)), n_cells=10)
+
+
+def test_observe_result_folds_churn_histogram():
+    reg = MetricsRegistry()
+
+    class R:
+        final_weights = np.ones((4, 8))
+        iter_metrics = np.array([[3.0, 3.0, 0.1, 1.0],
+                                 [4.0, 1.0, 0.1, 1.0]])
+
+    summary = observe_result(R(), reg)
+    assert summary["zap_frac"] == 0.0
+    h = reg.snapshot()["histograms"]
+    assert h["quality_iter_churn"]["count"] == 2
+
+
+# -------------------------------------- live-session acceptance contract
+
+def _stream_cube(nsub=10, nchan=8, nbin=16, seed=21):
+    ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                   seed=seed)
+    cube = np.asarray(ar.total_intensity(), dtype=np.float64)
+    return cube, StreamMeta.from_archive(ar)
+
+
+def _run_stream(cube, meta, weights_for, registry, **session_kw):
+    cfg = CleanConfig(backend="jax", max_iter=2, quality_window=3,
+                      quality_drift=0.2, stream_reconcile_every=0)
+    s = OnlineSession(meta, cfg, registry=registry, **session_kw)
+    for i in range(cube.shape[0]):
+        s.ingest(cube[i], weights_for(i))
+    return s, s.close()
+
+
+@pytest.mark.slow  # two full 12-subint sessions (~5s): CI runs it in
+# the multi-host step's -m slow pass
+def test_online_occupancy_step_alerts_and_masks_stay_bit_equal():
+    """The acceptance contract: a stream whose injected RFI occupancy
+    steps mid-stream raises quality_drift_alerts within the configured
+    window — and the masks are bit-equal with the observability-off
+    route."""
+    cube, meta = _stream_cube()
+    step_at = 6
+
+    def weights_for(i):
+        w = np.ones((meta.nchan,))
+        if i >= step_at:
+            w[: meta.nchan // 2] = 0.0   # upstream flags half the band
+        return w
+
+    reg = MetricsRegistry()
+    s_on, res_on = _run_stream(cube, meta, weights_for, reg,
+                               stream_id="live", profile=True)
+    # the first stepped subint departs the trailing median by 0.5 > 0.2,
+    # so alerts land from the step onward — within the 3-subint window
+    # (later stepped subints keep alerting until the window re-fills,
+    # and last_alert_subint tracks the latest of them)
+    assert s_on.quality.alerts >= 1
+    assert step_at <= s_on.quality.last_alert_subint \
+        < step_at + s_on.quality.window
+    counters = reg.snapshot()["counters"]
+    assert counters["quality_drift_alerts{stream=live}"] >= 1.0
+
+    # observability off: no registry, no monitor, no profiling
+    s_off, res_off = _run_stream(cube, meta, weights_for, None)
+    assert s_off.quality is None
+    np.testing.assert_array_equal(
+        np.asarray(res_on.archive.weights),
+        np.asarray(res_off.archive.weights))
+    np.testing.assert_array_equal(s_on.provisional_weights,
+                                  s_off.provisional_weights)
+
+
+@pytest.mark.slow  # reconciling 8-subint session (~8s): CI runs it in
+# the multi-host step's -m slow pass
+def test_session_reconcile_and_close_feed_the_churn_series():
+    cube, meta = _stream_cube(nsub=8, seed=5)
+    cube = cube.copy()
+    cube[1, 2] += 40.0                  # hot RFI the reconcile repairs
+    reg = MetricsRegistry()
+    cfg = CleanConfig(backend="jax", max_iter=2, quality_window=3,
+                      quality_drift=0.2)
+    s = OnlineSession(meta, cfg, reconcile_every=4, registry=reg,
+                      stream_id="churn")
+    for i in range(cube.shape[0]):
+        s.ingest(cube[i])
+    res = s.close()
+    # monitor churn equals the session's own drift accounting
+    assert s.quality.mask_churn == res.mask_drift + res.final_drift
+    gauges = reg.snapshot()["gauges"]
+    assert "quality_zap_frac_final{stream=churn}" in gauges
+    h = reg.snapshot()["histograms"]
+    assert h["quality_chan_occupancy{stream=churn}"]["count"] == meta.nchan
