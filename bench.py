@@ -1508,6 +1508,153 @@ def bench_online(n_subints, nchan, nbin, reconcile_every=4, bucket_pad=8,
     }
 
 
+def bench_mux(n_streams, n_subints, nchan, nbin, max_batch=None,
+              bucket_pad=8, max_iter=3):
+    """Multiplexed online-serving row (online/mux.py): a synthetic burst
+    of ``n_streams`` live streams fed round-robin through ONE StreamMux
+    vs the same subints through N independent OnlineSessions.
+
+    The sequential baseline shares one pre-jitted step across its N
+    sessions (the ``step_fn=`` kwarg), so the measured ratio is pure
+    dispatch amortization — batching ``max_batch`` streams' heads into
+    one device call — not N-1 avoided compiles.  Both paths are warmed
+    before timing (the baseline's shared step on a throwaway session;
+    the mux's batch rungs with throwaway lanes), so the timed window is
+    the steady state both subsystems contract to serve.
+
+    Contracts, fatal when broken (rc 7 through the bench subprocess):
+
+    * ``mux_recompiles_steady`` == 0 — every (bucket, rung) executable
+      compiles during warm-up; a steady-state recompile IS the latency
+      regression the rung ladder exists to prevent.
+    * ``mux_vs_sequential_masks`` — every stream's provisional weights
+      must be bit-equal with its independent-session twin, subint by
+      subint (scores compared with equal_nan: the nsub=1 channel-median
+      degeneracy makes provisional scores NaN on BOTH paths).
+    """
+    import jax
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io.synthetic import (
+        bench_rfi_density,
+        make_synthetic_archive,
+    )
+    from iterative_cleaner_tpu.online import OnlineSession, StreamMux
+    from iterative_cleaner_tpu.online.chunks import StreamMeta
+    from iterative_cleaner_tpu.online.session import (
+        percentile_ms,
+        resolve_ew_alpha,
+    )
+    from iterative_cleaner_tpu.online.step import build_subint_step
+
+    cfg = CleanConfig(backend="jax", max_iter=max_iter,
+                      fleet_bucket_pad=(0, bucket_pad),
+                      stream_reconcile_every=0)
+    streams = []
+    for s in range(n_streams):
+        ar, _ = make_synthetic_archive(
+            nsub=n_subints, nchan=nchan, nbin=nbin,
+            **bench_rfi_density(n_subints, nchan), seed=s,
+            dtype=np.float32)
+        streams.append((StreamMeta.from_archive(ar),
+                        np.asarray(ar.total_intensity(), np.float64),
+                        np.asarray(ar.weights, np.float64)))
+
+    # ---- sequential baseline: N independent sessions, ONE shared step
+    alpha = resolve_ew_alpha(cfg.stream_ew_alpha)
+    shared = jax.jit(build_subint_step(cfg, nchan, nbin, False, alpha)[0])
+    warm = OnlineSession(streams[0][0], cfg, step_fn=shared)
+    warm.ingest(streams[0][1][0], streams[0][2][0], label="warm")
+    solo = []
+    t0 = time.perf_counter()
+    for s, (meta, cube, weights) in enumerate(streams):
+        sess = OnlineSession(meta, cfg, step_fn=shared)
+        for i in range(n_subints):
+            sess.ingest(cube[i], weights[i], label="subint%03d" % i)
+        solo.append(sess)
+    t_seq = time.perf_counter() - t0
+
+    # ---- multiplexed: one mux, round-robin burst, manual pump
+    mux = StreamMux(max_batch=max_batch)
+    msess = [mux.open("s%03d" % s, meta, cfg)
+             for s, (meta, _c, _w) in enumerate(streams)]
+    # warm every batch rung the burst will hit with throwaway lanes:
+    # each round pops chunks of max_batch heads plus one tail chunk
+    mb = mux.max_batch
+    full_rounds, tail = divmod(n_streams, mb)
+    warm_pops = set()
+    if full_rounds:
+        warm_pops.add(mb)
+    if tail:
+        warm_pops.add(tail)
+    warm_meta, warm_cube, warm_w = streams[0]
+    wi = 0
+    for size in sorted(warm_pops):
+        keys = []
+        for _ in range(size):
+            k = "_warm_%03d" % wi
+            wi += 1
+            mux.open(k, warm_meta, cfg)
+            mux.ingest(k, warm_cube[0], warm_w[0], label="warm")
+            keys.append(k)
+        mux.pump(force=True)
+        for k in keys:
+            mux.abandon_stream(k)
+    warm_dispatches = mux.dispatches
+
+    t0 = time.perf_counter()
+    for i in range(n_subints):
+        for s, (_meta, cube, weights) in enumerate(streams):
+            mux.ingest("s%03d" % s, cube[i], weights[i],
+                       label="subint%03d" % i, block=True)
+        mux.pump(force=True)
+    mux.drain()
+    t_mux = time.perf_counter() - t0
+
+    # ---- contracts
+    assert mux.recompiles_steady == 0, (
+        "mux recompiled %d time(s) in steady state (warm-up compiles: "
+        "%d)" % (mux.recompiles_steady, mux.warmup_compiles))
+    for s in range(n_streams):
+        a, b = msess[s], solo[s]
+        n = a.n_subints
+        assert n == b.n_subints == n_subints, (s, n, b.n_subints)
+        assert np.array_equal(a._pweights[:n], b._pweights[:n]), (
+            "mux provisional weights diverged from the independent "
+            "session on stream %d" % s)
+        assert np.array_equal(a._pscores[:n], b._pscores[:n],
+                              equal_nan=True), (
+            "mux provisional scores diverged from the independent "
+            "session on stream %d" % s)
+
+    total = n_streams * n_subints
+    rate = total / t_mux
+    speedup = t_seq / t_mux
+    lat = [lt for sess in msess for lt in sess.latencies_s]
+    p99 = percentile_ms(lat, 99.0)
+    occ_all = mux.batch_occupancies[warm_dispatches:]
+    occ = (sum(occ_all) / len(occ_all)) if occ_all else 0.0
+    _log(f"mux ({n_streams} streams x {n_subints} subints of "
+         f"{nchan}x{nbin}, max_batch {mb}): {rate:.1f} subints/s "
+         f"aggregate, {speedup:.1f}x vs sequential "
+         f"({t_mux:.2f}s vs {t_seq:.2f}s), p99 {p99:.1f} ms, "
+         f"occupancy {occ:.2f}, {mux.warmup_compiles} warm-up "
+         f"compiles, 0 steady")
+    return {
+        "mux_platform": jax.default_backend(),
+        "mux_n_streams": int(n_streams),
+        "mux_n_subints": int(total),
+        "mux_max_batch": int(mb),
+        "mux_aggregate_subints_per_s": round(rate, 2),
+        "mux_vs_sequential": round(speedup, 3),
+        "mux_subint_p99_ms": round(p99, 3),
+        "mux_batch_occupancy": round(occ, 4),
+        "mux_warmup_compiles": int(mux.warmup_compiles),
+        "mux_recompiles_steady": int(mux.recompiles_steady),
+        "mux_vs_sequential_masks": "identical",
+    }
+
+
 def bench_fused(nsub, nchan, nbin, max_iter=3, chunk=None):
     """Fused-sweep row (stats/pallas_kernels.py ``fused_sweep_pallas*``):
     the one-launch sweep (``--fused-sweep on``) against the multi-kernel
@@ -1706,6 +1853,7 @@ def main():
                            ("BENCH_FLEET_ONLY", bench_fleet),
                            ("BENCH_SERVE_ONLY", bench_serve),
                            ("BENCH_ONLINE_ONLY", bench_online),
+                           ("BENCH_MUX_ONLY", bench_mux),
                            ("BENCH_FUSED_ONLY", bench_fused),
                            ("BENCH_MULTIHOST_ONLY", bench_multihost),
                            ("BENCH_ELASTIC_ONLY", bench_elastic)):
@@ -1837,6 +1985,26 @@ def main():
          "reconcile_every": 4, "bucket_pad": 4 if small else 16},
         timeout=float(os.environ.get("BENCH_ONLINE_TIMEOUT", "600")),
         label="online")
+    if row:
+        extras = {**(extras or {}), **row}
+
+    # multiplexed online row (online/mux.py): a 100-stream synthetic
+    # burst through one shared StreamMux vs N independent sessions (the
+    # baseline shares one jitted step, so the ratio is pure batched-
+    # dispatch amortization).  Zero-steady-recompile and per-stream
+    # provisional-mask parity are enforced inside the stage — same
+    # killable-subprocess + parity-is-fatal contract as the rows above.
+    # max_batch 100 = one full-occupancy dispatch per burst round; at
+    # 64 the 100-stream round splits 64 + 36-padded-to-64 (occupancy
+    # 0.78) and the ratio drops below the >= 10x contract margin
+    mx_streams, mx_n, mx_geom, mx_batch = ((16, 4, (8, 32), 16) if small
+                                           else (100, 8, (8, 32), 100))
+    row = _bench_row_subprocess(
+        "BENCH_MUX_ONLY",
+        {"n_streams": mx_streams, "n_subints": mx_n,
+         "nchan": mx_geom[0], "nbin": mx_geom[1], "max_batch": mx_batch},
+        timeout=float(os.environ.get("BENCH_MUX_TIMEOUT", "600")),
+        label="mux")
     if row:
         extras = {**(extras or {}), **row}
 

@@ -233,22 +233,51 @@ def _batched_fn_program() -> ProgramReport:
 
 def _online_step_program() -> ProgramReport:
     import jax
-    import jax.numpy as jnp
 
-    from iterative_cleaner_tpu.online.chunks import StreamMeta
-    from iterative_cleaner_tpu.online.session import OnlineSession
+    from iterative_cleaner_tpu.online.step import (
+        build_subint_step,
+        subint_step_avals,
+    )
 
-    meta = StreamMeta(nchan=NCHAN, nbin=NBIN,
-                      freqs_mhz=tuple(1400.0 + i for i in range(NCHAN)),
-                      period_s=0.5, dm=10.0, centre_freq_mhz=1400.0)
-    session = OnlineSession(meta, _default_config())
-    step = session._build_step()
-    f32 = jnp.float32
-    avals = (jax.ShapeDtypeStruct((1, NCHAN, NBIN), f32),
-             jax.ShapeDtypeStruct((1, NCHAN), f32),
-             jax.ShapeDtypeStruct((NBIN,), f32),
-             jax.ShapeDtypeStruct((), jnp.int32))
-    return verify_fn("online_step", step, avals, max_eqns=1400)
+    step, dtype = build_subint_step(_default_config(), NCHAN, NBIN,
+                                    False, 0.0)
+    avals = subint_step_avals(NCHAN, NBIN, dtype)
+    return verify_fn("online_step", jax.jit(step), avals, max_eqns=1400)
+
+
+def _mux_step_program() -> ProgramReport:
+    """The multiplexer's batched per-subint step: the vmapped online
+    step at a representative rung.  Beyond the standard hot-program
+    contracts (callback-free, no f64, pinned equation ceiling), the
+    fused sweep's single-read budget must survive the vmap — the
+    batched kernel still reads its (now batch-folded) cube tile ref
+    exactly once."""
+    import jax
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.online.step import (
+        batched_step_avals,
+        build_subint_step,
+    )
+
+    # force the fused-sweep route (same knobs as _fused_sweep_program):
+    # the mux serves its hottest traffic through this program, and the
+    # single-read contract is only meaningful with the sweep in it
+    c = CleanConfig(backend="jax", dtype="float32", stats_impl="fused",
+                    fft_mode="dft", median_impl="pallas")
+    step, dtype = build_subint_step(c, NCHAN, NBIN, False, 0.0)
+    avals = batched_step_avals(BATCH, NCHAN, NBIN, dtype)
+    fn = jax.jit(jax.vmap(step))
+    report = verify_fn("mux_step", fn, avals, max_eqns=2000)
+    closed = jax.make_jaxpr(fn)(*avals)
+    reads = _count_cube_ref_reads(closed)
+    if reads != [1]:
+        report.violations.append(ContractViolation(
+            "mux_step", "single-cube-read",
+            f"batched sweep kernel read counts {reads}: vmapping the "
+            "step must fold the batch into the launch grid and read "
+            "the cube tile ref exactly once"))
+    return report
 
 
 def _count_cube_ref_reads(closed_jaxpr) -> List[int]:
@@ -363,6 +392,7 @@ HOT_PROGRAMS = (
     ("build_clean_fn", _clean_fn_program),
     ("build_batched_clean_fn", _batched_fn_program),
     ("online_step", _online_step_program),
+    ("mux_step", _mux_step_program),
     ("fused_sweep", _fused_sweep_program),
 )
 

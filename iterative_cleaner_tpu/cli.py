@@ -529,6 +529,39 @@ def build_parser() -> argparse.ArgumentParser:
                              "<0.1%%, growing with the final tile's "
                              "zero-weight padding fraction — prefer a "
                              "CHUNK near a divisor of the subint count).")
+    parser.add_argument("--mux", nargs="*", default=None, metavar="DIR",
+                        help="Multiplex many live streams through one "
+                             "batched device dispatch (online/mux.py): "
+                             "pending subints from concurrent streams "
+                             "coalesce on a bounded ring and run as one "
+                             "(B,nchan,nbin) fused-sweep step per tick, "
+                             "bucketed by quantized geometry — per-stream "
+                             "masks stay bit-equal with independent "
+                             "sessions. Bare --mux turns this on inside "
+                             "the --serve daemon (all kind:\"stream\" "
+                             "requests share the mux; mirrors ICLEAN_MUX). "
+                             "With one or more DIRs it runs the standalone "
+                             "driver: tail each chunk directory as an "
+                             "independent stream (the M-spool-dirs "
+                             "equivalent of --stream DIR) until every "
+                             "stream closes.")
+    parser.add_argument("--mux-max-wait-ms", "--mux_max_wait_ms",
+                        type=float, default=None, dest="mux_max_wait_ms",
+                        metavar="MS",
+                        help="Mux latency SLO: a pending subint never "
+                             "waits longer than MS before its bucket "
+                             "dispatches a partial batch (default: "
+                             "ICLEAN_MUX_MAX_WAIT_MS env var, else 5). "
+                             "0 dispatches every pending subint "
+                             "immediately.")
+    parser.add_argument("--mux-max-batch", "--mux_max_batch",
+                        type=int, default=None, dest="mux_max_batch",
+                        metavar="B",
+                        help="Largest multiplexed dispatch (and top AOT "
+                             "batch rung; default: ICLEAN_MUX_MAX_BATCH "
+                             "env var, else 64). Batches pad up the "
+                             "power-of-two rung ladder, so steady-state "
+                             "recompiles stay 0 at any arrival pattern.")
     parser.add_argument("--mesh", choices=("off", "cell", "batch"),
                         default="off",
                         help="Multi-device execution: 'cell' shards each "
@@ -1117,6 +1150,11 @@ def _run_serve(args, telemetry=None) -> int:
             # None = not passed (env/default applies); '' disables
             flight_recorder=args.flight_recorder,
             profile_dir=getattr(args, "profile_dir", "") or None,
+            # bare --mux (mux_on with no DIRs) multiplexes the daemon's
+            # live streams; absent defers to the ICLEAN_MUX mirror
+            mux=(True if args.mux_on else None),
+            mux_max_wait_ms=args.mux_max_wait_ms,
+            mux_max_batch=args.mux_max_batch,
         )
     except ValueError as exc:
         build_parser().error(f"--serve: {exc}")
@@ -1228,6 +1266,156 @@ def _run_stream(args, telemetry=None) -> int:
     return 0
 
 
+def _run_mux(args, telemetry=None) -> int:
+    """--mux DIR... driver: the multiplexed online mode for M live
+    streams on this host (no daemon).  Tails every DIR for chunk files
+    in sorted name order and funnels them all through ONE
+    :class:`~iterative_cleaner_tpu.online.StreamMux`, whose dispatcher
+    thread batches geometry-compatible subints from different streams
+    into a single fused-sweep device dispatch per tick (bounded ring +
+    latency SLO).  Each stream closes independently on its own
+    ``stream.close`` sentinel — or after ICLEAN_STREAM_IDLE_S seconds
+    (default 30) with no new chunks anywhere — and writes
+    ``DIR/stream_cleaned.npz``, bit-equal with an unmultiplexed
+    --stream run of the same chunks."""
+    import time as _time
+
+    from iterative_cleaner_tpu.online import (
+        CLOSE_SENTINEL,
+        StreamMux,
+        is_chunk_name,
+        load_chunk,
+        load_stream_meta,
+    )
+
+    cfg = config_from_args(args)
+    registry = telemetry.registry if telemetry is not None else None
+    dirs = []
+    keys = {}
+    for raw in args.mux_dirs:
+        d = os.path.abspath(raw)
+        if not os.path.isdir(d):
+            print("ERROR: --mux directory %s does not exist" % d,
+                  file=sys.stderr)
+            return 2
+        if d in keys.values():
+            continue  # the same directory twice is one stream
+        key = os.path.basename(d) or "stream"
+        if key in keys:
+            # stream ids label telemetry and the summary: keep them
+            # unique even when two spools share a base name
+            key = "%s-%d" % (key, len(keys))
+        keys[key] = d
+        dirs.append((key, d))
+    idle_s = float(os.environ.get("ICLEAN_STREAM_IDLE_S", "30"))
+    mux = StreamMux(max_batch=args.mux_max_batch,
+                    max_wait_ms=args.mux_max_wait_ms,
+                    registry=registry)
+    mux.start()
+    # per-stream tail state; a stream leaves `open_dirs` when its close
+    # sentinel appears or the whole tail goes idle
+    state = {key: {"dir": d, "seen": set(),
+                   # None until an archive-container chunk arrives
+                   "meta": load_stream_meta(d), "opened": False}
+             for key, d in dirs}
+    open_dirs = dict(dirs)
+    results = {}
+    failed = []
+    last_new = _time.monotonic()
+    try:
+        while open_dirs:
+            progressed = False
+            for key in list(open_dirs):
+                d = open_dirs[key]
+                st = state[key]
+                try:
+                    names = sorted(os.listdir(d))
+                except OSError as exc:
+                    print("ERROR: cannot list %s: %s" % (d, exc),
+                          file=sys.stderr)
+                    failed.append(key)
+                    del open_dirs[key]
+                    if st["opened"]:
+                        mux.abandon_stream(key)
+                    continue
+                for name in names:
+                    if name in st["seen"] or not is_chunk_name(name):
+                        continue
+                    path = os.path.join(d, name)
+                    st["seen"].add(name)  # never spin on a bad chunk
+                    try:
+                        data, weights, st["meta"] = load_chunk(
+                            path, st["meta"])
+                    except (OSError, ValueError) as exc:
+                        print("ERROR reading chunk %s/%s: %s"
+                              % (key, name, exc), file=sys.stderr)
+                        continue
+                    if not st["opened"]:
+                        mux.open(key, st["meta"], cfg,
+                                 profile=(True
+                                          if getattr(args, "profile_dir",
+                                                     "")
+                                          else None))
+                        st["opened"] = True
+                    # block=True: a full ring backpressures the tail
+                    # instead of dropping a chunk (the dispatcher
+                    # thread drains it)
+                    mux.ingest(key, data, weights, label=name,
+                               block=True)
+                    progressed = True
+                    if not args.quiet:
+                        n = (mux.session(key).n_subints
+                             + mux.pending(key))
+                        print("mux: %s subint %d <- %s"
+                              % (key, n, name), flush=True)
+                if CLOSE_SENTINEL in names and not progressed:
+                    del open_dirs[key]
+                    if not st["opened"]:
+                        print("ERROR: stream %s closed (sentinel) with "
+                              "no chunks ingested" % key, file=sys.stderr)
+                        failed.append(key)
+                        continue
+                    results[key] = mux.close_stream(key)
+            if progressed:
+                last_new = _time.monotonic()
+                continue  # drain everything present before idle checks
+            if open_dirs and _time.monotonic() - last_new >= idle_s:
+                # an interrupted producer still yields cleaned archives
+                for key in list(open_dirs):
+                    del open_dirs[key]
+                    if not state[key]["opened"]:
+                        print("ERROR: stream %s closed (idle) with no "
+                              "chunks ingested" % key, file=sys.stderr)
+                        failed.append(key)
+                        continue
+                    results[key] = mux.close_stream(key)
+                break
+            if open_dirs:
+                _time.sleep(0.05)
+    finally:
+        mux.stop()
+    for key, result in results.items():
+        out = os.path.join(keys[key], "stream_cleaned.npz")
+        ar_io.save_archive(result.archive, out)
+        if not args.quiet:
+            print("mux: %s closed after %d subints — p99 %.1f ms, "
+                  "%d reconciles, drift %d mid + %d final -> %s"
+                  % (key, result.n_subints, result.p99_ms(),
+                     result.reconciles, result.mask_drift,
+                     result.final_drift, out))
+    if not args.quiet and results:
+        occ = mux.occupancy_mean()
+        print("mux: %d stream%s, %d subints in %d dispatches "
+              "(occupancy %.2f), %d warm-up compile%s, %d steady "
+              "recompiles"
+              % (len(results), "" if len(results) == 1 else "s",
+                 mux.subints, mux.dispatches, occ,
+                 mux.warmup_compiles,
+                 "" if mux.warmup_compiles == 1 else "s",
+                 mux.recompiles_steady))
+    return 1 if failed or not results else 0
+
+
 def _parse_geometry_spec(spec: str):
     """'NSUBxNCHANxNBIN' -> (nsub, nchan, nbin) for --precompile arguments
     that are not paths; None when the string does not look like one."""
@@ -1322,11 +1510,16 @@ def main(argv=None) -> int:
         args.stream_dir = raw_stream
         args.stream = 0
 
+    # --mux is overloaded the same way: bare = daemon multiplexing,
+    # DIR arguments = the standalone multi-stream driver
+    args.mux_dirs = list(args.mux) if args.mux else []
+    args.mux_on = args.mux is not None
+
     # --selfcheck runs the analyzer and exits: no archives, no device,
     # no session — it must work on a box with no accelerator at all
     if args.selfcheck:
         if (args.archive or args.serve or args.fleet or args.stream_dir
-                or args.precompile or args.stream > 0):
+                or args.precompile or args.stream > 0 or args.mux_on):
             build_parser().error(
                 "--selfcheck analyzes the installed package and takes "
                 "no archives or run modes")
@@ -1386,6 +1579,11 @@ def main(argv=None) -> int:
             build_parser().error(
                 "--member-ttl tunes the --join membership lease; "
                 "pass --join")
+        if args.mux_dirs:
+            build_parser().error(
+                "--serve runs --mux bare (daemon streams arrive as "
+                "kind: \"stream\" requests); the DIR form is the "
+                "standalone driver — drop --serve or the directories")
     elif args.spool or args.http_port is not None \
             or args.max_inflight is not None or args.join \
             or args.member_ttl is not None or args.result_cache:
@@ -1394,10 +1592,16 @@ def main(argv=None) -> int:
         build_parser().error(
             "--spool/--http-port/--max-inflight/--join/--member-ttl/"
             "--result-cache configure the --serve daemon; pass --serve")
-    elif not args.archive and not args.stream_dir:
+    elif not args.archive and not args.stream_dir and not args.mux_dirs:
+        if args.mux_on:
+            build_parser().error(
+                "bare --mux multiplexes the --serve daemon's live "
+                "streams; pass --serve with it, or give --mux the "
+                "chunk directories to drive standalone")
         build_parser().error(
             "at least one archive path is required (or pass --serve, "
-            "or --stream DIR for the online mode)")
+            "--stream DIR for the online mode, or --mux DIR... for "
+            "multiplexed streams)")
     if args.resume and not args.journal:
         build_parser().error(
             "--resume needs an explicit --journal PATH: resuming against "
@@ -1564,14 +1768,14 @@ def main(argv=None) -> int:
                 "fixed-shape per-subint step is a compiled program)")
     if ((args.stream_reconcile_every is not None
          or args.stream_ew_alpha is not None)
-            and not (args.stream_dir or args.serve
+            and not (args.stream_dir or args.serve or args.mux_dirs
                      or args.model == "online_ewt")):
         # the online knobs only exist in the online session — a silently
         # ignored flag would mislead (same contract as --bucket-pad)
         build_parser().error(
             "--stream-reconcile-every/--stream-ew-alpha configure the "
-            "online mode; pass --stream DIR, --model online_ewt, or "
-            "--serve (whose stream requests inherit them)")
+            "online mode; pass --stream DIR, --mux DIR..., --model "
+            "online_ewt, or --serve (whose stream requests inherit them)")
     if args.stream > 0 and (args.batch > 1 or args.unload_res
                             or args.record_history or args.checkpoint
                             or args.model != "surgical_scrub"):
@@ -1581,6 +1785,51 @@ def main(argv=None) -> int:
             "(tiles do not gather residuals or histories; checkpoints are "
             "keyed to whole-archive cleaning). --mesh cell composes with "
             "either stream mode.")
+    if args.mux_dirs:
+        if args.archive:
+            build_parser().error(
+                "--mux DIR... (multiplexed online mode) takes no archive "
+                "arguments: the chunks in each DIR are the input")
+        if args.stream_dir:
+            build_parser().error(
+                "--stream DIR drives ONE live stream; --mux DIR... "
+                "multiplexes many — pass one mode, not both")
+        if (args.fleet or args.precompile or args.batch > 1
+                or args.prefetch > 0 or args.mesh != "off"
+                or args.unload_res or args.checkpoint
+                or args.record_history or args.stream > 0
+                or args.output or args.model != "surgical_scrub"):
+            build_parser().error(
+                "--mux DIR... (multiplexed online mode) is incompatible "
+                "with --fleet/--precompile/--batch/--prefetch/--mesh/"
+                "--unload_res/--checkpoint/--record_history/--stream/"
+                "-o/--model (live streams, cleaned with the flagship "
+                "strategy; each stream writes DIR/stream_cleaned.npz)")
+        if args.backend != "jax":
+            build_parser().error(
+                "--mux (multiplexed online mode) requires --backend jax "
+                "(the shared batched per-subint step is a compiled "
+                "program)")
+    elif args.mux_on and not args.serve:
+        build_parser().error(
+            "bare --mux multiplexes the --serve daemon's live streams; "
+            "pass --serve with it, or give --mux the chunk directories "
+            "to drive standalone")
+    if ((args.mux_max_wait_ms is not None or args.mux_max_batch is not None)
+            and not args.mux_on and not os.environ.get("ICLEAN_MUX")):
+        # the mux knobs only exist in the multiplexer — a silently
+        # ignored flag would mislead (same contract as --bucket-pad)
+        build_parser().error(
+            "--mux-max-wait-ms/--mux-max-batch tune the stream "
+            "multiplexer; pass --mux (bare under --serve, or with the "
+            "chunk directories)")
+    if args.mux_max_wait_ms is not None and args.mux_max_wait_ms < 0:
+        build_parser().error(
+            f"--mux-max-wait-ms must be >= 0 (0 dispatches immediately), "
+            f"got {args.mux_max_wait_ms}")
+    if args.mux_max_batch is not None and args.mux_max_batch < 1:
+        build_parser().error(
+            f"--mux-max-batch must be >= 1, got {args.mux_max_batch}")
 
     # Probe the default device before the first jax computation: a dead
     # accelerator tunnel otherwise hangs PJRT init forever.  Skipped when a
@@ -1621,6 +1870,8 @@ def main(argv=None) -> int:
             serve_rc = _run_serve(args, telemetry)
         elif args.stream_dir:
             serve_rc = _run_stream(args, telemetry)
+        elif args.mux_dirs:
+            serve_rc = _run_mux(args, telemetry)
         elif args.fleet:
             failed = _run_fleet(args, telemetry)
         elif args.batch > 1:
@@ -1645,7 +1896,7 @@ def main(argv=None) -> int:
                     print("ERROR cleaning %s: %s: %s"
                           % (in_path, type(exc).__name__, exc),
                           file=sys.stderr)
-    if args.serve or args.stream_dir:
+    if args.serve or args.stream_dir or args.mux_dirs:
         return serve_rc
     if failed:
         print("Failed %d/%d archives: %s"

@@ -351,6 +351,20 @@ class ServeConfig:
     # sessions' AOT cost capture (``--profile-dir`` /
     # ``ICLEAN_PROFILE_DIR``); None disables on-demand trace capture
     profile_dir: Optional[str] = None
+    # stream multiplexing (``--mux`` / ``ICLEAN_MUX``): route every
+    # kind:"stream" request through one shared StreamMux so concurrent
+    # streams' subints batch into one device dispatch per tick
+    # (online/mux.py); per-stream masks stay bit-equal with the
+    # per-request sessions this replaces, so — like every knob here —
+    # it must stay out of the config identity
+    mux: bool = False
+    # mux latency SLO: a pending subint never waits longer than this
+    # before its bucket dispatches a partial batch (``--mux-max-wait-ms``
+    # / ``ICLEAN_MUX_MAX_WAIT_MS``; None = online/mux.py default)
+    mux_max_wait_ms: Optional[float] = None
+    # largest batched dispatch / top AOT rung (``--mux-max-batch`` /
+    # ``ICLEAN_MUX_MAX_BATCH``; None = online/mux.py default)
+    mux_max_batch: Optional[int] = None
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
@@ -372,6 +386,9 @@ class ServeConfig:
             "member_ttl_s": env("ICLEAN_MEMBER_TTL", float, 15.0),
             "result_cache": env("ICLEAN_RESULT_CACHE", flag, False),
             "profile_dir": env("ICLEAN_PROFILE_DIR", str, None),
+            "mux": env("ICLEAN_MUX", flag, False),
+            "mux_max_wait_ms": env("ICLEAN_MUX_MAX_WAIT_MS", float, None),
+            "mux_max_batch": env("ICLEAN_MUX_MAX_BATCH", int, None),
         }
         # "" is a meaningful override here (recorder OFF), so resolve it
         # outside the none-filtered update below
@@ -411,3 +428,9 @@ class ServeConfig:
             raise ValueError(
                 f"member_ttl_s must be > 0 (the membership lease "
                 f"duration), got {self.member_ttl_s}")
+        if self.mux_max_wait_ms is not None and self.mux_max_wait_ms < 0:
+            raise ValueError(
+                f"mux_max_wait_ms must be >= 0, got {self.mux_max_wait_ms}")
+        if self.mux_max_batch is not None and self.mux_max_batch < 1:
+            raise ValueError(
+                f"mux_max_batch must be >= 1, got {self.mux_max_batch}")

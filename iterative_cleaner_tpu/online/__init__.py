@@ -20,6 +20,14 @@ cleaned as they arrive with bounded latency.  This package is that mode:
 ``model``      ``online_ewt`` — the registry-selectable provisional
                cleaner (the triage answer the live pipeline sees before
                reconciliation).
+``step``       the stateless per-subint step (stream meta as traced
+               arguments, not closure constants) shared by the solo
+               session, the mux's batched dispatch and the jaxpr
+               contracts.
+``mux``        :class:`StreamMux` — many live streams coalesced into
+               one batched fused-sweep dispatch per tick, bucketed by
+               quantized geometry, with a bounded SLO'd ring between
+               ingest and device (``--mux``).
 
 Wireups: ``--stream DIR`` in the CLI tails a chunk directory;
 ``kind: "stream"`` serve requests (``POST /stream/<id>/subint`` /
@@ -38,12 +46,21 @@ from iterative_cleaner_tpu.online.chunks import (  # noqa: F401
     load_stream_meta,
     save_stream_meta,
 )
+from iterative_cleaner_tpu.online.mux import (  # noqa: F401
+    DEFAULT_MUX_MAX_BATCH,
+    DEFAULT_MUX_MAX_WAIT_MS,
+    MuxRingFull,
+    StreamMux,
+    resolve_mux_max_batch,
+    resolve_mux_max_wait_ms,
+)
 from iterative_cleaner_tpu.online.session import (  # noqa: F401
     DEFAULT_EW_ALPHA,
     DEFAULT_NSUB_STEP,
     DEFAULT_RECONCILE_EVERY,
     OnlineResult,
     OnlineSession,
+    PendingSubint,
     resolve_ew_alpha,
     resolve_reconcile_every,
 )

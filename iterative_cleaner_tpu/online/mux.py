@@ -1,0 +1,584 @@
+"""Stream multiplexer: many live streams, one batched device dispatch.
+
+One :class:`~iterative_cleaner_tpu.online.session.OnlineSession` per
+stream is the right *state* model (each stream keeps its own EW
+template, capacity ring, provisional masks, reconcile schedule and
+QualityMonitor) but the wrong *dispatch* model: N concurrent streams
+cost N launches of a ``(1, nchan, nbin)`` program, and at service scale
+dispatch overhead and device idle dominate long before the hardware
+does.  :class:`StreamMux` keeps the per-stream sessions and replaces
+the dispatch:
+
+* **Geometry buckets.**  Streams are grouped the way
+  :func:`~iterative_cleaner_tpu.parallel.fleet.plan_fleet` buckets
+  archives: the channel count quantizes up the config's
+  ``--bucket-pad`` chan grid (extra channels ride along zero-weight at
+  the centre frequency — excluded from every statistic, exactly the
+  :func:`~iterative_cleaner_tpu.parallel.fleet.pad_archive_geometry`
+  contract), and the bucket key is
+  :func:`~iterative_cleaner_tpu.online.step.step_build_key` — the full
+  set of resolved knobs the traced program depends on, so every stream
+  in a bucket runs the *same* program on different data.
+
+* **One launch per tick per bucket.**  Ready subints stack into a
+  ``(B, 1, nchan, nbin)`` batch and run ``vmap`` of the PR 15 per-subint
+  step — the fused sweep's ``custom_vmap`` rule folds the batch into
+  the Pallas launch grid, so B streams cost one dispatch.  Per-stream
+  meta (frequency table, DM, period) and EW state (template, count)
+  ride the batch as arguments; the batch axis is data-parallel, so each
+  lane's provisional mask is bit-equal with a solo session's — a
+  contract enforced by tests and the bench parity assert, not a hope.
+
+* **Batch-size rungs, zero steady recompiles.**  Executables are
+  AOT-compiled per (bucket, rung) at the power-of-two ladder of
+  :func:`~iterative_cleaner_tpu.parallel.batch.batch_rungs`; a partial
+  batch pads up to the next rung with inert lanes (zero weights, so
+  ``wsum == 0`` keeps even the padded template update a no-op).  A
+  compile at an already-seen (bucket, rung) increments
+  ``mux_recompiles_steady`` — pinned 0 by bench and CI.
+
+* **Bifrost-style bounded ring with a latency SLO.**  Between ingest
+  and device sits a bounded ring of pending subints (Bifrost's
+  ring-buffer-between-ingest-and-compute pattern).  Bursty arrivals
+  coalesce into full batches, but a subint never waits past
+  ``--mux-max-wait-ms``: at the deadline the bucket dispatches
+  partially full.  Only stream *heads* join a batch — subint ``n+1``
+  consumes the template subint ``n`` produced, so one subint per stream
+  per dispatch is the dependency order, and it doubles as the
+  no-starvation rule: a chatty stream contributes one lane per tick no
+  matter how deep its backlog, and heads are taken oldest-first.
+
+Lock discipline (two locks, fixed order ``_dispatch_lock`` →
+``_lock``): ``_lock`` is a leaf guarding the stream table, pending
+deques and ring occupancy — held only around those reads/writes, never
+across a device call, session commit or journal append.
+``_dispatch_lock`` serializes whole dispatch cycles (select → device →
+commit) so per-stream commit order is the ingest order even when
+``pump`` races a draining ``close_stream``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.online.chunks import StreamMeta
+from iterative_cleaner_tpu.online.session import (
+    OnlineResult,
+    OnlineSession,
+    PendingSubint,
+)
+
+DEFAULT_MUX_MAX_WAIT_MS = 5.0
+DEFAULT_MUX_MAX_BATCH = 64
+# ring bound: how many pending subints (all streams together) may sit
+# between ingest and device before ingest blocks/rejects
+DEFAULT_MUX_RING_FACTOR = 16
+
+__all__ = ["StreamMux", "MuxRingFull", "resolve_mux_max_wait_ms",
+           "resolve_mux_max_batch", "DEFAULT_MUX_MAX_WAIT_MS",
+           "DEFAULT_MUX_MAX_BATCH"]
+
+
+def resolve_mux_max_wait_ms(value: Optional[float]) -> float:
+    """Explicit config value, else ICLEAN_MUX_MAX_WAIT_MS, else
+    :data:`DEFAULT_MUX_MAX_WAIT_MS`.  0 means dispatch every pending
+    subint immediately (batching only within one ingest burst)."""
+    if value is not None:
+        return float(value)
+    raw = os.environ.get("ICLEAN_MUX_MAX_WAIT_MS", "")
+    return float(raw) if raw else DEFAULT_MUX_MAX_WAIT_MS
+
+
+def resolve_mux_max_batch(value: Optional[int]) -> int:
+    """Explicit config value, else ICLEAN_MUX_MAX_BATCH, else
+    :data:`DEFAULT_MUX_MAX_BATCH`."""
+    if value is not None:
+        return int(value)
+    raw = os.environ.get("ICLEAN_MUX_MAX_BATCH", "")
+    return int(raw) if raw else DEFAULT_MUX_MAX_BATCH
+
+
+class MuxRingFull(RuntimeError):
+    """Non-blocking ingest found the ring at capacity (the daemon maps
+    this to an HTTP 429 — the journaled-ingest path blocks instead)."""
+
+
+@dataclasses.dataclass
+class _MuxStream:
+    """One multiplexed stream: its session plus the stacked-lane inputs
+    that never change (padded frequency table, scalar meta) and its
+    FIFO of pending subints."""
+
+    key: str
+    session: OnlineSession
+    bucket: tuple
+    nchan: int                 # true channel count (lane outputs slice to it)
+    freqs_q: np.ndarray        # (qchan,) dtype — padded at centre freq
+    dm: float
+    ref: float
+    period: float
+    # (arrival, pend): arrival is stamped by the mux's own clock, NOT
+    # pend.t0 — t0 is perf_counter for commit latency, and the SLO must
+    # use the injectable clock or deadline tests are non-deterministic
+    pending: Deque[Tuple[float, PendingSubint]] = dataclasses.field(
+        default_factory=collections.deque)
+    closing: bool = False
+    # heads popped by _select_batch but not yet committed back by
+    # _dispatch: drain must wait these out too, or close() races the
+    # in-flight commit (session cube vs counter torn mid-write)
+    inflight: int = 0
+
+
+@dataclasses.dataclass
+class _MuxBucket:
+    """One geometry/config bucket: what the AOT compile needs."""
+
+    key: tuple
+    config: CleanConfig        # representative (the key resolves identically)
+    qchan: int
+    nbin: int
+    dedispersed: bool
+    alpha: float
+
+
+class StreamMux:
+    """Multiplex many live streams through one batched per-subint
+    dispatch; see the module docstring for the design."""
+
+    def __init__(self, *, max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 ring_capacity: Optional[int] = None,
+                 registry=None, tracer=None, clock=None):
+        self.max_batch = resolve_mux_max_batch(max_batch)
+        if self.max_batch < 1:
+            raise ValueError("mux max_batch must be >= 1")
+        self.max_wait_ms = resolve_mux_max_wait_ms(max_wait_ms)
+        if self.max_wait_ms < 0:
+            raise ValueError("mux max_wait_ms must be >= 0")
+        self.ring_capacity = (int(ring_capacity) if ring_capacity
+                              else DEFAULT_MUX_RING_FACTOR * self.max_batch)
+        if self.ring_capacity < 1:
+            raise ValueError("mux ring_capacity must be >= 1")
+        self.registry = registry
+        self.tracer = tracer
+        self._clock = clock or time.monotonic
+        # _lock is a LEAF: held only around the stream table / deques /
+        # occupancy scalars below, never across a device call, session
+        # commit, journal append or any other lock
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._dispatch_lock = threading.Lock()
+        self._streams: Dict[str, _MuxStream] = {}
+        self._buckets: Dict[tuple, _MuxBucket] = {}
+        self._pending_total = 0
+        # AOT executables per (bucket key, batch rung) + the seen-key
+        # set behind the zero-steady-recompile contract
+        self._aot: Dict[tuple, object] = {}
+        self._seen_rungs = set()
+        self._stop_flag = False
+        self._thread: Optional[threading.Thread] = None
+        # accounting (bench/CI contract keys)
+        self.dispatches = 0
+        self.partial_dispatches = 0
+        self.subints = 0
+        self.warmup_compiles = 0
+        self.recompiles_steady = 0
+        self.batch_occupancies: List[float] = []
+
+    # ------------------------------------------------------------ streams
+    def open(self, key: str, meta: StreamMeta, config: CleanConfig, *,
+             reconcile_every: Optional[int] = None,
+             profile: Optional[bool] = None,
+             trace_id: Optional[str] = None,
+             parent_span_id: Optional[str] = None) -> OnlineSession:
+        """Register a stream.  The session is built exactly as the solo
+        path would (same knobs, same per-stream QualityMonitor labeled
+        with ``key`` — distinct labels keep per-stream drift series
+        independent), but its jit step is never compiled: the mux's
+        batched executable does every dispatch."""
+        import jax.numpy as jnp
+
+        from iterative_cleaner_tpu.online.step import step_build_key
+        from iterative_cleaner_tpu.parallel.fleet import quantize_geometry
+
+        session = OnlineSession(
+            meta, config, reconcile_every=reconcile_every,
+            registry=self.registry, tracer=self.tracer, trace_id=trace_id,
+            parent_span_id=parent_span_id, stream_id=key, profile=profile)
+        alpha = session.alpha
+        chan_step = int(config.fleet_bucket_pad[1])
+        qchan = quantize_geometry(1, meta.nchan, (0, chan_step))[1]
+        bucket = step_build_key(config, qchan, meta.nbin, meta.dedispersed,
+                                alpha)
+        dtype = jnp.dtype(config.dtype)
+        freqs_q = np.full((qchan,), float(meta.centre_freq_mhz), dtype)
+        freqs_q[:meta.nchan] = np.asarray(meta.freqs_mhz, dtype)
+        st = _MuxStream(
+            key=key, session=session, bucket=bucket, nchan=meta.nchan,
+            freqs_q=freqs_q, dm=float(meta.dm),
+            ref=float(meta.centre_freq_mhz), period=float(meta.period_s))
+        with self._lock:
+            if key in self._streams:
+                raise ValueError(f"stream {key!r} is already multiplexed")
+            self._streams[key] = st
+            if bucket not in self._buckets:
+                self._buckets[bucket] = _MuxBucket(
+                    key=bucket, config=config, qchan=qchan, nbin=meta.nbin,
+                    dedispersed=bool(meta.dedispersed), alpha=alpha)
+            n_streams = len(self._streams)
+        if self.registry is not None:
+            self.registry.gauge_set("mux_streams", n_streams)
+        return session
+
+    def session(self, key: str) -> OnlineSession:
+        with self._lock:
+            return self._streams[key].session
+
+    def streams(self) -> List[str]:
+        with self._lock:
+            return list(self._streams)
+
+    def pending(self, key: Optional[str] = None) -> int:
+        with self._lock:
+            if key is None:
+                return self._pending_total
+            return len(self._streams[key].pending)
+
+    # ------------------------------------------------------------- ingest
+    def ingest(self, key: str, data, weights=None, *, label: str = "",
+               block: bool = False, timeout_s: float = 30.0) -> int:
+        """Queue one chunk (``(nchan, nbin)`` or ``(k, nchan, nbin)``)
+        onto the ring.  With ``block=False`` a full ring raises
+        :class:`MuxRingFull`; with ``block=True`` ingest waits for the
+        dispatcher to drain space (journaled daemon ingest must apply
+        backpressure, never drop — the chunk is already durable).
+        Returns the stream's pending count."""
+        with self._lock:
+            st = self._streams[key]
+        data = np.asarray(data, dtype=np.float64)
+        if data.ndim == 2:
+            data = data[None]
+        if weights is None:
+            weights = np.ones(data.shape[:2], dtype=np.float64)
+        weights = np.asarray(weights, dtype=np.float64)
+        if weights.ndim == 1:
+            weights = weights[None]
+        if weights.shape != data.shape[:2]:
+            raise ValueError(
+                f"chunk weights shape {weights.shape} does not match data "
+                f"{data.shape[:2]}")
+        if data.shape[0] == 0:
+            return len(st.pending)
+        n = 0
+        for i in range(data.shape[0]):
+            self._reserve_slot(block=block, timeout_s=timeout_s)
+            try:
+                pend = st.session.begin_subint(data[i], weights[i],
+                                               label=label)
+            except BaseException:
+                with self._lock:
+                    self._pending_total -= 1
+                raise
+            with self._lock:
+                st.pending.append((self._clock(), pend))
+                n = self._pending_total
+                self._cond.notify_all()
+        if self.registry is not None:
+            self.registry.gauge_set("mux_pending", n)
+        return len(st.pending)
+
+    def _reserve_slot(self, *, block: bool, timeout_s: float) -> None:
+        deadline = self._clock() + timeout_s
+        with self._lock:
+            while self._pending_total >= self.ring_capacity:
+                if not block:
+                    raise MuxRingFull(
+                        f"mux ring at capacity ({self.ring_capacity} "
+                        f"pending subints)")
+                remaining = deadline - self._clock()
+                if remaining <= 0 or not self._cond.wait(
+                        timeout=min(remaining, 0.1)):
+                    if self._clock() >= deadline:
+                        raise MuxRingFull(
+                            f"mux ring still full after {timeout_s:.1f}s "
+                            f"of backpressure")
+            self._pending_total += 1
+
+    # ----------------------------------------------------------- dispatch
+    def pump(self, now: Optional[float] = None, force: bool = False) -> int:
+        """Run every due dispatch (full buckets, SLO-expired heads,
+        closing streams; everything when ``force``).  Returns the number
+        of batched dispatches performed.  The daemon's dispatcher thread
+        calls this in a loop; tests and the CLI/bench drivers call it
+        manually (injectable ``clock`` makes the SLO deterministic)."""
+        dispatched = 0
+        while True:
+            with self._dispatch_lock:
+                picked = self._select_batch(
+                    self._clock() if now is None else now, force)
+                if picked is None:
+                    break
+                self._dispatch(*picked)
+            dispatched += 1
+        return dispatched
+
+    def _select_batch(self, now: float, force: bool):
+        """Pick one due bucket and pop up to ``max_batch`` stream heads,
+        oldest first.  Called with ``_dispatch_lock`` held; takes the
+        leaf ``_lock`` only around the table walk and deque pops."""
+        wait_s = self.max_wait_ms / 1000.0
+        with self._lock:
+            ready: Dict[tuple, List[_MuxStream]] = {}
+            for st in self._streams.values():
+                if st.pending:
+                    ready.setdefault(st.bucket, []).append(st)
+            chosen = None
+            for bucket, sts in ready.items():
+                due = (force or len(sts) >= self.max_batch
+                       or any(s.closing for s in sts)
+                       or min(s.pending[0][0] for s in sts)
+                       <= now - wait_s)
+                if due:
+                    chosen = (bucket, sts)
+                    break
+            if chosen is None:
+                return None
+            bucket, sts = chosen
+            sts.sort(key=lambda s: s.pending[0][0])
+            lanes = [(s, s.pending.popleft()[1])
+                     for s in sts[:self.max_batch]]
+            for s, _pend in lanes:
+                s.inflight += 1
+            self._pending_total -= len(lanes)
+            self._cond.notify_all()
+        return self._buckets[bucket], lanes
+
+    def _executable(self, binfo: _MuxBucket, rung: int):
+        """The AOT-compiled vmapped step for one (bucket, rung).  A
+        compile for a key never seen is warm-up; a compile for a seen
+        key (memo evicted — should not happen) is a steady recompile,
+        the counter bench/CI pin to 0."""
+        memo_key = (binfo.key, rung)
+        with self._lock:
+            exe = self._aot.get(memo_key)
+        if exe is not None:
+            return exe
+        import jax
+
+        from iterative_cleaner_tpu.online.step import (
+            batched_step_avals,
+            build_subint_step,
+        )
+        from iterative_cleaner_tpu.telemetry import profiling
+
+        step, dtype = build_subint_step(binfo.config, binfo.qchan,
+                                        binfo.nbin, binfo.dedispersed,
+                                        binfo.alpha)
+        avals = batched_step_avals(rung, binfo.qchan, binfo.nbin, dtype)
+        t0 = time.perf_counter()
+        exe = jax.jit(jax.vmap(step)).lower(*avals).compile()
+        dt = time.perf_counter() - t0
+        with self._lock:
+            steady = memo_key in self._seen_rungs
+            if steady:
+                self.recompiles_steady += 1
+            else:
+                self._seen_rungs.add(memo_key)
+                self.warmup_compiles += 1
+            self._aot[memo_key] = exe
+        if self.registry is not None:
+            self.registry.counter_inc("mux_recompiles_steady" if steady
+                                      else "mux_warmup_compiles")
+        profiling.capture_compiled("mux_step", exe, registry=self.registry,
+                                   compile_s=dt)
+        return exe
+
+    def _dispatch(self, binfo: _MuxBucket, lanes) -> None:
+        """Stack the popped heads into one (rung, ...) batch, run the
+        bucket executable, commit each lane back to its session.  Called
+        with ``_dispatch_lock`` held and ``_lock`` NOT held — commits
+        may reconcile (a full batch clean) and must not stall ingest."""
+        import jax.numpy as jnp
+
+        from iterative_cleaner_tpu.parallel.batch import next_rung
+
+        b = len(lanes)
+        rung = next_rung(b, self.max_batch)
+        qc, nb = binfo.qchan, binfo.nbin
+        dtype = np.dtype(str(jnp.dtype(binfo.config.dtype)))
+        tiles = np.zeros((rung, 1, qc, nb), dtype)
+        ws = np.zeros((rung, 1, qc), dtype)
+        freqs = np.ones((rung, qc), dtype)
+        dms = np.zeros((rung,), dtype)
+        refs = np.ones((rung,), dtype)
+        periods = np.ones((rung,), dtype)
+        templates = np.zeros((rung, nb), dtype)
+        counts = np.zeros((rung,), np.int32)
+        for i, (st, pend) in enumerate(lanes):
+            nc = st.nchan
+            tiles[i, 0, :nc] = pend.tile
+            ws[i, 0, :nc] = pend.w_row
+            freqs[i] = st.freqs_q
+            dms[i] = st.dm
+            refs[i] = st.ref
+            periods[i] = st.period
+            templates[i] = np.asarray(st.session._template, dtype)
+            counts[i] = st.session._count
+        exe = self._executable(binfo, rung)
+        t0 = time.perf_counter()
+        new_w, scores, new_t, updated = exe(tiles, ws, freqs, dms, refs,
+                                            periods, templates, counts)
+        new_w = np.asarray(new_w)
+        scores = np.asarray(scores)
+        new_t = np.asarray(new_t)
+        updated = np.asarray(updated)
+        dt = time.perf_counter() - t0
+        for i, (st, pend) in enumerate(lanes):
+            nc = st.nchan
+            st.session.commit_subint(pend, new_w[i][:, :nc],
+                                     scores[i][:, :nc], new_t[i],
+                                     bool(updated[i]))
+        occupancy = b / float(rung)
+        with self._lock:
+            for st, _pend in lanes:
+                st.inflight -= 1
+            self.dispatches += 1
+            self.subints += b
+            self.partial_dispatches += int(b < self.max_batch)
+            self.batch_occupancies.append(occupancy)
+            many = self.dispatches > 1
+            self._cond.notify_all()
+        if self.registry is not None:
+            from iterative_cleaner_tpu.telemetry.quality import (
+                FRACTION_BUCKETS,
+            )
+            from iterative_cleaner_tpu.telemetry.registry import SECONDS
+
+            self.registry.counter_inc("mux_dispatches")
+            self.registry.counter_inc("mux_subints", b)
+            if b < self.max_batch:
+                self.registry.counter_inc("mux_partial_dispatches")
+            self.registry.histogram_observe("mux_batch_occupancy",
+                                            occupancy,
+                                            buckets=FRACTION_BUCKETS)
+            self.registry.histogram_observe("mux_dispatch_s", dt,
+                                            buckets=SECONDS)
+        if many:
+            from iterative_cleaner_tpu.telemetry import profiling
+
+            profiling.record_walltime("mux_step", dt,
+                                      registry=self.registry)
+
+    # -------------------------------------------------------- drain/close
+    def drain(self, key: Optional[str] = None, timeout_s: float = 60.0
+              ) -> None:
+        """Dispatch until ``key``'s (or every) pending queue is empty.
+        With a dispatcher thread running this waits for it (the closing
+        flag makes partial batches due immediately); without one it
+        pumps inline."""
+        deadline = self._clock() + timeout_s
+        while True:
+            with self._lock:
+                if key is None:
+                    empty = (self._pending_total == 0
+                             and all(st.inflight == 0
+                                     for st in self._streams.values()))
+                else:
+                    st = self._streams[key]
+                    empty = not st.pending and st.inflight == 0
+            if empty:
+                return
+            if self._thread is not None and self._thread.is_alive():
+                with self._lock:
+                    self._cond.notify_all()
+                time.sleep(0.002)
+            else:
+                self.pump(force=True)
+            if self._clock() > deadline:
+                raise TimeoutError(
+                    f"mux drain of {key or '<all>'} timed out after "
+                    f"{timeout_s:.0f}s")
+
+    def close_stream(self, key: str, timeout_s: float = 60.0
+                     ) -> OnlineResult:
+        """Drain a stream's pending subints (partial batches become due
+        immediately — closing never stalls the bucket's other streams)
+        and run the session's final close reconcile."""
+        with self._lock:
+            st = self._streams[key]
+            st.closing = True
+            self._cond.notify_all()
+        self.drain(key, timeout_s=timeout_s)
+        with self._lock:
+            st = self._streams.pop(key)
+            n_streams = len(self._streams)
+        if self.registry is not None:
+            self.registry.gauge_set("mux_streams", n_streams)
+        return st.session.close()
+
+    def abandon_stream(self, key: str) -> None:
+        """Drop a stream without closing its session (daemon shutdown:
+        the journal replays the stream on recovery)."""
+        with self._lock:
+            st = self._streams.pop(key, None)
+            if st is not None:
+                self._pending_total -= len(st.pending)
+                self._cond.notify_all()
+
+    # --------------------------------------------------------- dispatcher
+    def start(self) -> None:
+        """Start the background dispatcher (daemon mode).  Tests and the
+        CLI burst driver call :meth:`pump` manually instead."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop_flag = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="icln-mux-dispatch")
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        with self._lock:
+            self._stop_flag = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+
+    def _run(self) -> None:
+        wait_s = max(self.max_wait_ms / 1000.0, 0.001)
+        while True:
+            with self._lock:
+                if self._stop_flag:
+                    return
+            self.pump()
+            with self._lock:
+                if self._stop_flag:
+                    return
+                # sleep until the oldest head's SLO deadline (or an
+                # ingest/close notify), so a partial batch dispatches
+                # at most one scheduling quantum past the SLO
+                now = self._clock()
+                oldest = None
+                for st in self._streams.values():
+                    if st.pending:
+                        t0 = st.pending[0][0]
+                        oldest = t0 if oldest is None else min(oldest, t0)
+                if oldest is None:
+                    timeout = wait_s
+                else:
+                    timeout = max(0.001, oldest + wait_s - now)
+                self._cond.wait(timeout=min(timeout, wait_s))
+
+    # -------------------------------------------------------------- views
+    def occupancy_mean(self) -> float:
+        if not self.batch_occupancies:
+            return 0.0
+        return float(np.mean(self.batch_occupancies))

@@ -97,6 +97,22 @@ def _jit_cache_size(fn) -> int:
 
 
 @dataclasses.dataclass
+class PendingSubint:
+    """One staged subint between :meth:`OnlineSession.begin_subint` and
+    :meth:`OnlineSession.commit_subint`.  The solo ingest path commits
+    immediately; a :class:`~iterative_cleaner_tpu.online.mux.StreamMux`
+    parks these in its ring until the batched dispatch.  ``t0`` is the
+    begin-time clock, so the committed latency includes any ring wait —
+    exactly the number the mux SLO bounds."""
+
+    tile: np.ndarray       # (nchan, nbin) float64
+    w_row: np.ndarray      # (nchan,) float64
+    t0: float
+    span: object = None
+    label: str = ""
+
+
+@dataclasses.dataclass
 class OnlineResult:
     """What :meth:`OnlineSession.close` returns: the cleaned assembled
     archive plus the session's latency/compile/drift accounting."""
@@ -124,7 +140,8 @@ class OnlineSession:
                  tracer=None, trace_id: Optional[str] = None,
                  parent_span_id: Optional[str] = None,
                  stream_id: Optional[str] = None,
-                 profile: Optional[bool] = None):
+                 profile: Optional[bool] = None,
+                 step_fn=None):
         self.meta = meta
         self.config = config
         self.alpha = resolve_ew_alpha(config.stream_ew_alpha)
@@ -168,10 +185,16 @@ class OnlineSession:
         self._weights = None     # (cap, nchan) as ingested
         self._pweights = None    # (cap, nchan) provisional mask
         self._pscores = None     # (cap, nchan)
-        # device-side EW state + the one fixed-shape step program
+        # device-side EW state + the one fixed-shape step program.
+        # step_fn (optional) is a pre-jitted shared step with the
+        # online.step signature: N sessions of identical geometry and
+        # config can then share one compiled program (the bench's
+        # sequential baseline does this so it pays 1 compile, not N).
         self._template = None
         self._count = 0
         self._step = None
+        self._shared_step = step_fn
+        self._meta_args = None
         # accounting (the bench/CI contract keys)
         self.warmup_compiles = 0
         self.recompiles_steady = 0
@@ -225,95 +248,40 @@ class OnlineSession:
         self._pweights, self._pscores = pweights, pscores
         self._cap = cap
 
-    def _build_step(self):
-        import jax
+    def _init_device_state(self) -> None:
+        """dtype, the zero EW template and this stream's traced meta
+        arguments — everything a step caller (solo jit or a mux's
+        batched dispatch) needs before the first subint, without
+        compiling anything."""
+        if self._template is not None:
+            return
         import jax.numpy as jnp
 
-        from iterative_cleaner_tpu.backends.jax_backend import (
-            resolve_fft_mode,
-            resolve_fused_sweep,
-            resolve_median_impl,
-            resolve_stats_impl,
-        )
-        from iterative_cleaner_tpu.engine.loop import (
-            _pulse_window,
-            diagnostics_given_template,
-            prepare_cube_jax,
-        )
-        from iterative_cleaner_tpu.online.ewt import ew_update, subint_profile
-        from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
-
-        cfg = self.config
         meta = self.meta
-        dtype = jnp.dtype(cfg.dtype)
-        fft_mode = resolve_fft_mode(cfg.fft_mode, dtype)
-        median_impl = resolve_median_impl(cfg.median_impl, dtype)
-        alpha = float(self.alpha)
-        freqs = np.asarray(meta.freqs_mhz, dtype=dtype)
-        # One-launch SWEEP route for the provisional zap (the same fused
-        # tile step as the batch engine's fused route, at nsub=1): engages
-        # where the resolved --fused-sweep is on and the geometry gate
-        # admits a single-subint plane.  The provisional diagnostics then
-        # carry the fused route's DFT-flavoured rFFT magnitudes — a
-        # legitimate flavour change for a *provisional* mask (only the
-        # reconciles are contractual; they run the configured batch path
-        # unconditionally), and bit-equal to composing the fused cell
-        # kernel with scale_and_combine (tests/test_fused_sweep.py).
-        use_sweep = False
-        sweep_window = None
-        if dtype == jnp.float32:
-            from iterative_cleaner_tpu.stats.pallas_kernels import (
-                fused_sweep_eligible,
-                fused_sweep_pallas_dedisp,
-            )
-
-            stats_impl = resolve_stats_impl(cfg.stats_impl, dtype,
-                                            meta.nbin, fft_mode)
-            use_sweep = (
-                resolve_fused_sweep(cfg.fused_sweep, stats_impl) == "on"
-                and fused_sweep_eligible(1, meta.nchan, meta.nbin))
-        if use_sweep:
-            m = _pulse_window(meta.nbin, cfg.pulse_slice, cfg.pulse_scale,
-                              cfg.pulse_region_active, dtype)
-            sweep_window = jnp.ones((meta.nbin,), dtype) if m is None else m
-
-        def step(tile, w_row, template, count):
-            # cell-local preamble; always baseline_mode="profile" — the
-            # integration-mode consensus window needs the whole archive,
-            # which is exactly what a per-subint step cannot see.  The
-            # reconciles run the configured mode; only the provisional
-            # zap uses the per-profile window.
-            ded, _ = prepare_cube_jax(
-                tile, freqs, jnp.asarray(meta.dm, dtype),
-                jnp.asarray(meta.centre_freq_mhz, dtype),
-                jnp.asarray(meta.period_s, dtype),
-                baseline_duty=cfg.baseline_duty, rotation=cfg.rotation,
-                dedispersed=meta.dedispersed, baseline_mode="profile")
-            profile = subint_profile(ded, w_row, jnp)
-            wsum = jnp.sum(w_row)
-            updated = wsum > 0
-            new_template = jnp.where(
-                updated, ew_update(template, count, profile, alpha, jnp),
-                template)
-            cell_mask = w_row == 0
-            if use_sweep:
-                new_w, scores, _ = fused_sweep_pallas_dedisp(
-                    ded, new_template, sweep_window, w_row, cell_mask,
-                    float(cfg.chanthresh), float(cfg.subintthresh))
-            else:
-                diags = diagnostics_given_template(
-                    ded, None, new_template, w_row, cell_mask, None,
-                    pulse_slice=cfg.pulse_slice, pulse_scale=cfg.pulse_scale,
-                    pulse_active=cfg.pulse_region_active,
-                    rotation=cfg.rotation, fft_mode=fft_mode,
-                    stats_impl="xla", stats_frame="dedispersed")
-                scores = scale_and_combine(diags, cell_mask, cfg.chanthresh,
-                                           cfg.subintthresh, median_impl)
-                new_w = jnp.where(scores >= 1.0, 0.0, w_row)
-            return new_w, scores, new_template, updated
-
+        dtype = jnp.dtype(self.config.dtype)
         self._dtype = dtype
         self._template = jnp.zeros((meta.nbin,), dtype)
+        self._meta_args = (
+            jnp.asarray(np.asarray(meta.freqs_mhz), dtype),
+            jnp.asarray(meta.dm, dtype),
+            jnp.asarray(meta.centre_freq_mhz, dtype),
+            jnp.asarray(meta.period_s, dtype))
+
+    def _build_step(self):
+        # the step body lives in online/step.py (stream meta rides the
+        # arguments, not the closure) so this session, the mux's batched
+        # dispatch and the jaxpr contracts all trace the SAME program
+        import jax
+
+        from iterative_cleaner_tpu.online.step import (
+            build_subint_step,
+            subint_step_avals,
+        )
+
+        meta = self.meta
+        self._init_device_state()
+        step, dtype = build_subint_step(self.config, meta.nchan, meta.nbin,
+                                        meta.dedispersed, self.alpha)
         step_fn = jax.jit(step)
         if self._profile:
             # AOT-compile the same program once for its cost_analysis /
@@ -323,12 +291,7 @@ class OnlineSession:
             # the first real call is untouched)
             from iterative_cleaner_tpu.telemetry import profiling
 
-            avals = (
-                jax.ShapeDtypeStruct((1, meta.nchan, meta.nbin), dtype),
-                jax.ShapeDtypeStruct((1, meta.nchan), dtype),
-                jax.ShapeDtypeStruct((meta.nbin,), dtype),
-                jax.ShapeDtypeStruct((), jnp.int32),
-            )
+            avals = subint_step_avals(meta.nchan, meta.nbin, dtype)
             t0 = time.perf_counter()
             try:
                 compiled = step_fn.lower(*avals).compile()
@@ -371,32 +334,68 @@ class OnlineSession:
     def _ingest_one(self, tile, w_row, *, label: str = "") -> None:
         import jax.numpy as jnp
 
-        t0 = time.perf_counter()
+        pend = self.begin_subint(tile, w_row, label=label)
+        if self._step is None:
+            self._step = (self._shared_step if self._shared_step is not None
+                          else self._build_step())
+        before = _jit_cache_size(self._step)
+        new_w, scores, new_template, updated = self._step(
+            jnp.asarray(pend.tile[None], self._dtype),
+            jnp.asarray(pend.w_row[None], self._dtype),
+            *self._meta_args,
+            self._template, jnp.asarray(self._count, jnp.int32))
+        self._record_compiles(_jit_cache_size(self._step) - before,
+                              warmup=self._n == 0)
+        self.commit_subint(pend, new_w, scores, new_template, updated)
+
+    def begin_subint(self, tile, w_row, *, label: str = "") -> PendingSubint:
+        """Stage one subint without touching the capacity ring: validate
+        and f64-copy the tile, start the latency clock and tracer span.
+        The solo path runs the jit step and commits in the same call; a
+        StreamMux parks the pending entry in its ring and commits after
+        the batched dispatch.  Pending subints deliberately do NOT enter
+        ``self._cube`` — a staged row with live weight would join the
+        next reconcile's capacity cube and break bit-equality with the
+        solo ingest order."""
+        if self.closed:
+            raise RuntimeError("stream session is closed")
+        tile = np.asarray(tile, dtype=np.float64)
+        w_row = np.asarray(w_row, dtype=np.float64)
+        if tile.shape != (self.meta.nchan, self.meta.nbin):
+            raise ValueError(
+                f"subint shape {tile.shape} does not match stream geometry "
+                f"({self.meta.nchan}, {self.meta.nbin})")
+        if w_row.shape != (self.meta.nchan,):
+            raise ValueError(
+                f"subint weights shape {w_row.shape} does not match "
+                f"({self.meta.nchan},)")
         span = None
         if self.tracer is not None:
             span = self.tracer.start(
                 "subint", trace_id=self.trace_id,
                 parent_id=self.parent_span_id, subsystem="online",
                 subint=self._n, label=label)
+        self._init_device_state()
+        return PendingSubint(tile=tile, w_row=w_row,
+                             t0=time.perf_counter(), span=span, label=label)
+
+    def commit_subint(self, pend: PendingSubint, new_w, scores,
+                      new_template, updated) -> None:
+        """Land one stepped subint: capacity-ring write, EW template and
+        count advance, provisional mask, latency (now − begin time, so a
+        mux's ring wait is inside the SLO-bounded number), telemetry and
+        the reconcile schedule.  ``new_w``/``scores`` are the step's
+        ``(1, nchan)`` outputs (a mux passes one lane of its batch)."""
         if self._n >= self._cap:
             self._grow(self._n + 1)
-        self._cube[self._n] = tile
-        self._weights[self._n] = w_row
-        if self._step is None:
-            self._step = self._build_step()
-        before = _jit_cache_size(self._step)
-        new_w, scores, new_template, updated = self._step(
-            jnp.asarray(tile[None], self._dtype),
-            jnp.asarray(w_row[None], self._dtype),
-            self._template, jnp.asarray(self._count, jnp.int32))
-        self._record_compiles(_jit_cache_size(self._step) - before,
-                              warmup=self._n == 0)
+        self._cube[self._n] = pend.tile
+        self._weights[self._n] = pend.w_row
         self._template = new_template
         self._count += int(updated)
         self._pweights[self._n] = np.asarray(new_w[0], np.float64)
         self._pscores[self._n] = np.asarray(scores[0], np.float64)
         self._n += 1
-        dt = time.perf_counter() - t0
+        dt = time.perf_counter() - pend.t0
         self.latencies_s.append(dt)
         if self.registry is not None:
             from iterative_cleaner_tpu.telemetry.registry import SECONDS
@@ -416,10 +415,11 @@ class OnlineSession:
             self.quality.observe_subint(
                 self._pweights[self._n - 1],
                 template=np.asarray(self._template))
-        if span is not None:
-            span.set("nsub", self._n)
-            span.set("zapped", int(np.sum(self._pweights[self._n - 1] == 0)))
-            span.end()
+        if pend.span is not None:
+            pend.span.set("nsub", self._n)
+            pend.span.set("zapped",
+                          int(np.sum(self._pweights[self._n - 1] == 0)))
+            pend.span.end()
         if self.reconcile_every > 0 and self._n % self.reconcile_every == 0:
             self.reconcile()
 

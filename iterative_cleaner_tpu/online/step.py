@@ -1,0 +1,193 @@
+"""The stateless per-subint step: one pure function, three callers.
+
+PR 10's :class:`~iterative_cleaner_tpu.online.session.OnlineSession`
+built its per-subint program inline, closing over the stream's metadata
+(frequency table, DM, folding period) as trace constants.  That shape
+cannot multiplex: a batched step serving many streams must take the
+per-stream values as *arguments* so streams sharing one compiled
+program can differ in everything but geometry.  This module is the
+extraction: :func:`build_subint_step` returns a pure function
+
+    step(tile, w_row, freqs, dm, ref, period, template, count)
+      -> (new_weights, scores, new_template, updated)
+
+with NO stream state in the closure — only the resolved config knobs
+(thresholds, routes, EW alpha) and the geometry, which together form
+the compile key (:func:`step_build_key`).  Callers:
+
+* ``OnlineSession`` jit-wraps it per session (the solo path — warm-up
+  accounting unchanged);
+* :class:`~iterative_cleaner_tpu.online.mux.StreamMux` vmaps it over a
+  leading stream axis and AOT-compiles the batched form per bucket
+  rung — per-lane math is data-parallel, so each stream's provisional
+  mask is bit-equal with a solo session's;
+* the jaxpr contract suite traces both forms against the pinned
+  callback/f64/eqn-count ceilings.
+
+The math is byte-for-byte the session's original step: cell-local
+preamble (``baseline_mode="profile"`` — a per-subint step cannot see
+the integration-mode consensus window), in-graph EW template update,
+then either the PR 15 one-launch fused sweep (float32 + resolved
+``--fused-sweep`` on + geometry eligible at nsub=1) or the XLA
+diagnostics + scale/combine route.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+__all__ = ["build_subint_step", "step_build_key", "subint_step_avals",
+           "batched_step_avals"]
+
+
+def step_build_key(config, nchan: int, nbin: int, dedispersed: bool,
+                   alpha: float) -> Tuple:
+    """Everything that changes the traced per-subint program: resolved
+    route knobs + geometry + the EW alpha (a trace constant).  Streams
+    with equal keys share one compiled step — the mux's bucket axis."""
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fft_mode,
+        resolve_fused_sweep,
+        resolve_median_impl,
+        resolve_stats_impl,
+    )
+
+    dtype = jnp.dtype(config.dtype)
+    fft_mode = resolve_fft_mode(config.fft_mode, dtype)
+    stats_impl = resolve_stats_impl(config.stats_impl, dtype, nbin,
+                                    fft_mode)
+    return (
+        int(nchan), int(nbin), bool(dedispersed), str(dtype), fft_mode,
+        resolve_median_impl(config.median_impl, dtype), stats_impl,
+        resolve_fused_sweep(config.fused_sweep, stats_impl),
+        float(config.chanthresh), float(config.subintthresh),
+        float(config.baseline_duty), config.rotation,
+        tuple(config.pulse_slice) if config.pulse_slice else None,
+        config.pulse_scale, bool(config.pulse_region_active),
+        float(alpha),
+    )
+
+
+def build_subint_step(config, nchan: int, nbin: int, dedispersed: bool,
+                      alpha: float):
+    """Build the pure per-subint step for one (config, geometry) bucket.
+
+    Returns ``(step, dtype)``: ``step`` is an un-jitted pure function of
+    ``(tile (1,nchan,nbin), w_row (1,nchan), freqs (nchan,), dm (),
+    ref (), period (), template (nbin,), count () int32)`` returning
+    ``(new_w (1,nchan), scores (1,nchan), new_template (nbin,),
+    updated () bool)``.  Stream identity rides the arguments; the
+    closure holds only resolved knobs, so one compiled program serves
+    every stream in the bucket."""
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fft_mode,
+        resolve_fused_sweep,
+        resolve_median_impl,
+        resolve_stats_impl,
+    )
+    from iterative_cleaner_tpu.engine.loop import (
+        _pulse_window,
+        diagnostics_given_template,
+        prepare_cube_jax,
+    )
+    from iterative_cleaner_tpu.online.ewt import ew_update, subint_profile
+    from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
+
+    cfg = config
+    dtype = jnp.dtype(cfg.dtype)
+    fft_mode = resolve_fft_mode(cfg.fft_mode, dtype)
+    median_impl = resolve_median_impl(cfg.median_impl, dtype)
+    alpha = float(alpha)
+    # One-launch SWEEP route for the provisional zap (the same fused
+    # tile step as the batch engine's fused route, at nsub=1): engages
+    # where the resolved --fused-sweep is on and the geometry gate
+    # admits a single-subint plane.  The provisional diagnostics then
+    # carry the fused route's DFT-flavoured rFFT magnitudes — a
+    # legitimate flavour change for a *provisional* mask (only the
+    # reconciles are contractual; they run the configured batch path
+    # unconditionally), and bit-equal to composing the fused cell
+    # kernel with scale_and_combine (tests/test_fused_sweep.py).
+    use_sweep = False
+    sweep_window = None
+    if dtype == jnp.float32:
+        from iterative_cleaner_tpu.stats.pallas_kernels import (
+            fused_sweep_eligible,
+            fused_sweep_pallas_dedisp,
+        )
+
+        stats_impl = resolve_stats_impl(cfg.stats_impl, dtype, nbin,
+                                        fft_mode)
+        use_sweep = (
+            resolve_fused_sweep(cfg.fused_sweep, stats_impl) == "on"
+            and fused_sweep_eligible(1, nchan, nbin))
+    if use_sweep:
+        m = _pulse_window(nbin, cfg.pulse_slice, cfg.pulse_scale,
+                          cfg.pulse_region_active, dtype)
+        sweep_window = jnp.ones((nbin,), dtype) if m is None else m
+
+    def step(tile, w_row, freqs, dm, ref, period, template, count):
+        # cell-local preamble; always baseline_mode="profile" — the
+        # integration-mode consensus window needs the whole archive,
+        # which is exactly what a per-subint step cannot see.  The
+        # reconciles run the configured mode; only the provisional
+        # zap uses the per-profile window.
+        ded, _ = prepare_cube_jax(
+            tile, freqs, dm, ref, period,
+            baseline_duty=cfg.baseline_duty, rotation=cfg.rotation,
+            dedispersed=dedispersed, baseline_mode="profile")
+        profile = subint_profile(ded, w_row, jnp)
+        wsum = jnp.sum(w_row)
+        updated = wsum > 0
+        new_template = jnp.where(
+            updated, ew_update(template, count, profile, alpha, jnp),
+            template)
+        cell_mask = w_row == 0
+        if use_sweep:
+            new_w, scores, _ = fused_sweep_pallas_dedisp(
+                ded, new_template, sweep_window, w_row, cell_mask,
+                float(cfg.chanthresh), float(cfg.subintthresh))
+        else:
+            diags = diagnostics_given_template(
+                ded, None, new_template, w_row, cell_mask, None,
+                pulse_slice=cfg.pulse_slice, pulse_scale=cfg.pulse_scale,
+                pulse_active=cfg.pulse_region_active,
+                rotation=cfg.rotation, fft_mode=fft_mode,
+                stats_impl="xla", stats_frame="dedispersed")
+            scores = scale_and_combine(diags, cell_mask, cfg.chanthresh,
+                                       cfg.subintthresh, median_impl)
+            new_w = jnp.where(scores >= 1.0, 0.0, w_row)
+        return new_w, scores, new_template, updated
+
+    return step, dtype
+
+
+def subint_step_avals(nchan: int, nbin: int, dtype):
+    """Abstract inputs of the solo (unbatched) step, for AOT lowering
+    and the jaxpr contracts."""
+    import jax
+    import jax.numpy as jnp
+
+    return (
+        jax.ShapeDtypeStruct((1, nchan, nbin), dtype),
+        jax.ShapeDtypeStruct((1, nchan), dtype),
+        jax.ShapeDtypeStruct((nchan,), dtype),
+        jax.ShapeDtypeStruct((), dtype),
+        jax.ShapeDtypeStruct((), dtype),
+        jax.ShapeDtypeStruct((), dtype),
+        jax.ShapeDtypeStruct((nbin,), dtype),
+        jax.ShapeDtypeStruct((), jnp.int32),
+    )
+
+
+def batched_step_avals(batch: int, nchan: int, nbin: int, dtype):
+    """Abstract inputs of the vmapped step at batch rung ``batch`` —
+    every solo aval with a leading stream axis."""
+    import jax
+
+    return tuple(
+        jax.ShapeDtypeStruct((batch,) + a.shape, a.dtype)
+        for a in subint_step_avals(nchan, nbin, dtype))

@@ -256,6 +256,32 @@ def batch_abstract_inputs(batch_dim: int, nsub: int, nchan: int, nbin: int,
         for s, spec in zip(shapes, specs))
 
 
+def batch_rungs(max_batch: int) -> Tuple[int, ...]:
+    """The AOT batch-size ladder for shape-polymorphic callers (the
+    stream mux): powers of two up to ``max_batch``, topped by
+    ``max_batch`` itself.  A partial batch pads up to the next rung, so
+    the set of compiled batch shapes is O(log max_batch) — steady-state
+    dispatches never meet a new shape and recompiles stay 0."""
+    if max_batch < 1:
+        raise ValueError("max_batch must be >= 1")
+    rungs: List[int] = []
+    b = 1
+    while b < int(max_batch):
+        rungs.append(b)
+        b *= 2
+    rungs.append(int(max_batch))
+    return tuple(rungs)
+
+
+def next_rung(n: int, max_batch: int) -> int:
+    """Smallest :func:`batch_rungs` rung >= ``n`` (callers never exceed
+    ``max_batch``, the top rung)."""
+    for r in batch_rungs(max_batch):
+        if r >= n:
+            return r
+    raise ValueError(f"batch of {n} exceeds max_batch={max_batch}")
+
+
 # AOT executable memo: (resolved build args, geometry, batch dim, mesh,
 # donation) -> the jax Compiled object.  `jit(...).lower().compile()` does
 # NOT populate the jit wrapper's per-shape cache, so precompiled programs

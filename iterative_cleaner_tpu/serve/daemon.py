@@ -215,6 +215,19 @@ class ServeDaemon:
         # open online streams by request id (kind: "stream"); entries
         # leave at finalize (worker pop after close) or terminal failure
         self._streams: Dict[str, _StreamState] = {}
+        # stream multiplexer (--mux): all kind:"stream" requests share
+        # one StreamMux — concurrent streams' subints coalesce into one
+        # batched dispatch per tick instead of one launch per stream.
+        # Journal/dedup/replay semantics are untouched: the mux sits
+        # strictly between the (already journaled) ingest and the device
+        self.mux = None
+        if serve_config.mux:
+            from iterative_cleaner_tpu.online.mux import StreamMux
+
+            self.mux = StreamMux(
+                max_batch=serve_config.mux_max_batch,
+                max_wait_ms=serve_config.mux_max_wait_ms,
+                registry=self.registry, tracer=self.tracer)
         # POST /profile serialization: jax.profiler supports one trace at
         # a time, so a second capture while one runs is a 409, not a queue
         self._profile_lock = threading.Lock()
@@ -593,6 +606,16 @@ class ServeDaemon:
             members = {"n": 1,
                        "self": "draining" if draining else "standalone",
                        "id": None, "evicted": 0}
+        mux = None
+        if self.mux is not None:
+            mux = {
+                "streams": len(self.mux.streams()),
+                "pending": self.mux.pending(),
+                "dispatches": self.mux.dispatches,
+                "max_batch": self.mux.max_batch,
+                "max_wait_ms": self.mux.max_wait_ms,
+                "recompiles_steady": self.mux.recompiles_steady,
+            }
         return {
             "status": "draining" if draining else "ok",
             "draining": draining,
@@ -601,6 +624,7 @@ class ServeDaemon:
             "queued": self.scheduler.depth(),
             "running": self._running_id,
             "streams": len(self._streams),
+            "mux": mux,
             "members": members,
             # age of this process's last journal fold: None before the
             # first fold, else how far behind the shared state the
@@ -930,12 +954,29 @@ class ServeDaemon:
                 f"chunk {os.path.basename(chunk_path)!r}: {exc}") from exc
         if st.session is None:
             cfg = st.req.effective_config(self.base_config)
-            st.session = OnlineSession(
-                meta, cfg, registry=self.registry, tracer=self.tracer,
-                trace_id=st.req.trace_id,
-                parent_span_id=st.req.root_span_id,
-                stream_id=st.req.request_id,
-                profile=(True if self.serve_config.profile_dir else None))
+            if self.mux is not None:
+                st.session = self.mux.open(
+                    st.req.request_id, meta, cfg,
+                    trace_id=st.req.trace_id,
+                    parent_span_id=st.req.root_span_id,
+                    profile=(True if self.serve_config.profile_dir
+                             else None))
+            else:
+                st.session = OnlineSession(
+                    meta, cfg, registry=self.registry, tracer=self.tracer,
+                    trace_id=st.req.trace_id,
+                    parent_span_id=st.req.root_span_id,
+                    stream_id=st.req.request_id,
+                    profile=(True if self.serve_config.profile_dir
+                             else None))
+        if self.mux is not None:
+            # journaled ingest never drops: a full ring applies
+            # backpressure (the HTTP response waits) instead of 429ing
+            # a chunk the journal already recorded
+            self.mux.ingest(st.req.request_id, data, weights,
+                            label=os.path.basename(chunk_path), block=True)
+            return st.session.n_subints + self.mux.pending(
+                st.req.request_id)
         return st.session.ingest(
             data, weights, label=os.path.basename(chunk_path))
 
@@ -966,10 +1007,18 @@ class ServeDaemon:
                 raise RequestError(
                     f"stream {req.request_id!r} reached the worker with "
                     f"no ingested subints")
-            result = st.session.close()
+            if self.mux is not None:
+                # drain the stream's pending subints (partial batches
+                # become due immediately) then close — the mux returns
+                # the same OnlineResult the solo session would
+                result = self.mux.close_stream(req.request_id)
+            else:
+                result = st.session.close()
             out = self._stream_out_path(req, st)
             ar_io.save_archive(result.archive, out)
         except Exception as exc:
+            if self.mux is not None:
+                self.mux.abandon_stream(req.request_id)
             dt = time.perf_counter() - t0
             span.event("error", type=type(exc).__name__,
                        message=str(exc)[:200])
@@ -1045,6 +1094,8 @@ class ServeDaemon:
         except (RequestError, Rejection) as exc:
             with self._state_lock:
                 self._streams.pop(rid, None)
+            if self.mux is not None:
+                self.mux.abandon_stream(rid)
             self.scheduler.mark_done(req)
             self._close_root_span(req, "failed")
             self.journal.record_request(
@@ -1053,6 +1104,11 @@ class ServeDaemon:
             return 0
         st.keys = set(str(k) for k in (view.get("keys") or [])) \
             or set(st.chunks)
+        if self.mux is not None and st.session is not None:
+            # replayed subints must be committed (not pending) before
+            # the replay counter reads n_subints — and recovery may run
+            # before the dispatcher thread starts
+            self.mux.drain(rid)
         if st.session is not None:
             self.registry.counter_inc("online_replayed_subints",
                                       st.session.n_subints)
@@ -1180,6 +1236,13 @@ class ServeDaemon:
             print("serve: joined pool as %s (member ttl %.1fs)"
                   % (self.membership.member_id,
                      self.serve_config.member_ttl_s), flush=True)
+        if self.mux is not None:
+            # dispatcher up BEFORE recovery: replayed chunks flow through
+            # the same ring, and a blocked (backpressured) replay needs a
+            # consumer
+            self.mux.start()
+            print("serve: stream mux on (max batch %d, SLO %.1fms)"
+                  % (self.mux.max_batch, self.mux.max_wait_ms), flush=True)
         self.recover()
         if self.serve_config.http_port is not None:
             from iterative_cleaner_tpu.serve.http import (
@@ -1245,6 +1308,11 @@ class ServeDaemon:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+        if self.mux is not None:
+            # stop dispatching; open streams stay journaled and replay on
+            # the next start (the same abandoned-stream contract as the
+            # per-session path)
+            self.mux.stop()
         if self.membership is not None:
             # leave BEFORE compacting: the roster forgets a drained
             # member immediately (never "evicted") and the compaction

@@ -9,10 +9,19 @@ proxy owns TLS/authn, exactly like node_exporter's model).  Endpoints::
                         503 while draining, 400 malformed
     POST /stream/<id>/subint  {"path": "/data/chunk0.npy", "seq": 0}
                         -> 200 {"ingested": true} | {"duplicate": true};
-                        404 unknown stream, 400 bad chunk
+                        404 unknown stream, 400 bad chunk.  Under --mux
+                        the subint lands on the shared multiplexer ring
+                        (a full ring backpressures the response instead
+                        of dropping a journaled chunk) and is batched
+                        with other live streams' subints into one
+                        device dispatch
     POST /stream/<id>/close   -> 200 {"closed": true}; the stream queues
-                        for close reconciliation + output write
-    GET  /healthz       200 {"status": "ok" | "draining", ...counts}
+                        for close reconciliation + output write (under
+                        --mux the worker drains the stream's pending
+                        ring entries first)
+    GET  /healthz       200 {"status": "ok" | "draining", ...counts;
+                        "mux": {streams, pending, dispatches, ...} when
+                        --mux is on, else null}
     GET  /requests      200 {"n": ..., "requests": [{id, state, kind,
                         tenant}, ...]} — the journaled request index
     GET  /requests/<id> 200 {"state": ...} from the journaled lifecycle

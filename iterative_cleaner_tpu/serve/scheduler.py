@@ -24,6 +24,16 @@ member, so a greedy tenant cannot multiply its cap by spraying
 submissions at each member's front door.  A failing pool view falls
 back to the local count — admission degrades to per-host fairness,
 it never wedges intake.
+
+``kind: "stream"`` requests pass admission here (``submit`` with
+``enqueue=False`` — the per-tenant cap counts an OPEN stream as one
+in-flight unit for its whole lifetime) but their per-subint flow is
+not this scheduler's: subints go straight to the stream's session, or
+under ``--mux`` onto the shared multiplexer ring, whose bounded
+capacity + latency SLO is a second, finer backpressure boundary
+(:mod:`iterative_cleaner_tpu.online.mux`).  Only the close
+reconciliation re-enters the queue (``enqueue_admitted``) to compete
+with batch work for the single device worker.
 """
 
 from __future__ import annotations

@@ -73,6 +73,9 @@ TRACKED: Dict[str, Track] = {
                                          "streaming_platform"),
     "fused_vs_unfused": Track("higher", 0.30, "fused_platform"),
     "online_subint_p99_ms": Track("lower", 0.50, "online_platform"),
+    "mux_vs_sequential": Track("higher", 0.30, "mux_platform"),
+    "mux_aggregate_subints_per_s": Track("higher", 0.35, "mux_platform"),
+    "mux_subint_p99_ms": Track("lower", 0.50, "mux_platform"),
 }
 
 

@@ -662,7 +662,7 @@ def test_selfcheck_cli_repo_wide_gate():
     assert doc["findings"] == []
     programs = {r["program"]: r for r in doc["jaxpr"]}
     assert set(programs) == {"build_clean_fn", "build_batched_clean_fn",
-                             "online_step", "fused_sweep"}
+                             "online_step", "mux_step", "fused_sweep"}
     for rep in programs.values():
         assert rep["violations"] == []
     # donation is realized on the CPU lowering for both donating builders

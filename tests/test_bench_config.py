@@ -145,6 +145,24 @@ def test_bench_small_end_to_end_json_schema():
     assert out["online_recompiles_steady"] == 0
     assert out["online_warmup_compiles"] >= 1
     assert out["online_vs_batch_masks"] == "identical"
+    # mux row (online/mux.py): the shared-dispatch multiplexer's burst
+    # keys — the zero-steady-recompile contract and per-stream
+    # provisional-mask parity are rc-7-fatal inside the stage, so
+    # reaching here means both held
+    for key in ("mux_n_streams", "mux_n_subints", "mux_max_batch",
+                "mux_platform", "mux_aggregate_subints_per_s",
+                "mux_vs_sequential", "mux_subint_p99_ms",
+                "mux_batch_occupancy", "mux_warmup_compiles",
+                "mux_recompiles_steady", "mux_vs_sequential_masks"):
+        assert key in out, (key, err)
+    assert out["mux_n_streams"] >= 8
+    assert out["mux_aggregate_subints_per_s"] > 0
+    assert out["mux_vs_sequential"] > 0
+    assert out["mux_subint_p99_ms"] > 0
+    assert 0 < out["mux_batch_occupancy"] <= 1.0
+    assert out["mux_recompiles_steady"] == 0
+    assert out["mux_warmup_compiles"] >= 1
+    assert out["mux_vs_sequential_masks"] == "identical"
     # fused-sweep row: warm best-of-2 timing plus the deterministic
     # contracts (strict program shrink, strict streaming-H2D shrink, and
     # the single-read cube budget — each rc-7 fatal inside the stage, so
@@ -218,6 +236,30 @@ def test_bench_elastic_row_keys():
     assert out["serve_failover_s"] > 0
     assert out["cache_hits"] >= 1
     assert out["cache_hit_vs_clean"] > 0
+
+
+@pytest.mark.slow
+def test_bench_mux_row_keys():
+    """The full mux row (100-stream burst through one StreamMux) in
+    isolation: the >= 10x aggregate-throughput contract vs N independent
+    sessions holds on the CPU row, with zero steady recompiles and
+    full-rung occupancy.  Per-stream provisional-mask parity is
+    rc-7-fatal inside the stage."""
+    import json
+
+    proc = _run_repo_script("bench.py", extra_env=(
+        ("BENCH_MUX_ONLY", json.dumps(
+            {"n_streams": 100, "n_subints": 8, "nchan": 8, "nbin": 32,
+             "max_batch": 100})),))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    err = proc.stderr[-3000:]
+    assert out["mux_n_streams"] == 100
+    assert out["mux_n_subints"] == 800
+    assert out["mux_recompiles_steady"] == 0, err
+    assert out["mux_batch_occupancy"] == 1.0
+    assert out["mux_vs_sequential"] >= 10.0, (out, err)
+    assert out["mux_vs_sequential_masks"] == "identical"
 
 
 def test_profile_stages_small_end_to_end():

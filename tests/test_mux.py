@@ -1,0 +1,256 @@
+"""Stream multiplexer (online/mux.py): ring/SLO semantics, the
+head-of-line no-starvation rule, close-drain behaviour, bit-equality
+with solo sessions across batch rungs, and per-stream quality-drift
+independence when many streams share one registry.
+
+The SLO tests inject a fake clock — the mux stamps ring arrival with
+its own (injectable) clock precisely so deadline behaviour is
+deterministic under test.
+"""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io import make_synthetic_archive
+from iterative_cleaner_tpu.online import OnlineSession, StreamMeta
+from iterative_cleaner_tpu.online.mux import MuxRingFull, StreamMux
+from iterative_cleaner_tpu.parallel.batch import batch_rungs, next_rung
+from iterative_cleaner_tpu.telemetry.registry import (
+    MetricsRegistry,
+    labeled,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+def _cfg(**kw):
+    kw.setdefault("backend", "jax")
+    kw.setdefault("max_iter", 2)
+    # mid-stream reconciles are the session's own concern (covered by
+    # test_online); here they would only slow the parity sweeps down
+    kw.setdefault("stream_reconcile_every", 0)
+    return CleanConfig(**kw)
+
+
+def _stream(nsub=4, nchan=8, nbin=16, seed=7):
+    ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                   seed=seed)
+    cube = np.asarray(ar.total_intensity(), dtype=np.float64)
+    return StreamMeta.from_archive(ar), cube
+
+
+# ------------------------------------------------------------- rung ladder
+
+def test_batch_rung_ladder_and_next_rung():
+    assert batch_rungs(1) == (1,)
+    assert batch_rungs(8) == (1, 2, 4, 8)
+    # a non-power-of-two cap tops the ladder as its own rung
+    assert batch_rungs(100) == (1, 2, 4, 8, 16, 32, 64, 100)
+    for n in range(1, 9):
+        r = next_rung(n, 8)
+        assert r >= n and r in batch_rungs(8)
+    assert next_rung(65, 100) == 100
+    with pytest.raises(ValueError):
+        next_rung(9, 8)
+    with pytest.raises(ValueError):
+        batch_rungs(0)
+
+
+# ------------------------------------------------------------ SLO / ring
+
+def test_partial_batch_dispatches_at_slo_deadline():
+    clock = FakeClock()
+    mux = StreamMux(max_batch=4, max_wait_ms=50.0, clock=clock)
+    meta, cube = _stream()
+    mux.open("a", meta, _cfg())
+    mux.ingest("a", cube[0])
+    # a lone head is not due before the deadline...
+    assert mux.pump() == 0
+    clock.advance(0.049)
+    assert mux.pump() == 0
+    assert mux.session("a").n_subints == 0
+    # ...and goes out partial the moment the SLO expires
+    clock.advance(0.002)
+    assert mux.pump() == 1
+    assert mux.session("a").n_subints == 1
+    assert mux.partial_dispatches == 1
+    assert mux.warmup_compiles == 1 and mux.recompiles_steady == 0
+
+
+def test_full_bucket_dispatches_without_waiting():
+    clock = FakeClock()
+    mux = StreamMux(max_batch=2, max_wait_ms=60_000.0, clock=clock)
+    meta, cube = _stream()
+    cfg = _cfg()
+    mux.open("a", meta, cfg)
+    mux.open("b", meta, cfg)
+    mux.ingest("a", cube[0])
+    assert mux.pump() == 0          # half a batch, an hour of headroom
+    mux.ingest("b", cube[1])
+    assert mux.pump() == 1          # full bucket: no SLO wait
+    assert mux.partial_dispatches == 0
+    assert mux.batch_occupancies == [1.0]
+
+
+def test_ring_backpressure_nonblocking_and_blocking():
+    mux = StreamMux(max_batch=1, max_wait_ms=60_000.0, ring_capacity=2)
+    meta, cube = _stream()
+    mux.open("a", meta, _cfg())
+    mux.ingest("a", cube[0])
+    mux.ingest("a", cube[1])
+    with pytest.raises(MuxRingFull, match="capacity"):
+        mux.ingest("a", cube[2])
+    # blocking ingest times out (nothing is draining the ring)
+    with pytest.raises(MuxRingFull, match="backpressure"):
+        mux.ingest("a", cube[2], block=True, timeout_s=0.15)
+    # abandoning the stream frees its ring slots
+    mux.abandon_stream("a")
+    assert mux.pending() == 0
+
+
+def test_no_starvation_one_head_per_stream_oldest_first():
+    clock = FakeClock()
+    mux = StreamMux(max_batch=8, max_wait_ms=5.0, clock=clock)
+    meta, cube = _stream(nsub=6)
+    cfg = _cfg()
+    mux.open("chatty", meta, cfg)
+    mux.open("slow", meta, cfg)
+    # the chatty stream backlogs five subints before slow's one arrives
+    for i in range(5):
+        mux.ingest("chatty", cube[i])
+        clock.advance(0.001)
+    mux.ingest("slow", cube[5])
+    # one dispatch cycle: only stream HEADS join the batch, oldest
+    # first — the backlog depth buys chatty no extra lanes
+    with mux._dispatch_lock:
+        picked = mux._select_batch(clock(), True)
+        assert picked is not None
+        binfo, lanes = picked
+        assert [s.key for s, _ in lanes] == ["chatty", "slow"]
+        mux._dispatch(binfo, lanes)
+    assert mux.session("slow").n_subints == 1
+    assert mux.session("chatty").n_subints == 1
+    assert mux.pending("chatty") == 4
+    # the backlog then drains one lane per dispatch
+    assert mux.pump(force=True) == 4
+    assert mux.subints == 6
+
+
+def test_closing_stream_drains_without_stalling_bucket():
+    clock = FakeClock()
+    mux = StreamMux(max_batch=8, max_wait_ms=60_000.0, clock=clock)
+    meta, cube = _stream()
+    cfg = _cfg()
+    mux.open("a", meta, cfg)
+    mux.open("b", meta, cfg)
+    mux.ingest("a", cube[0])
+    mux.ingest("a", cube[1])
+    mux.ingest("b", cube[2])
+    assert mux.pump() == 0          # nothing due: partial and fresh
+    # closing "a" makes its pending due immediately; "b"'s head rides
+    # the same bucket's batches instead of being stalled behind the SLO
+    res = mux.close_stream("a")
+    assert res.n_subints == 2
+    assert res.recompiles_steady == 0
+    assert "a" not in mux.streams()
+    assert mux.session("b").n_subints == 1
+    # and "b" keeps working after its neighbour closed
+    mux.ingest("b", cube[3])
+    mux.pump(force=True)
+    assert mux.close_stream("b").n_subints == 2
+
+
+# ------------------------------------------------- bit-equality contract
+
+_PARITY_NSUB = 4
+
+
+@pytest.fixture(scope="module")
+def solo_baseline():
+    """Reference run shared by every batch-size param: 3 solo sessions
+    over one pre-jitted step (the sweep compares masks, not compiles),
+    closed once — (streams, [(pweights, pscores, final_weights)])."""
+    import jax
+
+    from iterative_cleaner_tpu.online.session import resolve_ew_alpha
+    from iterative_cleaner_tpu.online.step import build_subint_step
+
+    cfg = _cfg(fleet_bucket_pad=(0, 8))
+    streams = [_stream(nsub=_PARITY_NSUB, nchan=6, nbin=16, seed=100 + s)
+               for s in range(3)]
+    alpha = resolve_ew_alpha(cfg.stream_ew_alpha)
+    shared = jax.jit(build_subint_step(cfg, 6, 16, False, alpha)[0])
+    refs = []
+    for meta, cube in streams:
+        sess = OnlineSession(meta, cfg, step_fn=shared)
+        for i in range(_PARITY_NSUB):
+            sess.ingest(cube[i])
+        pw, ps = sess.provisional_weights, sess.provisional_scores
+        refs.append((pw, ps, np.asarray(sess.close().archive.weights)))
+    return cfg, streams, refs
+
+
+@pytest.mark.parametrize("max_batch", [1, 2, 3, 8])
+def test_mux_masks_bit_equal_with_solo_sessions(max_batch, solo_baseline):
+    # nchan=6 with a chan-step of 8 quantizes up to qchan=8: every
+    # dispatch carries padded channels, so this sweep also proves the
+    # pad lanes never leak into the true channels.  max_batch=2 forces
+    # split dispatches of 3 streams; max_batch=8 forces rung padding
+    # (b=3 -> rung 4 with one inert lane).
+    cfg, streams, refs = solo_baseline
+    mux = StreamMux(max_batch=max_batch, max_wait_ms=0.0)
+    for k, (meta, _) in enumerate(streams):
+        mux.open(f"s{k}", meta, cfg)
+    for i in range(_PARITY_NSUB):
+        for k, (_, cube) in enumerate(streams):
+            mux.ingest(f"s{k}", cube[i])
+        mux.pump(force=True)
+    assert mux.recompiles_steady == 0
+    for k, (pw, ps, final_w) in enumerate(refs):
+        ms = mux.session(f"s{k}")
+        np.testing.assert_array_equal(ms.provisional_weights, pw)
+        # provisional scores carry NaN where a channel median is
+        # degenerate — identical NaN placement is part of the contract
+        assert np.array_equal(ms.provisional_scores, ps, equal_nan=True)
+        # close reconciles agree too: the archived product is bit-equal
+        res_m = mux.close_stream(f"s{k}")
+        np.testing.assert_array_equal(np.asarray(res_m.archive.weights),
+                                      final_w)
+
+
+# -------------------------------------------- per-stream quality series
+
+def test_quality_drift_alerts_stay_per_stream_under_mux():
+    # Two streams batched through one mux and one registry: only the
+    # drifting stream's quality_drift_alerts{stream=} may increment.
+    reg = MetricsRegistry()
+    cfg = _cfg(quality_window=2, quality_drift=0.25)
+    meta, cube = _stream(nsub=4)
+    mux = StreamMux(max_batch=4, max_wait_ms=0.0, registry=reg)
+    mux.open("quiet", meta, cfg)
+    mux.open("noisy", meta, cfg)
+    for i in range(2):              # identical baselines fill both windows
+        mux.ingest("quiet", cube[i])
+        mux.ingest("noisy", cube[i])
+        mux.pump(force=True)
+    # third subint: noisy arrives with three quarters of its band dead,
+    # jumping its zap fraction past the drift band; quiet stays flat
+    dead = np.ones(meta.nchan)
+    dead[: (3 * meta.nchan) // 4] = 0.0
+    mux.ingest("quiet", cube[2])
+    mux.ingest("noisy", cube[2], dead)
+    mux.pump(force=True)
+    noisy = labeled("quality_drift_alerts", stream="noisy")
+    quiet = labeled("quality_drift_alerts", stream="quiet")
+    assert reg.counters.get(noisy, 0.0) >= 1.0
+    assert reg.counters.get(quiet, 0.0) == 0.0
