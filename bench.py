@@ -1779,6 +1779,104 @@ def bench_fused(nsub, nchan, nbin, max_iter=3, chunk=None):
     }
 
 
+def bench_mesh(nsub, nchan, nbin, max_iter=3):
+    """Sharded fused-sweep row (parallel/shard_sweep.py): the one-launch
+    sweep shard_mapped over a cell mesh vs the same engine on one device,
+    same archive, both warm.
+
+    ``mesh_vs_single`` is warm best-of-2 wall clock (on a forced-CPU mesh
+    the devices timeshare one core, so the ratio documents overhead, not
+    speedup — the TPU number comes from tpu_validation_pass.sh).  Mask
+    parity between the routes is rc-7 fatal like every row above, and
+    ``mesh_sweep_cube_reads`` is PROVEN per shard: the DMA kernel is
+    traced at the local shard geometry and its cube-ref loads counted by
+    the --selfcheck contract helper (anything but 1 raises)."""
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.analysis.jaxpr_contracts import (
+        _count_cube_ref_reads,
+    )
+    from iterative_cleaner_tpu.backends.jax_backend import clean_cube
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.io.synthetic import (
+        bench_rfi_density,
+        make_synthetic_archive,
+    )
+    from iterative_cleaner_tpu.parallel.mesh import cell_mesh
+    from iterative_cleaner_tpu.parallel.shard_sweep import (
+        sweep_downgrade_reason,
+    )
+    from iterative_cleaner_tpu.parallel.sharding import clean_cube_sharded
+    from iterative_cleaner_tpu.stats import pallas_kernels as pk
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        _log("mesh stage: single device only (force a CPU mesh with "
+             "XLA_FLAGS=--xla_force_host_platform_device_count=4); "
+             "skipping the row")
+        return None
+    mesh = cell_mesh(min(4, n_dev))
+    reason = sweep_downgrade_reason(mesh, nsub, nchan, nbin)
+    if reason is not None:
+        _log(f"mesh stage: {nsub}x{nchan}x{nbin} ineligible on "
+             f"{dict(mesh.shape)} ({reason}); skipping the row")
+        return None
+
+    ar, _ = make_synthetic_archive(
+        nsub=nsub, nchan=nchan, nbin=nbin, **bench_rfi_density(nsub, nchan),
+        seed=0, dtype=np.float32)
+    cfg = CleanConfig(backend="jax", dtype="float32", stats_impl="fused",
+                      fft_mode="dft", median_impl="pallas",
+                      fused_sweep="on", max_iter=max_iter)
+    args = (ar.total_intensity(), ar.weights, ar.freqs_mhz, ar.dm,
+            ar.centre_freq_mhz, ar.period_s, cfg)
+    runs = {"single": lambda: clean_cube(*args),
+            "mesh": lambda: clean_cube_sharded(*args, mesh)}
+    results, times = {}, {}
+    for name, run in runs.items():
+        run()                                   # compile + warm
+        for _ in range(2):                      # warm best-of-2
+            t0 = time.perf_counter()
+            results[name] = run()
+            dt = time.perf_counter() - t0
+            times[name] = min(times.get(name, dt), dt)
+    assert np.array_equal(results["single"].final_weights,
+                          results["mesh"].final_weights), (
+        "sharded sweep masks diverged from the single-device engine "
+        "(%d cells)" % int(np.sum(results["single"].final_weights
+                                  != results["mesh"].final_weights)))
+
+    # per-shard single-read budget, proven on the traced DMA kernel at
+    # the LOCAL shard geometry (what each device actually launches)
+    s_loc = nsub // mesh.shape["sub"]
+    c_loc = nchan // mesh.shape["chan"]
+    f32 = jnp.float32
+    cube = jax.ShapeDtypeStruct((s_loc, c_loc, nbin), f32)
+    plane = jax.ShapeDtypeStruct((s_loc, c_loc), f32)
+    mask = jax.ShapeDtypeStruct((s_loc, c_loc), jnp.bool_)
+    row = jax.ShapeDtypeStruct((nbin,), f32)
+    closed = jax.make_jaxpr(
+        lambda d, t, win, w, m: pk.sweep_shard_diags_dedisp(
+            d, t, win, w, m, dma=True))(cube, row, row, plane, mask)
+    reads = _count_cube_ref_reads(closed)
+    assert reads == [1], (
+        "sharded sweep kernel broke its single-read budget: %r" % (reads,))
+
+    ratio = times["mesh"] / times["single"]
+    _log(f"mesh ({nsub}x{nchan}x{nbin} over {dict(mesh.shape)}): warm "
+         f"best-of-2 {times['mesh'] * 1e3:.1f} ms sharded vs "
+         f"{times['single'] * 1e3:.1f} ms single ({ratio:.2f}x), "
+         f"{reads[0]} cube read(s)/shard/iteration")
+    return {
+        "mesh_geometry": f"{nsub}x{nchan}x{nbin}",
+        "mesh_platform": jax.default_backend(),
+        "mesh_devices": int(mesh.devices.size),
+        "mesh_vs_single": round(ratio, 3),
+        "mesh_sweep_cube_reads": int(reads[0]),
+    }
+
+
 def bench_numpy(nsub, nchan, nbin, max_iter=5):
     from iterative_cleaner_tpu.backends.numpy_backend import clean_cube
     from iterative_cleaner_tpu.config import CleanConfig
@@ -1802,7 +1900,7 @@ def bench_numpy(nsub, nchan, nbin, max_iter=5):
     return rate
 
 
-def _bench_row_subprocess(env_key, payload, timeout, label):
+def _bench_row_subprocess(env_key, payload, timeout, label, extra_env=None):
     """Run one bench stage in a KILLABLE subprocess with its own deadline.
 
     The 2026-07-31 TPU window lost its headline JSON to a wedge inside the
@@ -1817,7 +1915,7 @@ def _bench_row_subprocess(env_key, payload, timeout, label):
     """
     import subprocess
 
-    env = {**os.environ, env_key: json.dumps(payload)}
+    env = {**os.environ, **(extra_env or {}), env_key: json.dumps(payload)}
     try:
         # stderr is INHERITED: the child's stage logs stream live (and
         # survive a timeout kill); only the one-line JSON is captured
@@ -1855,6 +1953,7 @@ def main():
                            ("BENCH_ONLINE_ONLY", bench_online),
                            ("BENCH_MUX_ONLY", bench_mux),
                            ("BENCH_FUSED_ONLY", bench_fused),
+                           ("BENCH_MESH_ONLY", bench_mesh),
                            ("BENCH_MULTIHOST_ONLY", bench_multihost),
                            ("BENCH_ELASTIC_ONLY", bench_elastic)):
         if os.environ.get(env_key):
@@ -2020,6 +2119,30 @@ def main():
         label="fused")
     if row:
         extras = {**(extras or {}), **row}
+
+    # sharded fused-sweep row (parallel/shard_sweep.py): the one-launch
+    # sweep shard_mapped over a cell mesh vs the single-device engine.
+    # The child gets a forced 4-device host platform unless the caller
+    # already pinned one (harmless off-CPU: the flag only shapes the
+    # host platform, and a real TPU run uses its real devices).
+    # BENCH_SKIP_MESH=1 opts out: the stage compiles the sharded program
+    # twice, which the tier-1 bench-schema test cannot afford inside its
+    # wall-clock budget (tests/test_bench_config.py pins this row's keys
+    # in a dedicated slow test instead).
+    if os.environ.get("BENCH_SKIP_MESH") != "1":
+        me_geom = (16, 32, 64) if small else (64, 128, 256)
+        flags = os.environ.get("XLA_FLAGS", "")
+        mesh_env = {}
+        if "xla_force_host_platform_device_count" not in flags:
+            mesh_env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4").strip()
+        row = _bench_row_subprocess(
+            "BENCH_MESH_ONLY",
+            {"nsub": me_geom[0], "nchan": me_geom[1], "nbin": me_geom[2]},
+            timeout=float(os.environ.get("BENCH_MESH_TIMEOUT", "600")),
+            label="mesh", extra_env=mesh_env)
+        if row:
+            extras = {**(extras or {}), **row}
 
     # multi-host fleet row (parallel/fleet.py + resilience/journal.py):
     # the same fleet served by 1 process vs 2 journal-coordinated

@@ -208,3 +208,77 @@ print(f"fourier/fft multi-chip OK: mesh {sd}x{cd}, grid {nsub}x{nchan}, "
       f"loops={int(sharded.loops)}, "
       f"zapped={int((np.asarray(sharded.final_weights) == 0).sum())}")
 PYEOF
+
+# 8. (round 7) SHARDED FUSED SWEEP multi-chip validation: the one-launch
+#    sweep shard_mapped over the real cell mesh with the double-buffered
+#    HBM->VMEM DMA grid inside each shard.  CPU interpret tests pin
+#    bit-parity and the single-read budget; this measures what the pod
+#    rung BUYS.  Targets: masks bit-equal with the single-chip fused
+#    engine (fatal, no `|| true`), per-shard hbm_util >= 0.6 on the
+#    bench-config shard (the DMA pipeline should keep the sweep
+#    memory-bound, not launch-bound), and >= 2x single-chip cell-iters/s
+#    on a 4-chip mesh (linear would be 4x; the tree-reduce collectives
+#    and the replicated template tax the rest).  Record shortfalls in
+#    BASELINE.md rather than tuning blind — the roofline row in the
+#    profile log (step 3) says which side is short.
+python - <<'PYEOF' > "benchmarks/measured/sharded_sweep_${STAMP}.txt" 2>&1
+import time
+import numpy as np, jax
+devs = [d for d in jax.devices() if d.platform == "tpu"]
+if len(devs) < 2:
+    print(f"SKIP: sharded sweep needs >=2 TPU chips, have {len(devs)}")
+    raise SystemExit(0)
+from iterative_cleaner_tpu.backends.jax_backend import clean_cube
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io.synthetic import (
+    bench_rfi_density, make_synthetic_archive)
+from iterative_cleaner_tpu.parallel.mesh import cell_mesh
+from iterative_cleaner_tpu.parallel.shard_sweep import sweep_downgrade_reason
+from iterative_cleaner_tpu.parallel.sharding import clean_cube_sharded
+
+mesh = cell_mesh(devices=devs)
+sd, cd = mesh.shape["sub"], mesh.shape["chan"]
+nsub, nchan, nbin = 256 * sd, 1024 * cd, 128   # bench-config shard/chip
+reason = sweep_downgrade_reason(mesh, nsub, nchan, nbin)
+assert reason is None, f"bench shard fell off the sweep rung: {reason}"
+ar, _ = make_synthetic_archive(
+    nsub=nsub, nchan=nchan, nbin=nbin, **bench_rfi_density(nsub, nchan),
+    seed=0, dtype=np.float32)
+cfg = CleanConfig(backend="jax", dtype="float32", stats_impl="fused",
+                  fft_mode="dft", median_impl="pallas", fused_sweep="on",
+                  max_iter=3)
+args = (ar.total_intensity(), ar.weights, ar.freqs_mhz, ar.dm,
+        ar.centre_freq_mhz, ar.period_s, cfg)
+runs = {"single": lambda: clean_cube(*args),
+        "mesh": lambda: clean_cube_sharded(*args, mesh)}
+res, times = {}, {}
+for name, run in runs.items():
+    run()                                   # compile + warm
+    for _ in range(2):                      # warm best-of-2
+        t0 = time.perf_counter()
+        res[name] = run()
+        dt = time.perf_counter() - t0
+        times[name] = min(times.get(name, dt), dt)
+assert np.array_equal(np.asarray(res["single"].final_weights),
+                      np.asarray(res["mesh"].final_weights)), \
+    "sharded sweep mask diverged from the single-chip fused engine"
+speedup = times["single"] / times["mesh"]
+cells = nsub * nchan * int(res["mesh"].loops)
+print(f"sharded sweep OK: mesh {sd}x{cd}, grid {nsub}x{nchan}x{nbin}, "
+      f"{times['mesh']*1e3:.1f} ms sharded vs {times['single']*1e3:.1f} ms "
+      f"single ({speedup:.2f}x, target >= 2x on 4 chips), "
+      f"{cells / times['mesh']:.3e} cell-iters/s aggregate")
+assert speedup >= 2.0 or len(devs) < 4, \
+    f"sharded sweep under the 2x floor on {len(devs)} chips: {speedup:.2f}x"
+PYEOF
+
+# 8b. The bench_mesh row on the real mesh (the same keys CI's CPU smoke
+#     gates; here mesh_vs_single < 1.0 is the expectation worth keeping)
+#     + the per-shard roofline: profile_stages' hbm_util for the sweep
+#     stage at the per-chip shard geometry — the >= 0.6 target says the
+#     double-buffered DMA grid keeps the kernel memory-bound.
+BENCH_MESH_ONLY='{"nsub": 1024, "nchan": 4096, "nbin": 128}' \
+  python bench.py > "benchmarks/measured/bench_mesh_${STAMP}.json" \
+                 2> "benchmarks/measured/bench_mesh_${STAMP}.stderr.txt"
+python benchmarks/profile_stages.py --nsub 256 --nchan 1024 \
+  > "benchmarks/measured/shard_roofline_${STAMP}.txt" 2>&1

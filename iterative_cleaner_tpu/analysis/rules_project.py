@@ -33,6 +33,9 @@ ENV_ONLY = frozenset({
     "ICLEAN_FUSED_SBLK",
     "ICLEAN_FUSED_CBLK_SCALE",
     "ICLEAN_SCALER_VMEM_MB",
+    "ICLEAN_SWEEP_DMA",         # per-shard DMA-vs-BlockSpec escape hatch
+                                # (hardware debugging; masks bit-equal, so
+                                # no user-facing flag is warranted)
     "ICLEAN_BUILDER_CACHE",     # lru_cache bound for the batch builders
     "ICLEAN_FAULT_HANG_S",      # fault-injection hang duration
     "ICLEAN_RACE_BUDGET_S",     # model-checker sweep wall-clock budget

@@ -133,18 +133,28 @@ def resolve_stats_impl(stats_impl: str, dtype, nbin: int,
     return "fused" if ok else "xla"
 
 
-def resolve_fused_sweep(fused_sweep, stats_impl_resolved: str) -> str:
+def resolve_fused_sweep(fused_sweep, stats_impl_resolved: str, *,
+                        mesh=None, shape=None) -> str:
     """Resolve the fused-SWEEP knob to 'on'/'off'.
 
     ``None`` defers to the ``ICLEAN_FUSED_SWEEP`` env mirror, then
     'auto'.  'auto' follows the RESOLVED stats_impl: the sweep is the
     one-launch packaging of the fused cell kernels, so it engages exactly
     where those kernels are already trusted — and nowhere else (no
-    separate hardware allowlist to drift).  The resolved 'on' is still a
-    request, not a promise: the engine's per-program gate
-    (``fused_sweep_eligible`` geometry, unsharded, float32) makes the
-    final trace-time call and quietly keeps the multi-kernel route when
-    it fails."""
+    separate hardware allowlist to drift).
+
+    ``mesh``/``shape`` add the mesh rung of the eligibility ladder for
+    sharded programs: under 'auto' a ('sub', 'chan') mesh that cannot
+    take the sharded sweep (indivisible cell grid, or a local shard
+    outside the single-device geometry budget —
+    :func:`~iterative_cleaner_tpu.parallel.shard_sweep.
+    sweep_downgrade_reason`) resolves 'off' so the program never requests
+    what the engine would refuse.  An explicit 'on' passes through
+    unchanged — it is still a request, not a promise: the engine's
+    trace-time gate (geometry, float32, one-read frame, the same mesh
+    rung) makes the final call and quietly keeps the multi-kernel route
+    when it fails; the CLI surfaces that downgrade
+    (``fused_sweep_ineligible`` counter) instead of erroring."""
     import os
 
     if fused_sweep is None:
@@ -153,7 +163,16 @@ def resolve_fused_sweep(fused_sweep, stats_impl_resolved: str) -> str:
         raise ValueError(f"unknown fused sweep mode {fused_sweep!r}")
     if fused_sweep != "auto":
         return fused_sweep
-    return "on" if stats_impl_resolved == "fused" else "off"
+    if stats_impl_resolved != "fused":
+        return "off"
+    if mesh is not None and shape is not None:
+        from iterative_cleaner_tpu.parallel.shard_sweep import (
+            sharded_sweep_eligible,
+        )
+
+        if not sharded_sweep_eligible(mesh, *shape):
+            return "off"
+    return "on"
 
 
 @functools.lru_cache(maxsize=None)

@@ -655,6 +655,37 @@ def output_name(ar, args: argparse.Namespace, in_path: str) -> str:
     return args.output
 
 
+def _notice_sweep_downgrade(cfg, mesh, shape, *, quiet, telemetry):
+    """Satellite of the sharded fused sweep: an EXPLICIT ``--fused-sweep
+    on`` (or ``ICLEAN_FUSED_SWEEP=on``) that the mesh rung of the
+    eligibility ladder refuses must not silently take the marginal
+    route — print the one-line downgrade and bump the
+    ``fused_sweep_ineligible{reason=...}`` counter.  'auto' stays quiet
+    (it never promised the sweep).  Returns the reason (or None)."""
+    knob = cfg.fused_sweep
+    if knob is None:
+        knob = os.environ.get("ICLEAN_FUSED_SWEEP", "") or "auto"
+    if knob != "on":
+        return None
+    from iterative_cleaner_tpu.parallel.shard_sweep import (
+        sweep_downgrade_reason,
+    )
+
+    reason = sweep_downgrade_reason(mesh, *shape)
+    if reason is None:
+        return None
+    if telemetry is not None and telemetry.registry is not None:
+        from iterative_cleaner_tpu.telemetry.registry import labeled
+
+        telemetry.registry.counter_inc(
+            labeled("fused_sweep_ineligible", reason=reason))
+    if not quiet:
+        print("fused sweep ineligible on this mesh (%s): keeping the "
+              "multi-kernel sharded route (masks unchanged, more HBM "
+              "traffic)" % reason)
+    return reason
+
+
 def clean_one(in_path: str, args: argparse.Namespace,
               timer=None, preloaded=None, result=None,
               telemetry=None) -> str:
@@ -709,6 +740,9 @@ def clean_one(in_path: str, args: argparse.Namespace,
                     from iterative_cleaner_tpu.parallel.mesh import cell_mesh
 
                     mesh = cell_mesh()
+                    _notice_sweep_downgrade(
+                        cfg, mesh, (ar.nsub, ar.nchan, ar.nbin),
+                        quiet=args.quiet, telemetry=telemetry)
                 result = clean_streaming(
                     ar, stream, cfg, mesh,
                     mode=getattr(args, "stream_mode", "exact"),
@@ -720,7 +754,11 @@ def clean_one(in_path: str, args: argparse.Namespace,
                     clean_archive_sharded,
                 )
 
-                result = clean_archive_sharded(ar, cfg, cell_mesh())
+                mesh = cell_mesh()
+                _notice_sweep_downgrade(
+                    cfg, mesh, (ar.nsub, ar.nchan, ar.nbin),
+                    quiet=args.quiet, telemetry=telemetry)
+                result = clean_archive_sharded(ar, cfg, mesh)
             else:
                 from iterative_cleaner_tpu.models import get_model
 

@@ -299,27 +299,42 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
     template half — fit, residual, diagnostics, both scaler orientations,
     combine, zap — runs as ONE Pallas kernel reading each cube tile
     exactly once per iteration.  It engages only where its trace-time
-    gate admits it (fused stats route, unsharded, float32 weights, a
-    one-read frame — ``stats_frame='dedispersed'`` or ``disp_iteration``
-    — and :func:`~iterative_cleaner_tpu.stats.pallas_kernels.
+    gate admits it (fused stats route, float32 weights, a one-read frame
+    — ``stats_frame='dedispersed'`` or ``disp_iteration`` — and
+    :func:`~iterative_cleaner_tpu.stats.pallas_kernels.
     fused_sweep_eligible` geometry); everything else quietly keeps the
-    multi-kernel route.  Masks and scores are bit-equal either way (the
-    sweep reuses the exact kernel bodies; tests/test_fused_sweep.py).
+    multi-kernel route.  Under a ``shard_mesh`` the sweep takes its
+    pod-scale form (:mod:`iterative_cleaner_tpu.parallel.shard_sweep`):
+    per-shard one-read diagnostics plus tree-reduced kth-select combine,
+    gated by the mesh rung of the eligibility ladder
+    (:func:`~iterative_cleaner_tpu.parallel.shard_sweep.
+    sharded_sweep_eligible` — the mesh must divide the cell grid and the
+    LOCAL shard must fit the single-device geometry budget).  Masks and
+    scores are bit-equal on every route (the sweep reuses the exact
+    kernel bodies and the distributed selects merge integer counts only;
+    tests/test_fused_sweep.py, tests/test_shard_sweep.py).
     """
     if stats_impl == "fused" and fft_mode == "fft":
         raise ValueError(
             "stats_impl='fused' computes DFT-flavoured rFFT magnitudes; "
             "pass fft_mode='dft'")
     use_sweep = (bool(fused_sweep) and stats_impl == "fused"
-                 and shard_mesh is None
                  and (stats_frame == "dedispersed" or disp_iteration))
+    if use_sweep and orig_weights.dtype != jnp.float32:
+        use_sweep = False
     if use_sweep:
-        from iterative_cleaner_tpu.stats.pallas_kernels import (
-            fused_sweep_eligible,
-        )
+        if shard_mesh is not None:
+            from iterative_cleaner_tpu.parallel.shard_sweep import (
+                sharded_sweep_eligible,
+            )
 
-        use_sweep = (orig_weights.dtype == jnp.float32
-                     and fused_sweep_eligible(*ded_cube.shape))
+            use_sweep = sharded_sweep_eligible(shard_mesh, *ded_cube.shape)
+        else:
+            from iterative_cleaner_tpu.stats.pallas_kernels import (
+                fused_sweep_eligible,
+            )
+
+            use_sweep = fused_sweep_eligible(*ded_cube.shape)
     with jax.named_scope("icln_template"):
         template = _build_template(
             ded_cube, disp_base, weights, back_shifts, rotation=rotation,
@@ -328,6 +343,11 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
     if use_sweep:
         nsub, nchan, nbin = ded_cube.shape
         with jax.named_scope("icln_fused_sweep"):
+            if shard_mesh is not None:
+                from iterative_cleaner_tpu.parallel.shard_sweep import (
+                    sharded_fused_sweep,
+                    sharded_fused_sweep_dedisp,
+                )
             from iterative_cleaner_tpu.stats.pallas_kernels import (
                 fused_sweep_pallas,
                 fused_sweep_pallas_dedisp,
@@ -338,9 +358,14 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
                                   pulse_active, ded_cube.dtype)
                 window = jnp.ones((nbin,), ded_cube.dtype) if m is None \
                     else m
-                new_weights, scores, d_std = fused_sweep_pallas_dedisp(
-                    ded_cube, template, window, orig_weights, cell_mask,
-                    chanthresh, subintthresh)
+                if shard_mesh is not None:
+                    new_weights, scores, d_std = sharded_fused_sweep_dedisp(
+                        shard_mesh, ded_cube, template, window,
+                        orig_weights, cell_mask, chanthresh, subintthresh)
+                else:
+                    new_weights, scores, d_std = fused_sweep_pallas_dedisp(
+                        ded_cube, template, window, orig_weights, cell_mask,
+                        chanthresh, subintthresh)
             else:
                 # disp_iteration: pulse inactive by construction, so the
                 # rotated-template row is unwindowed — same prep as
@@ -350,9 +375,14 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
                     jnp, method=rotation)
                 nyq_row = _nyq_correction_row(back_shifts, nbin, rotation,
                                               ded_cube.dtype)
-                new_weights, scores, d_std = fused_sweep_pallas(
-                    disp_base, rot_t, nyq_row, template, orig_weights,
-                    cell_mask, chanthresh, subintthresh)
+                if shard_mesh is not None:
+                    new_weights, scores, d_std = sharded_fused_sweep(
+                        shard_mesh, disp_base, rot_t, nyq_row, template,
+                        orig_weights, cell_mask, chanthresh, subintthresh)
+                else:
+                    new_weights, scores, d_std = fused_sweep_pallas(
+                        disp_base, rot_t, nyq_row, template, orig_weights,
+                        cell_mask, chanthresh, subintthresh)
         if not with_metrics:
             return new_weights, scores
         with jax.named_scope("icln_iter_metrics"):

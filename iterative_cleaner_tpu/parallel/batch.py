@@ -21,6 +21,7 @@ import numpy as np
 from iterative_cleaner_tpu.archive import Archive
 from iterative_cleaner_tpu.backends.base import CleanResult, apply_bad_parts
 from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.parallel.mesh import shard_map_compat
 
 # Bound on the builder lru_caches below: a long-lived server sweeping many
 # geometries/configs would otherwise grow compiled-program host memory
@@ -141,7 +142,7 @@ def build_batch_shardmap_fn(mesh, *build_args, donate=False):
     inner = build_batched_clean_fn(*build_args)
     in_specs = tuple(P("batch", *([None] * (nd - 1)))
                      for nd in _STACKED_NDIMS)
-    sharded = jax.shard_map(inner, mesh=mesh, in_specs=in_specs,
+    sharded = shard_map_compat(inner, mesh=mesh, in_specs=in_specs,
                             out_specs=P("batch"), check_vma=False)
     # every CleanOutputs leaf carries a leading batch dim, so one
     # P('batch') prefix spec covers the whole output pytree

@@ -8,6 +8,25 @@ from typing import Optional, Sequence
 import numpy as np
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=True):
+    """``shard_map`` across the jax API move: newer jax exposes it as
+    top-level ``jax.shard_map`` (replication checking spelled
+    ``check_vma``); this jax generation still has it at
+    ``jax.experimental.shard_map`` with the same knob spelled
+    ``check_rep``.  Every shard_map in the package routes through here so
+    the sharded paths work on both sides of the move."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as esm
+
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def factor_2d(n: int) -> tuple[int, int]:
     """Factor n devices into the most-square (a, b) grid with a*b == n."""
     for a in range(int(math.isqrt(n)), 0, -1):

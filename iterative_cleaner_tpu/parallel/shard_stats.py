@@ -35,6 +35,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import PartitionSpec as P
 
+from iterative_cleaner_tpu.parallel.mesh import shard_map_compat
 from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
 from iterative_cleaner_tpu.stats.pallas_kernels import pallas_interpret
 
@@ -90,7 +91,7 @@ def sharded_scale_and_combine(mesh, diagnostics, cell_mask, chanthresh,
 
     # check_vma=False: pallas_call's abstract eval carries no varying-mesh
     # annotation, so shard_map's replication checker cannot see through it.
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(_CELL,) * 5,
+    fn = shard_map_compat(local, mesh=mesh, in_specs=(_CELL,) * 5,
                        out_specs=_CELL, check_vma=False)
     with pallas_interpret(_mesh_interpret(mesh)):
         return fn(*diagnostics, cell_mask)
@@ -108,7 +109,7 @@ def sharded_cell_diagnostics_fused(mesh, ded, disp_base, rot_t, template,
         cell_diagnostics_pallas,
     )
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         cell_diagnostics_pallas, mesh=mesh,
         in_specs=(_CUBE, _CUBE, _CHAN_ROW, _REP, _CELL, _CELL),
         out_specs=(_CELL,) * 4, check_vma=False,
@@ -132,7 +133,7 @@ def sharded_weighted_marginals(mesh, disp, weights):
         a, t1 = weighted_marginals_pallas(disp, weights)
         return (jax.lax.psum(a, "sub"), jax.lax.psum(t1, "chan"))
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local, mesh=mesh, in_specs=(_CUBE, _CELL),
         out_specs=(P("chan", None), P("sub", None)), check_vma=False,
     )
@@ -162,7 +163,7 @@ def sharded_cell_diagnostics_fused_disp(mesh, disp, rot_t, nyq_row,
             disp, rot_t, nyq_row if apply_nyq else None, template,
             weights, cell_mask)
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local, mesh=mesh,
         in_specs=(_CUBE, _CHAN_ROW, _CHAN_ROW, _REP, _CELL, _CELL),
         out_specs=(_CELL,) * 4, check_vma=False,
@@ -179,10 +180,100 @@ def sharded_cell_diagnostics_fused_dedisp(mesh, ded, template, window,
         cell_diagnostics_pallas_dedisp,
     )
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         cell_diagnostics_pallas_dedisp, mesh=mesh,
         in_specs=(_CUBE, _REP, _REP, _CELL, _CELL),
         out_specs=(_CELL,) * 4, check_vma=False,
     )
     with pallas_interpret(_mesh_interpret(mesh)):
         return fn(ded, template, window, weights, cell_mask)
+
+
+# ---------------------------------------------------------------------------
+# Tree-reduced robust statistics: distributed kth-select medians/MADs
+# ---------------------------------------------------------------------------
+#
+# The sharded fused sweep (parallel/shard_sweep.py) cannot gather the
+# diagnostic planes the way sharded_scale_and_combine does — the whole
+# point of the sweep is that nothing cube-sized or plane-sized makes an
+# extra HBM round trip.  Instead the radix-bisection select runs as a
+# MERGE of per-shard partial counts: every bisection step psums the
+# per-shard "keys <= mid" counts over the reduce-axis mesh axis, the
+# successor probe pmins the per-shard minima, and every device walks the
+# identical global bisection.  All cross-device traffic is int32 counts
+# and keys — integer adds/mins are exact in any reduction order — and the
+# float epilogues run locally on identical operands, so the distributed
+# medians/MADs/scores are bit-equal with the single-device
+# stats/pallas_kernels.py route by construction (the bisection code IS
+# the same function, parameterised by the reducers).  XLA lowers the
+# psums/pmins as tree (or ring) all-reduces over the mesh axis.
+
+def tree_reducers(axis_name):
+    """(reduce_sum, reduce_min, reduce_any) collectives over one mesh
+    axis, in the shape :func:`pallas_kernels._select_kth` and friends
+    accept.  ``reduce_any`` serves the NaN-propagation patch of the
+    plain (rFFT) scaler path: a line's NaN may live on another shard."""
+    import jax.numpy as jnp
+
+    def reduce_sum(x):
+        return jax.lax.psum(x, axis_name)
+
+    def reduce_min(x):
+        return jax.lax.pmin(x, axis_name)
+
+    def reduce_any(x):
+        return jax.lax.pmax(x.astype(jnp.int32), axis_name) > 0
+
+    return reduce_sum, reduce_min, reduce_any
+
+
+def tree_masked_median_lanes(values, mask, axis_name):
+    """Distributed :func:`pallas_kernels._masked_median_lanes`: the
+    median over the unmasked entries of each lane where the reduction
+    axis (axis 0 of the local shard) is sharded over ``axis_name``.
+    Must run inside a shard_map body.  Returns (medians, n_valid) with
+    the global count — bit-equal with the single-device select on the
+    concatenated shards."""
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        _masked_median_lanes,
+    )
+
+    reduce_sum, reduce_min, _ = tree_reducers(axis_name)
+    return _masked_median_lanes(values, mask, reduce_sum, reduce_min)
+
+
+def tree_scaled_sides(d0, d1, d2, d3, mask, thresh, axis_name):
+    """Distributed :func:`pallas_kernels._scaled_sides_body`: one scaler
+    orientation with the reduction axis sharded over ``axis_name``."""
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        _scaled_sides_body,
+    )
+
+    reduce_sum, reduce_min, reduce_any = tree_reducers(axis_name)
+    return _scaled_sides_body(d0, d1, d2, d3, mask, thresh,
+                              reduce_sum=reduce_sum, reduce_min=reduce_min,
+                              reduce_any=reduce_any)
+
+
+def tree_combine_zap(diagnostics, cell_mask, worig, chanthresh,
+                     subintthresh):
+    """The iteration tail (both scaler orientations, 4-way median,
+    threshold/zap) on ('sub', 'chan')-sharded local planes, the
+    distributed twin of :func:`pallas_kernels._combine_zap` on unpadded
+    planes: the channel scaler reduces over the 'sub' mesh axis, the
+    subint scaler (transposed locally — a transpose moves values, it
+    does not round them) over 'chan'.  Must run inside a shard_map body;
+    returns (new_weights, scores) local shards."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from iterative_cleaner_tpu.stats.pallas_kernels import _median4
+
+    d0, d1, d2, d3 = diagnostics
+    chan = tree_scaled_sides(d0, d1, d2, d3, cell_mask, chanthresh, "sub")
+    sub = tree_scaled_sides(d0.T, d1.T, d2.T, d3.T, cell_mask.T,
+                            subintthresh, "chan")
+    per = [jnp.maximum(c, s.T) for c, s in zip(chan, sub)]
+    scores = _median4(*per)
+    new_w = jnp.where(scores >= np.float32(1.0), np.float32(0.0), worig)
+    return new_w, scores

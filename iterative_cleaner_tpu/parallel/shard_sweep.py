@@ -1,0 +1,135 @@
+"""Pod-scale sharded fused sweep: the one-launch iteration tail over a
+device mesh.
+
+The single-device fused sweep (stats/pallas_kernels.py) reads each cube
+tile exactly once per iteration but holds the whole archive on one chip.
+This module is its multi-device form: the (nsub, nchan, nbin) cube stays
+sharded over the ('sub', 'chan') cell mesh, each shard runs the one-read
+diagnostics kernel locally — cube tiles staged through the kernel's own
+double-buffered HBM→VMEM DMA pipeline so fetch overlaps compute — and the
+cross-cell combine runs as tree-reduced kth-select merges
+(parallel/shard_stats.py): only int32 counts and keys cross the mesh,
+never a cube- or plane-sized array.
+
+Bit-parity with the single-device fused route is by construction at every
+stage: the per-shard kernel traces the SAME residual/diagnostics bodies,
+and the distributed selects walk the identical global bisection (integer
+collectives are exact in any reduction order).  tests/test_shard_sweep.py
+locks the end-to-end masks bit-equal on forced CPU meshes.
+
+Eligibility follows the fused_sweep_eligible ladder with a mesh rung: the
+mesh must divide the cell grid exactly (shard_map's layout requirement)
+and each LOCAL shard must satisfy the single-device geometry budget.
+Ineligible geometry keeps the sharded multi-kernel (marginal) route —
+never an error; :func:`sweep_downgrade_reason` names the rung that failed
+so the CLI can surface the downgrade instead of silently losing the
+single-read budget.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from iterative_cleaner_tpu.parallel.mesh import shard_map_compat
+from iterative_cleaner_tpu.parallel.shard_stats import (
+    _CELL,
+    _CHAN_ROW,
+    _CUBE,
+    _REP,
+    _mesh_interpret,
+    shard_divisible,
+    tree_combine_zap,
+)
+from iterative_cleaner_tpu.stats.pallas_kernels import (
+    fused_sweep_eligible,
+    pallas_interpret,
+    sweep_shard_diags_dedisp,
+    sweep_shard_diags_disp,
+)
+
+
+def sharded_sweep_eligible(mesh, nsub: int, nchan: int, nbin: int) -> bool:
+    """THE eligibility predicate for the sharded fused sweep — the mesh
+    rung of the fused_sweep_eligible ladder.  Geometry-only, like its
+    single-device twin: the knob/dtype gates live with the caller."""
+    return sweep_downgrade_reason(mesh, nsub, nchan, nbin) is None
+
+
+def sweep_downgrade_reason(mesh, nsub: int, nchan: int,
+                           nbin: int) -> Optional[str]:
+    """Why this mesh/geometry cannot take the sharded fused sweep, as a
+    stable one-token reason (the ``fused_sweep_ineligible`` counter
+    label), or None when eligible.
+
+    - ``mesh_indivisible``: a mesh axis does not divide its cell-grid
+      dimension, so the cube cannot shard equally (shard_map layout);
+    - ``shard_geometry``: the per-shard local cube fails the
+      single-device fused-sweep VMEM budget
+      (:func:`pallas_kernels.fused_sweep_eligible` on local shapes).
+    """
+    if not shard_divisible(mesh, nsub, nchan):
+        return "mesh_indivisible"
+    s_loc = nsub // int(mesh.shape["sub"])
+    c_loc = nchan // int(mesh.shape["chan"])
+    if not fused_sweep_eligible(s_loc, c_loc, nbin):
+        return "shard_geometry"
+    return None
+
+
+def sharded_fused_sweep_dedisp(mesh, ded, template, window, weights,
+                               cell_mask, chanthresh, subintthresh):
+    """Dedispersed-frame sharded fused sweep: per-shard one-read
+    diagnostics (DMA-pipelined cube fetch) + tree-reduced combine/zap.
+    Same signature/returns as
+    :func:`pallas_kernels.fused_sweep_pallas_dedisp` plus the leading
+    mesh: (new_weights, scores, d_std), each ('sub', 'chan')-sharded
+    (nsub, nchan) float32, bit-equal with the single-device sweep."""
+    ct, st = float(chanthresh), float(subintthresh)
+
+    def local(ded, template, window, weights, cell_mask):
+        w32 = weights.astype(jnp.float32)
+        diags = sweep_shard_diags_dedisp(ded, template, window, w32,
+                                         cell_mask)
+        new_w, scores = tree_combine_zap(diags, cell_mask, w32, ct, st)
+        return new_w, scores, diags[0]
+
+    fn = shard_map_compat(
+        local, mesh=mesh,
+        in_specs=(_CUBE, _REP, _REP, _CELL, _CELL),
+        out_specs=(_CELL,) * 3, check_vma=False,
+    )
+    with pallas_interpret(_mesh_interpret(mesh)):
+        return fn(ded, template, window.astype(jnp.float32), weights,
+                  cell_mask)
+
+
+def sharded_fused_sweep(mesh, disp, rot_t, nyq_row, template, weights,
+                        cell_mask, chanthresh, subintthresh):
+    """Dispersed-frame one-read sharded fused sweep, the multi-device
+    twin of :func:`pallas_kernels.fused_sweep_pallas`: the per-channel
+    rotated template and Nyquist-correction rows ride the 'chan' axis
+    with the cube, the (nbin,) template is replicated.  Returns
+    (new_weights, scores, d_std) sharded ('sub', 'chan')."""
+    ct, st = float(chanthresh), float(subintthresh)
+    apply_nyq = nyq_row is not None
+    if nyq_row is None:
+        nyq_row = jnp.zeros_like(rot_t)
+
+    def local(disp, rot_t, nyq_row, template, weights, cell_mask):
+        w32 = weights.astype(jnp.float32)
+        diags = sweep_shard_diags_disp(
+            disp, rot_t, nyq_row if apply_nyq else None, template, w32,
+            cell_mask)
+        new_w, scores = tree_combine_zap(diags, cell_mask, w32, ct, st)
+        return new_w, scores, diags[0]
+
+    fn = shard_map_compat(
+        local, mesh=mesh,
+        in_specs=(_CUBE, _CHAN_ROW, _CHAN_ROW, _REP, _CELL, _CELL),
+        out_specs=(_CELL,) * 3, check_vma=False,
+    )
+    with pallas_interpret(_mesh_interpret(mesh)):
+        return fn(disp, rot_t, nyq_row, template, weights, cell_mask)

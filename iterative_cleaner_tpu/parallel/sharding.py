@@ -27,7 +27,8 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
                            pulse_slice, pulse_scale, pulse_active, rotation,
                            baseline_duty, fft_mode, median_impl="sort",
                            stats_frame="dispersed", dedispersed=False,
-                           stats_impl="xla", baseline_mode="profile"):
+                           stats_impl="xla", baseline_mode="profile",
+                           fused_sweep="off", donate=False):
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -71,12 +72,25 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
             # sharded masks must equal the single-chip path's bit-for-bit
             disp_iteration=disp_iteration_enabled(
                 baseline_mode, stats_frame, pulse_active, dedispersed),
+            fused_sweep=(fused_sweep == "on"),
         )
 
+    kwargs = {}
+    if donate:
+        # cube + weights donation on the sharded program: each device's
+        # input shards alias into the loop carry, so the sharded cube
+        # never re-materialises in HBM (same contract as build_clean_fn)
+        from iterative_cleaner_tpu.backends.jax_backend import (
+            silence_unusable_donation_warning,
+        )
+
+        silence_unusable_donation_warning()
+        kwargs["donate_argnums"] = (0, 1)
     fn = jax.jit(
         run,
         in_shardings=(cube_sh, w_sh, rep, rep, rep, rep),
         out_shardings=None,  # let GSPMD place outputs
+        **kwargs,
     )
     return fn, cube_sh, w_sh, rep
 
@@ -127,6 +141,18 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
     median_impl = resolve_median_impl(config.median_impl, dtype)
     stats_impl = resolve_stats_impl(config.stats_impl, dtype,
                                     cube.shape[-1], fft_mode)
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fused_sweep,
+    )
+
+    fused_sweep = resolve_fused_sweep(config.fused_sweep, stats_impl,
+                                      mesh=mesh, shape=cube.shape)
+    # Donate only buffers this call owns (clean_cube's rule): host inputs
+    # become fresh sharded uploads below, while a caller-held jax.Array
+    # would lose its buffer to the donation.
+    donate = (config.donate_buffers
+              and not isinstance(cube, jax.Array)
+              and not isinstance(weights, jax.Array))
     fn, cube_sh, w_sh, rep = build_sharded_clean_fn(
         mesh, config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
@@ -134,6 +160,7 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
         fft_mode, median_impl,
         resolve_stats_frame(config.stats_frame, dtype),
         bool(dedispersed), stats_impl, config.baseline_mode,
+        fused_sweep=fused_sweep, donate=donate,
     )
     with mesh:
         outs = fn(

@@ -439,19 +439,23 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
     # combine runs on the reassembled FULL (nsub, nchan) plane — tiny
     # (nbin-times smaller than any tile), so it stays unsharded.  Two
     # implementations, bit-identical masks/scores:
-    #   * fused (float32, no mesh, --fused-sweep resolves on): the drained
+    #   * fused (float32, --fused-sweep resolves on): the drained
     #     per-tile diagnostic handles stay ON DEVICE, concatenate inside
     #     this one program, and the whole scaler + 4-way median +
     #     threshold/zap tail runs as a single Pallas launch
     #     (fused_combine_pallas) — the four full planes are never
     #     re-uploaded, so per-iteration stream_h2d_bytes drops by
-    #     4 * nsub * nchan * 4 bytes.
+    #     4 * nsub * nchan * 4 bytes.  On the streamed-SHARD path
+    #     (mesh not None) the gathered planes are replicated before the
+    #     launch — plane-sized traffic, not cube-sized, and the masks
+    #     stay bit-equal with the streamed single-device route (the
+    #     combine is the same launch on the same full planes).
     #   * compact (everything else): the stacked-sort scaler keeps this
     #     standalone program's op count — and so its first-iteration
     #     compile latency — down; output is bit-identical to
     #     scale_and_combine (stats/masked_jax.py).
     use_fused_combine = False
-    if mesh is None and dtype == jnp.float32:
+    if dtype == jnp.float32:
         from iterative_cleaner_tpu.backends.jax_backend import (
             resolve_fused_sweep,
         )

@@ -133,11 +133,18 @@ def _line_fold(axis, B, S, C, keepdims=False):
     return fold, unfold
 
 
-def _select_kth(keys, k):
+def _select_kth(keys, k, reduce_sum=None):
     """Exact k-th (0-indexed) smallest int32 key per lane.
 
     keys: (n, t) int32; k: (t,) int32 in [0, n).  32 bisection steps, each a
     count of keys <= mid down the sublane axis.
+
+    ``reduce_sum`` merges the per-step counts across shards of the sublane
+    axis (``lax.psum`` over a mesh axis): every device bisects on the
+    *global* counts, so all devices converge on the identical k-th key of
+    the union — integer adds are exact regardless of reduction order, so
+    the distributed select is bit-equal with the single-device one by
+    construction.  ``None`` (the kernel default) is the local count.
     """
 
     def body(_, state):
@@ -146,6 +153,8 @@ def _select_kth(keys, k):
         mid = (lo >> 1) + (hi >> 1) + (lo & hi & 1)
         cnt = jnp.sum((keys <= mid[None, :]).astype(jnp.int32), axis=0,
                       dtype=jnp.int32)
+        if reduce_sum is not None:
+            cnt = reduce_sum(cnt)
         go_low = cnt >= k + 1
         return jnp.where(go_low, lo, mid + 1), jnp.where(go_low, mid, hi)
 
@@ -155,7 +164,7 @@ def _select_kth(keys, k):
     return lo
 
 
-def _select_adjacent(keys, k_lo, k_hi):
+def _select_adjacent(keys, k_lo, k_hi, reduce_sum=None, reduce_min=None):
     """The ``k_lo``-th and ``k_hi``-th smallest keys where ``k_hi`` is
     ``k_lo`` or ``k_lo + 1`` (the median's two middle ranks).
 
@@ -164,25 +173,42 @@ def _select_adjacent(keys, k_lo, k_hi):
     found key, rank ``k_hi`` is the same key (duplicates straddle the
     middle), otherwise it is the smallest key strictly greater.  ~34 passes
     over the tile instead of the 64 two independent bisections cost — the
-    dominant VPU work of every median/MAD launch."""
-    lo_key = _select_kth(keys, k_lo)
+    dominant VPU work of every median/MAD launch.
+
+    ``reduce_sum``/``reduce_min`` merge the counts and the successor key
+    across shards of the sublane axis (psum/pmin collectives) for the
+    tree-reduced distributed form; both merges are integer ops, so the
+    selected key bit patterns match the single-device select exactly."""
+    lo_key = _select_kth(keys, k_lo, reduce_sum)
     cnt_le = jnp.sum((keys <= lo_key[None, :]).astype(jnp.int32), axis=0,
                      dtype=jnp.int32)
     above = jnp.where(keys > lo_key[None, :], keys, _INT32_MAX)
     succ = jnp.min(above, axis=0)
+    if reduce_sum is not None:
+        cnt_le = reduce_sum(cnt_le)
+        succ = reduce_min(succ)
     hi_key = jnp.where(cnt_le > k_hi, lo_key, succ)
     return lo_key, hi_key
 
 
-def _masked_median_lanes(values, mask):
+def _masked_median_lanes(values, mask, reduce_sum=None, reduce_min=None):
     """Median of the unmasked entries down the sublane axis of one tile:
     the shared core of the standalone median kernel and the fused scaler
-    kernel.  Returns the (t,) medians (0.0 where a line is fully masked)."""
+    kernel.  Returns the (t,) medians (0.0 where a line is fully masked).
+
+    With ``reduce_sum``/``reduce_min`` the sublane axis may be sharded
+    across devices: ranks and counts come from globally merged integers,
+    the float epilogue (``0.5*(lo+hi)``) runs on identical keys on every
+    device — the distributed median is bit-equal with the single-device
+    one."""
     keys = jnp.where(mask, _KEY_MASKED, _ordered_key(values))
     n_valid = jnp.sum((~mask).astype(jnp.int32), axis=0, dtype=jnp.int32)
+    if reduce_sum is not None:
+        n_valid = reduce_sum(n_valid)
     k_lo = jnp.maximum(n_valid - 1, 0) // 2
     k_hi = n_valid // 2
-    lo_key, hi_key = _select_adjacent(keys, k_lo, k_hi)
+    lo_key, hi_key = _select_adjacent(keys, k_lo, k_hi, reduce_sum,
+                                      reduce_min)
     med = np.float32(0.5) * (_key_to_float(lo_key) + _key_to_float(hi_key))
     return jnp.where(n_valid == 0, np.float32(0.0), med), n_valid
 
@@ -192,7 +218,8 @@ def _median_kernel(v_ref, m_ref, out_ref):
     out_ref[0, :] = med
 
 
-def _scaled_sides_body(d0, d1, d2, d3, mask, thresh, plain_mask=None):
+def _scaled_sides_body(d0, d1, d2, d3, mask, thresh, plain_mask=None,
+                       reduce_sum=None, reduce_min=None, reduce_any=None):
     """One orientation of the whole scaler stage for all four diagnostics
     on (n_reduce, T_lines) VMEM arrays: median -> centring -> MAD ->
     epilogue.
@@ -208,18 +235,34 @@ def _scaled_sides_body(d0, d1, d2, d3, mask, thresh, plain_mask=None):
     ``plain_mask`` drops entries from the rFFT diagnostic's *rank
     selection* the way cropping would (the sweep kernel's grid-padding
     rows, which the unpadded route never sees); the default all-false
-    mask IS the existing plain path — rank over every entry."""
+    mask IS the existing plain path — rank over every entry.
+
+    ``reduce_sum``/``reduce_min``/``reduce_any`` distribute the reduction
+    axis over a mesh axis (psum counts, pmin successor keys, global
+    NaN-presence OR).  Only the integer rank machinery crosses devices;
+    every float op runs locally on identical operands, so the distributed
+    orientation is bit-equal with this single-device body."""
     from iterative_cleaner_tpu.stats.masked_jax import (
         _masked_side,
         _patch_nan_lines,
     )
 
+    def patch_nan(stat, values):
+        # _patch_nan_lines with a cross-device NaN presence test: a line
+        # whose NaN lives on another shard must patch on every shard.
+        if reduce_any is None:
+            return _patch_nan_lines(stat, values, 0)
+        has_nan = reduce_any(jnp.any(jnp.isnan(values), axis=0,
+                                     keepdims=True))
+        return jnp.where(has_nan, np.float32(np.nan), stat)
+
     t = np.float32(thresh)
     outs = []
     for d in (d0, d1, d2):
-        med, n_valid = _masked_median_lanes(d, mask)
+        med, n_valid = _masked_median_lanes(d, mask, reduce_sum, reduce_min)
         centred = jnp.where(mask, d, d - med[None, :])
-        mad, _ = _masked_median_lanes(jnp.abs(centred), mask)
+        mad, _ = _masked_median_lanes(jnp.abs(centred), mask, reduce_sum,
+                                      reduce_min)
         outs.append(_masked_side(centred, mad[None, :], mask,
                                  n_valid[None, :], t))
     # the rFFT diagnostic: plain path (quirk 5) — no mask, NaN-bearing
@@ -227,12 +270,11 @@ def _scaled_sides_body(d0, d1, d2, d3, mask, thresh, plain_mask=None):
     # yields IEEE inf/nan that flow onward
     if plain_mask is None:
         plain_mask = jnp.zeros_like(mask)
-    med, _ = _masked_median_lanes(d3, plain_mask)
-    centred = d3 - _patch_nan_lines(med[None, :], d3, 0)
+    med, _ = _masked_median_lanes(d3, plain_mask, reduce_sum, reduce_min)
+    centred = d3 - patch_nan(med[None, :], d3)
     absc = jnp.abs(centred)
-    mad, _ = _masked_median_lanes(absc, plain_mask)
-    outs.append(jnp.abs(centred / _patch_nan_lines(mad[None, :], absc, 0))
-                / t)
+    mad, _ = _masked_median_lanes(absc, plain_mask, reduce_sum, reduce_min)
+    outs.append(jnp.abs(centred / patch_nan(mad[None, :], absc)) / t)
     return outs
 
 
@@ -981,7 +1023,7 @@ class _FusedScaffold:
         return x.reshape(self.ns, self.nc)
 
     def launch(self, kernel, inputs, in_specs, cos_t, sin_t, tt_info,
-               interpret):
+               interpret, scratch_shapes=()):
         outs = pl.pallas_call(
             functools.partial(kernel, num_k=self.num_k),
             out_shape=[jax.ShapeDtypeStruct(
@@ -990,6 +1032,7 @@ class _FusedScaffold:
             grid=self.grid,
             in_specs=list(in_specs) + self._table_specs(cos_t, sin_t),
             out_specs=[self.cell_spec] * 4,
+            scratch_shapes=list(scratch_shapes),
             interpret=interpret,
         )(*inputs, cos_t, sin_t, tt_info)
         return tuple(
@@ -1250,6 +1293,190 @@ def cell_diagnostics_pallas_dedisp(ded, template, window, weights, cell_mask):
     under ``vmap`` like :func:`cell_diagnostics_pallas`."""
     return _fused_dedisp(ded, template, window.astype(jnp.float32),
                          weights.astype(jnp.float32), cell_mask)
+
+
+# ---------------------------------------------------------------------------
+# Per-shard diagnostics with a double-buffered HBM→VMEM DMA pipeline
+# ---------------------------------------------------------------------------
+#
+# The sharded fused sweep (parallel/shard_sweep.py) runs these per-shard:
+# the local cube stays in HBM (memory_space=ANY) and the kernel drives its
+# own two-slot DMA pipeline over the (s_blk, c_blk, nbin) tiles — tile
+# t+1's fetch is issued while tile t computes, the emit_pipeline idiom
+# hand-rolled so the fetch schedule is explicit in the kernel (and so the
+# cube keeps exactly ONE read site for the jaxpr contract: both dma_start
+# sites target the same VMEM scratch buffer).  The kk spectrum axis stays
+# innermost and reuses the resident tile, so each cube byte still crosses
+# the HBM bus exactly once per iteration.
+
+# Env mirror ICLEAN_SWEEP_DMA: 'auto'/'on' drive the per-shard cube fetch
+# through the manual DMA pipeline; 'off' is the escape hatch back to the
+# BlockSpec-pipelined route (same values, different fetch schedule).
+def _sweep_dma_default(value=None) -> bool:
+    v = value
+    if v is None:
+        v = _os.environ.get("ICLEAN_SWEEP_DMA", "auto")
+    if isinstance(v, bool):
+        return v
+    v = str(v).lower()
+    if v not in ("auto", "on", "off"):
+        raise ValueError(f"ICLEAN_SWEEP_DMA must be auto/on/off, got {v!r}")
+    return v != "off"
+
+
+def _fetch_cube_tile(hbm_ref, buf, sem, i, j, kk, nj, n_tiles):
+    """Double-buffered fetch of cube tile (i, j) into VMEM scratch.
+
+    ``buf`` is (2, s_blk, c_blk, nbin) VMEM, ``sem`` a 2-slot DMA
+    semaphore.  Tiles are numbered t = i*nj + j in grid order; tile t
+    lives in slot t % 2.  At each tile's first spectrum step (kk == 0)
+    the kernel waits for tile t (started by the warmup at t == 0, or by
+    tile t-1's prefetch) and immediately starts tile t+1 into the other
+    slot, so the next fetch overlaps this tile's whole compute —
+    including all num_k spectrum steps.  The sequential TPU grid makes
+    slot reuse safe: tile t-1's compute finished before tile t+1's
+    prefetch is issued."""
+    s_blk, c_blk = buf.shape[1], buf.shape[2]
+    t = i * nj + j
+
+    def copy(ti, slot):
+        ii = ti // nj
+        jj = ti % nj
+        return pltpu.make_async_copy(
+            hbm_ref.at[pl.ds(ii * s_blk, s_blk), pl.ds(jj * c_blk, c_blk)],
+            buf.at[slot], sem.at[slot])
+
+    @pl.when((kk == 0) & (t == 0))
+    def _warmup():
+        copy(t, t % 2).start()
+
+    @pl.when(kk == 0)
+    def _advance():
+        copy(t, t % 2).wait()
+
+        @pl.when(t + 1 < n_tiles)
+        def _prefetch():
+            copy(t + 1, (t + 1) % 2).start()
+
+    return buf[t % 2]
+
+
+def _dma_disp_kernel(disp_hbm, rott_ref, nyq_ref, w_ref, m_ref,
+                     cos_ref, sin_ref, tt_ref,
+                     std_ref, mean_ref, ptp_ref, fft_ref,
+                     cube_buf, dma_sem, *, num_k, apply_nyq, nj, n_tiles):
+    """:func:`_cell_stats_disp_kernel` with the cube tile arriving through
+    the manual DMA pipeline instead of a BlockSpec; the compute body is
+    the same function, so the outputs are bit-identical."""
+    i, j, kk = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    block = _fetch_cube_tile(disp_hbm, cube_buf, dma_sem, i, j, kk, nj,
+                             n_tiles)
+    tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
+    wres = _wres_disp(block, rott_ref[0], nyq_ref[0], tt_safe, tt_zero,
+                      w_ref[0], apply_nyq=apply_nyq)
+    _write_diags(wres, m_ref[0], cos_ref, sin_ref,
+                 std_ref, mean_ref, ptp_ref, fft_ref, num_k)
+
+
+def _dma_dedisp_kernel(ded_hbm, t_ref, win_ref, w_ref, m_ref,
+                       cos_ref, sin_ref, tt_ref,
+                       std_ref, mean_ref, ptp_ref, fft_ref,
+                       cube_buf, dma_sem, *, num_k, nj, n_tiles):
+    """:func:`_cell_stats_dedisp_kernel` with the DMA-pipelined cube."""
+    i, j, kk = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    block = _fetch_cube_tile(ded_hbm, cube_buf, dma_sem, i, j, kk, nj,
+                             n_tiles)
+    tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
+    wres = _wres_dedisp(block, t_ref[0], win_ref[0], tt_safe, tt_zero,
+                        w_ref[0])
+    _write_diags(wres, m_ref[0], cos_ref, sin_ref,
+                 std_ref, mean_ref, ptp_ref, fft_ref, num_k)
+
+
+def _dma_scratch(sc):
+    return [pltpu.VMEM((2, sc.s_blk, sc.c_blk, sc.nbin), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,))]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_k", "interpret", "blocks",
+                                    "apply_nyq"))
+def _shard_diags_disp_call(disp, rot_t, nyq_row, tt_info, weights,
+                           cell_mask, cos_t, sin_t, num_k, interpret,
+                           blocks, apply_nyq):
+    sc = _FusedScaffold(*disp.shape[1:], num_k, batch=disp.shape[0],
+                        blocks=blocks)
+    weights, cell_mask = sc.pad_cells(weights, cell_mask)
+    nj = sc.nc // sc.c_blk
+    kernel = functools.partial(_dma_disp_kernel, apply_nyq=apply_nyq,
+                               nj=nj, n_tiles=(sc.ns // sc.s_blk) * nj)
+    return sc.launch(
+        kernel,
+        (sc.pad_cube(disp), sc.pad_chan_row(rot_t),
+         sc.pad_chan_row(nyq_row), weights, cell_mask),
+        (pl.BlockSpec(memory_space=pltpu.ANY), sc.chan_row_spec,
+         sc.chan_row_spec, sc.cell_spec, sc.cell_spec),
+        cos_t, sin_t, tt_info, interpret, scratch_shapes=_dma_scratch(sc),
+    )
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_k", "interpret", "blocks"))
+def _shard_diags_dedisp_call(ded, template, window, tt_info, weights,
+                             cell_mask, cos_t, sin_t, num_k, interpret,
+                             blocks):
+    sc = _FusedScaffold(*ded.shape[1:], num_k, batch=ded.shape[0],
+                        blocks=blocks)
+    weights, cell_mask = sc.pad_cells(weights, cell_mask)
+    nj = sc.nc // sc.c_blk
+    kernel = functools.partial(_dma_dedisp_kernel, nj=nj,
+                               n_tiles=(sc.ns // sc.s_blk) * nj)
+    return sc.launch(
+        kernel,
+        (sc.pad_cube(ded), template, window, weights, cell_mask),
+        (pl.BlockSpec(memory_space=pltpu.ANY), sc.row_spec, sc.row_spec,
+         sc.cell_spec, sc.cell_spec),
+        cos_t, sin_t, tt_info, interpret, scratch_shapes=_dma_scratch(sc),
+    )
+
+
+def sweep_shard_diags_disp(disp, rot_t, nyq_row, template, weights,
+                           cell_mask, dma=None):
+    """Per-shard dispersed-frame one-read diagnostics for the sharded
+    fused sweep: same values as :func:`cell_diagnostics_pallas_disp` with
+    the cube fetched through the double-buffered DMA pipeline (``dma``
+    None resolves the ICLEAN_SWEEP_DMA env mirror; 'off' keeps the
+    BlockSpec route).  Unbatched — the sharded engine runs one archive
+    per shard_map body."""
+    if not _sweep_dma_default(dma):
+        return cell_diagnostics_pallas_disp(disp, rot_t, nyq_row, template,
+                                            weights, cell_mask)
+    apply_nyq = nyq_row is not None
+    if nyq_row is None:
+        nyq_row = jnp.zeros_like(rot_t)
+    cos_t, sin_t, num_k, interpret = _fused_tables(disp.shape[-1],
+                                                   disp.dtype)
+    outs = _shard_diags_disp_call(
+        disp[None], rot_t[None], nyq_row[None], _tt_info(template[None]),
+        weights[None].astype(jnp.float32), cell_mask[None], cos_t, sin_t,
+        num_k, interpret, _cell_blocks(disp.shape[-1]), apply_nyq)
+    return tuple(o[0] for o in outs)
+
+
+def sweep_shard_diags_dedisp(ded, template, window, weights, cell_mask,
+                             dma=None):
+    """Per-shard dedispersed-frame twin of
+    :func:`sweep_shard_diags_disp`."""
+    if not _sweep_dma_default(dma):
+        return cell_diagnostics_pallas_dedisp(ded, template, window,
+                                              weights, cell_mask)
+    cos_t, sin_t, num_k, interpret = _fused_tables(ded.shape[-1], ded.dtype)
+    outs = _shard_diags_dedisp_call(
+        ded[None], template[None], window.astype(jnp.float32)[None],
+        _tt_info(template[None]), weights[None].astype(jnp.float32),
+        cell_mask[None], cos_t, sin_t, num_k, interpret,
+        _cell_blocks(ded.shape[-1]))
+    return tuple(o[0] for o in outs)
 
 
 # ---------------------------------------------------------------------------

@@ -645,6 +645,7 @@ def test_repo_ast_lint_is_clean():
     assert report.files_scanned > 50
 
 
+@pytest.mark.slow
 def test_selfcheck_cli_repo_wide_gate():
     """The shipped gate: ``python -m iterative_cleaner_tpu --selfcheck``
     in a fresh interpreter (deployment config: x64 off) must exit 0 with

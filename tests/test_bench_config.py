@@ -39,6 +39,7 @@ def _run_repo_script(rel_path, *argv, extra_env=()):
         env=env, capture_output=True, text=True, timeout=600)
 
 
+@pytest.mark.slow
 def test_bench_small_end_to_end_json_schema():
     """The driver runs `python bench.py` unattended at round end; a crash
     or malformed JSON there loses the round's benchmark record.  Run the
@@ -46,14 +47,15 @@ def test_bench_small_end_to_end_json_schema():
     contract: one JSON line with the driver-read keys."""
     import json
 
-    # BENCH_SKIP_MULTIHOST / BENCH_SKIP_ELASTIC: those rows launch
-    # several CLI/daemon processes each — more wall-clock than this
-    # tier-1 test's budget allows.  test_bench_multihost_row_keys and
-    # test_bench_elastic_row_keys (slow) pin their keys instead; CI's
+    # BENCH_SKIP_MULTIHOST / BENCH_SKIP_ELASTIC / BENCH_SKIP_MESH: those
+    # rows launch several CLI/daemon processes (or compile the sharded
+    # program twice) — more wall-clock than this tier-1 test's budget
+    # allows.  test_bench_multihost_row_keys, test_bench_elastic_row_keys
+    # and test_bench_mesh_row_keys (slow) pin their keys instead; CI's
     # bench smoke runs the full BENCH_SMALL set including them.
     proc = _run_repo_script("bench.py", extra_env=(
         ("BENCH_SMALL", "1"), ("BENCH_SKIP_MULTIHOST", "1"),
-        ("BENCH_SKIP_ELASTIC", "1")))
+        ("BENCH_SKIP_ELASTIC", "1"), ("BENCH_SKIP_MESH", "1")))
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, proc.stdout
@@ -239,6 +241,30 @@ def test_bench_elastic_row_keys():
 
 
 @pytest.mark.slow
+def test_bench_mesh_row_keys():
+    """The sharded fused-sweep row (shard_mapped one-launch sweep over a
+    forced 4-device CPU cell mesh vs the single-device engine) in
+    isolation: the driver and CI read these keys from the headline JSON.
+    Mask parity and the per-shard single-cube-read budget are rc-7-fatal
+    inside the stage."""
+    import json
+
+    proc = _run_repo_script("bench.py", extra_env=(
+        ("BENCH_MESH_ONLY", json.dumps(
+            {"nsub": 16, "nchan": 32, "nbin": 64, "max_iter": 2})),
+        ("XLA_FLAGS", "--xla_force_host_platform_device_count=4")))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    err = proc.stderr[-3000:]
+    for key in ("mesh_geometry", "mesh_platform", "mesh_devices",
+                "mesh_vs_single", "mesh_sweep_cube_reads"):
+        assert key in out, (key, err)
+    assert out["mesh_devices"] == 4
+    assert out["mesh_vs_single"] > 0
+    assert out["mesh_sweep_cube_reads"] == 1
+
+
+@pytest.mark.slow
 def test_bench_mux_row_keys():
     """The full mux row (100-stream burst through one StreamMux) in
     isolation: the >= 10x aggregate-throughput contract vs N independent
@@ -262,6 +288,7 @@ def test_bench_mux_row_keys():
     assert out["mux_vs_sequential_masks"] == "identical"
 
 
+@pytest.mark.slow
 def test_profile_stages_small_end_to_end():
     """profile_stages.py is step 3 of the queued hardware pass; a crash
     there (e.g. a stage signature drifting from the engine) would waste a

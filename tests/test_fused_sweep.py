@@ -51,8 +51,9 @@ def test_median4_matches_jnp_median_bitwise():
 
 
 @pytest.mark.parametrize("nsub,nchan,nbin,kw", [
-    (12, 10, 32, {}),
-    (8, 128, 64, {}),                      # lane-exact channel count
+    pytest.param(12, 10, 32, {}, marks=pytest.mark.slow),
+    pytest.param(8, 128, 64, {},           # lane-exact channel count
+                 marks=pytest.mark.slow),
     (3, 5, 16, {}),                        # heavy sublane+lane padding
     (12, 10, 32, {"zap_frac": 0.9}),       # nearly-dead plane
     (12, 10, 32, {"nan_template": True}),  # NaN propagation
@@ -195,7 +196,8 @@ def _engine_case():
     return cube, w, (freqs, 26.0, 1400.0, 0.005)
 
 
-@pytest.mark.parametrize("stats_frame", ["auto", "dedispersed"])
+@pytest.mark.parametrize("stats_frame", [
+    pytest.param("auto", marks=pytest.mark.slow), "dedispersed"])
 def test_engine_fused_sweep_masks_bit_equal(stats_frame):
     """clean_cube with --fused-sweep on/auto vs off: final weights,
     scores, loop count and per-iteration metrics all bit-equal — `off` is
@@ -238,6 +240,7 @@ def test_cli_fused_sweep_flag_round_trips():
 
 # ----------------------------------------- streaming / online route parity
 
+@pytest.mark.slow
 def test_streaming_exact_fused_combine_bit_equal_and_fewer_h2d_bytes():
     """Exact streaming with the fused one-launch combine: masks/scores
     bit-equal to the compact-scaler route, and per-run stream_h2d_bytes
@@ -272,6 +275,7 @@ def test_streaming_exact_fused_combine_bit_equal_and_fewer_h2d_bytes():
     np.testing.assert_array_equal(off1.scores, on1.scores)
 
 
+@pytest.mark.slow
 def test_online_session_fused_sweep_reconciles_bit_equal():
     """Per-subint fused sweep step: the provisional mask may change
     flavour (DFT-flavoured diagnostics), but the contractual reconcile

@@ -232,7 +232,9 @@ class TestFusedCellDiagnostics:
                                       np.asarray(b.final_weights))
         assert int(a.loops) == int(b.loops)
 
-    @pytest.mark.parametrize("nbin", [512, 1024, 2048, 4096])
+    @pytest.mark.parametrize("nbin", [
+        pytest.param(512, marks=pytest.mark.slow), 1024, 2048,
+        pytest.param(4096, marks=pytest.mark.slow)])
     def test_fused_long_profiles_match_xla(self, nbin):
         """VERDICT r1 weak item 2: BASELINE config 1 (512 bins) and common
         1024-bin archives must run fused instead of silently falling back.
@@ -262,6 +264,7 @@ class TestFusedCellDiagnostics:
             np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                        rtol=2e-5, atol=2e-4, err_msg=name)
 
+    @pytest.mark.slow
     def test_fused_engine_masks_match_xla_512bins(self):
         from iterative_cleaner_tpu.engine.loop import clean_dedispersed_jax
 
@@ -430,7 +433,8 @@ class TestSublaneTier:
         monkeypatch.setattr(pk, "_S_BLK", "2")
         assert pk._cell_blocks(512) == (2, 128)
 
-    @pytest.mark.parametrize("nbin", [64, 512, 2048])
+    @pytest.mark.parametrize("nbin", [
+        64, pytest.param(512, marks=pytest.mark.slow), 2048])
     def test_sublane_diagnostics_match_xla(self, nbin, monkeypatch):
         from iterative_cleaner_tpu.stats import pallas_kernels as pk
 

@@ -26,7 +26,9 @@ def test_dft_matches_fft():
         rfft_magnitudes(x, "welch")
 
 
-@pytest.mark.parametrize("n", [8, 4, 2])
+@pytest.mark.parametrize("n", [
+    pytest.param(8, marks=pytest.mark.slow),
+    pytest.param(4, marks=pytest.mark.slow), 2])
 def test_dryrun_multichip(n, monkeypatch):
     sys.path.insert(0, "/root/repo")
     import __graft_entry__ as g
@@ -351,9 +353,10 @@ def test_streaming_vs_whole_mask_drift_bounded():
 
 
 @pytest.mark.parametrize("backend,dtype,bmode", [
-    ("numpy", None, "integration"), ("jax", "float64", "integration"),
+    ("numpy", None, "integration"),
+    pytest.param("jax", "float64", "integration", marks=pytest.mark.slow),
     ("jax", "float32", "integration"), ("numpy", None, "profile"),
-    ("jax", "float64", "profile")])
+    pytest.param("jax", "float64", "profile", marks=pytest.mark.slow)])
 def test_streaming_exact_masks_bit_equal_to_whole(backend, dtype, bmode):
     """The two-pass exact mode (VERDICT r2 #4): masks bit-equal to
     whole-archive cleaning on every backend and both baseline estimators

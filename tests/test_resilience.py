@@ -335,6 +335,7 @@ def test_on_error_callback_exception_is_swallowed(tmp_path):
     assert reg.counters["fleet_callback_errors"] == 1
 
 
+@pytest.mark.slow
 def test_fault_soak_bit_equal_and_exactly_once(tmp_path):
     """12 mixed-geometry archives under deterministic faults at every
     site: the run terminates well inside a global deadline, recovers
@@ -511,6 +512,7 @@ def _run_cli(args, tmp_path, **env):
         cwd=str(tmp_path), capture_output=True, text=True, timeout=240)
 
 
+@pytest.mark.slow
 def test_kill9_then_resume_no_duplicate_cleans(tmp_path):
     """The crash-safety contract end-to-end through the real CLI: wedge a
     fleet run mid-serve with a hang fault, ``kill -9`` it, rerun with

@@ -691,6 +691,7 @@ def _assert_outputs_bit_equal(paths, ref_paths, ext):
             assert a.read() == b.read(), os.path.basename(out)
 
 
+@pytest.mark.slow
 def test_serve_kill9_restart_zero_duplicate_cleans(tmp_path):
     """The daemon's crash contract end-to-end: wedge a request mid-fleet
     with a hang fault, ``kill -9`` the daemon, restart it — the journaled
@@ -809,6 +810,7 @@ def test_serve_second_sigterm_forces_nonzero_exit(tmp_path):
     assert proc.wait(timeout=60) == FORCE_EXIT_CODE
 
 
+@pytest.mark.slow
 def test_serve_fault_soak_masks_bit_equal(tmp_path):
     """Deterministic serve-layer fault soak: intake, scheduler, load and
     execute faults all fire; the daemon never wedges, keeps answering
@@ -1027,6 +1029,7 @@ def test_daemon_stream_http_flow_and_parity(tmp_path):
     assert not t.is_alive()
 
 
+@pytest.mark.slow
 def test_serve_stream_kill9_resume_zero_duplicate_ingests(tmp_path):
     """The stream crash contract: SIGKILL a daemon holding an open stream
     mid-ingest, restart it in the same cwd — the journaled chunks replay

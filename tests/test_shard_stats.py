@@ -112,7 +112,7 @@ def _archive(nsub=16, nchan=32, nbin=64, seed=3):
 
 
 @pytest.mark.parametrize("stats_frame,rotation", [
-    ("dispersed", "roll"),
+    pytest.param("dispersed", "roll", marks=pytest.mark.slow),
     ("dispersed", "fourier"),   # default rotation: exercises the sharded
                                 # Nyquist-correction rows (_CHAN_ROW
                                 # nyq_row wiring of the disp_iteration
@@ -186,3 +186,109 @@ def test_uneven_grid_fails_fast():
             clean_cube_sharded(ar.total_intensity(), ar.weights,
                                ar.freqs_mhz, ar.dm, ar.centre_freq_mhz,
                                ar.period_s, cfg, mesh)
+
+
+# --- tree-reduced kth-select merges (the sharded fused sweep's combine) ----
+
+def _tree_median(values, mask, n_shards):
+    """tree_masked_median_lanes over a 1-D ('sub',) mesh of n_shards."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from iterative_cleaner_tpu.parallel.mesh import shard_map_compat
+    from iterative_cleaner_tpu.parallel.shard_stats import (
+        tree_masked_median_lanes,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:n_shards]), ("sub",))
+    fn = shard_map_compat(
+        lambda v, m: tree_masked_median_lanes(v, m, "sub"),
+        mesh=mesh, in_specs=(P("sub", None), P("sub", None)),
+        out_specs=(P(), P()), check_vma=False)
+    return jax.jit(fn)(values, mask)
+
+
+def _single_median(values, mask):
+    from iterative_cleaner_tpu.stats.pallas_kernels import (
+        _masked_median_lanes,
+    )
+
+    return jax.jit(_masked_median_lanes)(values, mask)
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4])
+def test_tree_median_matches_single(n_shards):
+    """The psum/pmin-merged kth-select walks the identical global
+    bisection: medians and counts bit-equal with the single-device
+    select at every shard count."""
+    rng = np.random.default_rng(7)
+    values = jnp.asarray(rng.normal(size=(16, 128)).astype(np.float32))
+    mask = jnp.asarray(rng.random((16, 128)) < 0.2)
+    med, n = _single_median(values, mask)
+    got_med, got_n = _tree_median(values, mask, n_shards)
+    np.testing.assert_array_equal(np.asarray(med), np.asarray(got_med))
+    np.testing.assert_array_equal(np.asarray(n), np.asarray(got_n))
+
+
+def test_tree_median_all_masked_shard():
+    """A shard whose every entry is masked contributes zero counts and
+    +inf successor keys — the merge must still land on the other shards'
+    exact median (and the all-masked LANES must come out 0.0)."""
+    rng = np.random.default_rng(8)
+    values = jnp.asarray(rng.normal(size=(8, 64)).astype(np.float32))
+    mask = np.zeros((8, 64), bool)
+    mask[:4] = True             # shard 0 of 2 entirely masked
+    mask[:, 5] = True           # one lane fully masked everywhere
+    mask = jnp.asarray(mask)
+    med, n = _single_median(values, mask)
+    got_med, got_n = _tree_median(values, mask, 2)
+    np.testing.assert_array_equal(np.asarray(med), np.asarray(got_med))
+    np.testing.assert_array_equal(np.asarray(n), np.asarray(got_n))
+    assert np.asarray(got_med)[5] == 0.0
+
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_tree_median_uneven_remainder_via_padding(n_shards):
+    """shard_map needs equal shards, so an uneven reduction axis ships
+    as masked padding rows: ranks come from the global valid count, so
+    the padded distributed median equals the unpadded single-device one
+    bit-for-bit."""
+    rng = np.random.default_rng(9)
+    n_real = 10                 # not divisible by 4
+    values = jnp.asarray(rng.normal(size=(n_real, 32)).astype(np.float32))
+    mask = jnp.asarray(rng.random((n_real, 32)) < 0.1)
+    med, n = _single_median(values, mask)
+    pad = (-n_real) % n_shards
+    vpad = jnp.pad(values, ((0, pad), (0, 0)))
+    mpad = jnp.pad(mask, ((0, pad), (0, 0)), constant_values=True)
+    got_med, got_n = _tree_median(vpad, mpad, n_shards)
+    np.testing.assert_array_equal(np.asarray(med), np.asarray(got_med))
+    np.testing.assert_array_equal(np.asarray(n), np.asarray(got_n))
+
+
+def test_tree_combine_zap_matches_combine_zap():
+    """The XLA-level distributed iteration tail equals the in-kernel
+    _combine_zap on unpadded planes (both jitted — the flavor the engine
+    always runs)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from iterative_cleaner_tpu.parallel.mesh import shard_map_compat
+    from iterative_cleaner_tpu.parallel.shard_stats import tree_combine_zap
+    from iterative_cleaner_tpu.stats.pallas_kernels import _combine_zap
+
+    diags, mask = _diagnostics()
+    rng = np.random.default_rng(11)
+    worig = jnp.asarray(
+        rng.uniform(0.5, 2.0, size=mask.shape).astype(np.float32))
+    expect = jax.jit(
+        lambda *a: _combine_zap(*a[:4], a[4], a[5], 5.0, 3.0, None)
+    )(*diags, mask, worig)
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs).reshape(2, 2), ("sub", "chan"))
+    fn = shard_map_compat(
+        lambda *a: tree_combine_zap(a[:4], a[4], a[5], 5.0, 3.0),
+        mesh=mesh,
+        in_specs=(P("sub", "chan"),) * 6,
+        out_specs=(P("sub", "chan"),) * 2, check_vma=False)
+    got = jax.jit(fn)(*diags, mask, worig)
+    for e, g in zip(expect, got):
+        np.testing.assert_array_equal(np.asarray(e), np.asarray(g))

@@ -1,0 +1,312 @@
+"""Pod-scale sharded fused sweep (parallel/shard_sweep.py) on the forced
+8-device virtual CPU mesh: the one-launch iteration tail sharded over the
+('sub', 'chan') cell grid, per-shard diagnostics staged through the
+double-buffered HBM→VMEM DMA pipeline, cross-device combine as
+tree-reduced kth-select merges.
+
+The central contract is inherited from the single-device sweep
+(tests/test_fused_sweep.py) and extended across the mesh: masks and
+scores are BIT-EQUAL to the single-device fused sweep — and so to the
+multi-kernel route — at every mesh shape, frame, and Nyquist mode.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from iterative_cleaner_tpu.parallel.mesh import cell_mesh
+from iterative_cleaner_tpu.parallel.shard_sweep import (
+    sharded_fused_sweep,
+    sharded_fused_sweep_dedisp,
+    sharded_sweep_eligible,
+    sweep_downgrade_reason,
+)
+from iterative_cleaner_tpu.stats import pallas_kernels as pk
+
+CH, ST = 4.0, 4.0
+
+
+def _case(nsub=8, nchan=16, nbin=32, seed=3):
+    rng = np.random.default_rng(seed)
+    f32 = np.float32
+    ded = jnp.asarray(rng.normal(size=(nsub, nchan, nbin)).astype(f32))
+    disp = jnp.asarray(rng.normal(size=(nsub, nchan, nbin)).astype(f32))
+    rot_t = jnp.asarray(rng.normal(size=(nchan, nbin)).astype(f32))
+    nyq = jnp.asarray((rng.normal(size=(nchan, nbin)) * 0.01).astype(f32))
+    t = jnp.asarray(rng.normal(size=(nbin,)).astype(f32))
+    win = jnp.asarray((np.arange(nbin) < nbin // 3).astype(f32))
+    w = rng.uniform(0.5, 2.0, size=(nsub, nchan)).astype(f32)
+    w[rng.uniform(size=(nsub, nchan)) < 0.2] = 0.0
+    m = w == 0
+    return ded, disp, rot_t, nyq, t, win, jnp.asarray(w), jnp.asarray(m)
+
+
+def _assert_triple_equal(got, want):
+    for name, g, e in zip(("new_weights", "scores", "d_std"), got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(e),
+                                      err_msg=name)
+
+
+# ------------------------------------------------ kernel-level mesh parity
+
+@pytest.mark.parametrize("ndev", [2, 8])
+def test_sharded_sweep_dedispersed_bit_equal(ndev):
+    """Dedispersed-frame sharded sweep vs the single-device one-launch
+    kernel, both jitted (the flavour the engine always runs)."""
+    ded, _, _, _, t, win, w, m = _case()
+    want = jax.jit(lambda *a: pk.fused_sweep_pallas_dedisp(*a, CH, ST))(
+        ded, t, win, w, m)
+    mesh = cell_mesh(ndev)
+    assert sharded_sweep_eligible(mesh, *ded.shape)
+    got = jax.jit(lambda *a: sharded_fused_sweep_dedisp(mesh, *a, CH, ST))(
+        ded, t, win, w, m)
+    _assert_triple_equal(got, want)
+
+
+@pytest.mark.parametrize("apply_nyq", [False, True])
+def test_sharded_sweep_dispersed_bit_equal(apply_nyq):
+    """Dispersed-frame sharded sweep (per-channel rotated template +
+    optional Nyquist rows riding the 'chan' axis) vs single-device."""
+    _, disp, rot_t, nyq, t, _, w, m = _case(seed=5)
+    nyq_row = nyq if apply_nyq else None
+    want = jax.jit(lambda *a: pk.fused_sweep_pallas(
+        a[0], a[1], nyq_row, a[2], a[3], a[4], CH, ST))(disp, rot_t, t, w, m)
+    mesh = cell_mesh(8)  # (2, 4): both axes genuinely sharded
+    got = jax.jit(lambda *a: sharded_fused_sweep(
+        mesh, a[0], a[1], nyq_row, a[2], a[3], a[4], CH, ST))(
+        disp, rot_t, t, w, m)
+    _assert_triple_equal(got, want)
+
+
+# ------------------------------------------- DMA pipeline vs BlockSpec route
+
+def test_shard_diags_dma_matches_blockspec():
+    """The manual double-buffered HBM→VMEM fetch computes on exactly the
+    tiles the BlockSpec pipeline would deliver: all four diagnostic
+    planes bit-equal with ICLEAN_SWEEP_DMA on vs off, both frames."""
+    ded, disp, rot_t, nyq, t, win, w, m = _case(seed=9)
+    on = pk.sweep_shard_diags_dedisp(ded, t, win, w, m, dma=True)
+    off = pk.sweep_shard_diags_dedisp(ded, t, win, w, m, dma=False)
+    for k, (a, b) in enumerate(zip(on, off)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"dedisp diag {k}")
+    on = pk.sweep_shard_diags_disp(disp, rot_t, nyq, t, w, m, dma=True)
+    off = pk.sweep_shard_diags_disp(disp, rot_t, nyq, t, w, m, dma=False)
+    for k, (a, b) in enumerate(zip(on, off)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"disp diag {k}")
+
+
+def test_sweep_dma_env_mirror(monkeypatch):
+    from iterative_cleaner_tpu.stats.pallas_kernels import _sweep_dma_default
+
+    monkeypatch.delenv("ICLEAN_SWEEP_DMA", raising=False)
+    assert _sweep_dma_default() is True            # auto -> DMA pipeline
+    assert _sweep_dma_default("on") is True
+    assert _sweep_dma_default("off") is False      # escape hatch
+    monkeypatch.setenv("ICLEAN_SWEEP_DMA", "off")
+    assert _sweep_dma_default() is False
+    monkeypatch.setenv("ICLEAN_SWEEP_DMA", "sideways")
+    with pytest.raises(ValueError, match="ICLEAN_SWEEP_DMA"):
+        _sweep_dma_default()
+
+
+# ------------------------------------------------------- eligibility ladder
+
+def test_sweep_downgrade_reasons():
+    mesh = cell_mesh(8)  # (2, 4)
+    assert sweep_downgrade_reason(mesh, 8, 16, 32) is None
+    assert sharded_sweep_eligible(mesh, 8, 16, 32)
+    # a mesh axis that does not divide its grid dimension
+    assert sweep_downgrade_reason(mesh, 9, 16, 32) == "mesh_indivisible"
+    assert sweep_downgrade_reason(mesh, 8, 18, 32) == "mesh_indivisible"
+    # divisible, but the LOCAL shard busts the single-device budget
+    assert not pk.fused_sweep_eligible(20000, 4096, 64)
+    assert sweep_downgrade_reason(cell_mesh(1), 20000, 4096, 64) \
+        == "shard_geometry"
+    assert not sharded_sweep_eligible(cell_mesh(1), 20000, 4096, 64)
+
+
+def test_resolve_fused_sweep_mesh_rung(monkeypatch):
+    """'auto' resolves 'off' when the mesh rung fails — the program never
+    requests what the engine would refuse; explicit 'on' passes through
+    (the engine downgrades, the CLI surfaces it)."""
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fused_sweep,
+    )
+
+    monkeypatch.delenv("ICLEAN_FUSED_SWEEP", raising=False)
+    mesh = cell_mesh(8)
+    good, bad = (8, 16, 32), (9, 16, 32)
+    assert resolve_fused_sweep("auto", "fused", mesh=mesh,
+                               shape=good) == "on"
+    assert resolve_fused_sweep("auto", "fused", mesh=mesh,
+                               shape=bad) == "off"
+    assert resolve_fused_sweep("on", "fused", mesh=mesh, shape=bad) == "on"
+    assert resolve_fused_sweep("auto", "xla", mesh=mesh, shape=good) \
+        == "off"
+
+
+def test_cli_downgrade_notice(capsys):
+    """--fused-sweep on over an ineligible mesh: one visible line + the
+    fused_sweep_ineligible{reason=} counter; 'auto' stays silent."""
+    from iterative_cleaner_tpu.cli import _notice_sweep_downgrade
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.telemetry import MetricsRegistry
+
+    class _Tel:
+        registry = MetricsRegistry()
+
+    tel = _Tel()
+    mesh = cell_mesh(8)
+    reason = _notice_sweep_downgrade(
+        CleanConfig(fused_sweep="on"), mesh, (9, 16, 32),
+        quiet=False, telemetry=tel)
+    assert reason == "mesh_indivisible"
+    out = capsys.readouterr().out
+    assert "fused sweep ineligible" in out and "mesh_indivisible" in out
+    counters = tel.registry.snapshot()["counters"]
+    assert counters[
+        'fused_sweep_ineligible{reason=mesh_indivisible}'] == 1
+    # auto never promised the sweep: no notice, no counter
+    assert _notice_sweep_downgrade(
+        CleanConfig(fused_sweep="auto"), mesh, (9, 16, 32),
+        quiet=False, telemetry=tel) is None
+    assert capsys.readouterr().out == ""
+    # eligible geometry: quiet regardless of knob
+    assert _notice_sweep_downgrade(
+        CleanConfig(fused_sweep="on"), mesh, (8, 16, 32),
+        quiet=False, telemetry=tel) is None
+    assert capsys.readouterr().out == ""
+
+
+# ------------------------------------------------------ engine-level parity
+
+def _archive(nsub=8, nchan=16, nbin=64, seed=23, **kw):
+    from iterative_cleaner_tpu.io.synthetic import make_synthetic_archive
+
+    ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                   seed=seed, dtype=np.float32, **kw)
+    return ar
+
+
+def test_sharded_engine_sweep_masks_bit_equal():
+    """clean_cube_sharded with the sweep engaged (stats_impl='fused',
+    --fused-sweep on) vs the single-device fused-sweep engine: final
+    weights and loop count bit-equal — the acceptance contract of the
+    sharded sweep in one run.  Scores may move at float32 ulp scale
+    (the sharded engine's template comes from a psum whose summation
+    order regroups — same caveat as test_parallel.py's exact mode);
+    masks must not."""
+    from iterative_cleaner_tpu.backends.jax_backend import clean_cube
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.parallel.sharding import clean_cube_sharded
+
+    ar = _archive()
+    cfg = CleanConfig(backend="jax", dtype="float32", stats_impl="fused",
+                      fft_mode="dft", median_impl="pallas",
+                      fused_sweep="on", rotation="roll", max_iter=3,
+                      stats_frame="dedispersed")
+    single = clean_cube(ar.total_intensity(), ar.weights, ar.freqs_mhz,
+                        ar.dm, ar.centre_freq_mhz, ar.period_s, cfg)
+    sharded = clean_cube_sharded(ar.total_intensity(), ar.weights,
+                                 ar.freqs_mhz, ar.dm, ar.centre_freq_mhz,
+                                 ar.period_s, cfg, cell_mesh(8))
+    np.testing.assert_array_equal(single.final_weights,
+                                  sharded.final_weights)
+    np.testing.assert_allclose(single.scores, sharded.scores,
+                               rtol=1e-4, atol=1e-6)
+    assert sharded.loops == single.loops
+    assert sharded.converged == single.converged
+
+
+@pytest.mark.slow
+def test_sharded_engine_sweep_dispersed_frame_bit_equal():
+    """The dispersed-frame (disp_iteration) sharded sweep through the
+    full engine — the production default-config route at pod scale."""
+    from iterative_cleaner_tpu.backends.jax_backend import clean_cube
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.parallel.sharding import clean_cube_sharded
+
+    ar = _archive(seed=29)
+    cfg = CleanConfig(backend="jax", dtype="float32", stats_impl="fused",
+                      fft_mode="dft", median_impl="pallas",
+                      fused_sweep="on", rotation="roll", max_iter=3)
+    single = clean_cube(ar.total_intensity(), ar.weights, ar.freqs_mhz,
+                        ar.dm, ar.centre_freq_mhz, ar.period_s, cfg)
+    sharded = clean_cube_sharded(ar.total_intensity(), ar.weights,
+                                 ar.freqs_mhz, ar.dm, ar.centre_freq_mhz,
+                                 ar.period_s, cfg, cell_mesh(8))
+    np.testing.assert_array_equal(single.final_weights,
+                                  sharded.final_weights)
+    np.testing.assert_allclose(single.scores, sharded.scores,
+                               rtol=1e-4, atol=1e-6)
+
+
+# -------------------------------------------------- streamed-shard parity
+
+def test_streamed_shard_fused_combine_bit_equal():
+    """The >HBM route: exact streaming over a cell mesh with the fused
+    one-launch combine engaged — masks bit-equal with the streamed
+    single-device route (which is itself bit-equal with whole-archive
+    cleaning)."""
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.parallel.streaming import clean_streaming
+
+    ar = _archive(nsub=16, nchan=16, nbin=32, seed=31, n_rfi_cells=8,
+                  n_prezapped=4)
+    cfg = CleanConfig(backend="jax", dtype="float32", stats_impl="fused",
+                      fft_mode="dft", median_impl="sort",
+                      fused_sweep="on", rotation="roll",
+                      chanthresh=2.5, subintthresh=2.5, max_iter=3)
+    single = clean_streaming(ar, 8, cfg, None, mode="exact")
+    sharded = clean_streaming(ar, 8, cfg, cell_mesh(4), mode="exact")
+    np.testing.assert_array_equal(single.final_weights,
+                                  sharded.final_weights)
+    np.testing.assert_array_equal(single.scores, sharded.scores)
+    assert sharded.loops == single.loops
+
+
+# --------------------------------------------------------- jaxpr contracts
+
+@pytest.mark.slow
+def test_sharded_sweep_hot_program_contract_green():
+    """The registered sharded_sweep contract: callback-free, donation
+    realized on the sharded program, and ONE cube read per per-shard
+    kernel — counted through the DMA pipeline's destination buffers."""
+    from iterative_cleaner_tpu.analysis.jaxpr_contracts import (
+        verify_hot_programs,
+    )
+
+    (report,) = verify_hot_programs(["sharded_sweep"])
+    # x64 is on under pytest (conftest): filter no-f64 exactly as the
+    # fused_sweep contract test does; the deployment flavour is covered
+    # by the selfcheck CLI subprocess test.
+    bad = [v for v in report.violations if v.contract != "no-f64"]
+    assert not bad, [v.render() for v in bad]
+    assert report.eqn_count > 0
+
+
+def test_dma_kernel_single_cube_read_counts():
+    """Both per-shard DMA kernels stage the cube tile through exactly ONE
+    VMEM scratch destination (the single-read budget, proven on the
+    traced jaxpr through the cond-nested dma_start sites)."""
+    from iterative_cleaner_tpu.analysis.jaxpr_contracts import (
+        _count_cube_ref_reads,
+    )
+
+    f32 = jnp.float32
+    ns, nc, nb = 4, 8, 32
+    cube = jax.ShapeDtypeStruct((ns, nc, nb), f32)
+    plane = jax.ShapeDtypeStruct((ns, nc), f32)
+    mask = jax.ShapeDtypeStruct((ns, nc), jnp.bool_)
+    row = jax.ShapeDtypeStruct((nb,), f32)
+    rows = jax.ShapeDtypeStruct((nc, nb), f32)
+    ded = jax.make_jaxpr(lambda d, t, win, w, m: pk.sweep_shard_diags_dedisp(
+        d, t, win, w, m, dma=True))(cube, row, row, plane, mask)
+    assert _count_cube_ref_reads(ded) == [1]
+    disp = jax.make_jaxpr(lambda d, rt, nq, t, w, m: pk.sweep_shard_diags_disp(
+        d, rt, nq, t, w, m, dma=True))(cube, rows, rows, row, plane, mask)
+    assert _count_cube_ref_reads(disp) == [1]

@@ -161,6 +161,7 @@ def _run_cli(args, cwd):
         capture_output=True, text=True, timeout=300)
 
 
+@pytest.mark.slow
 def test_warm_restart_cli_zero_real_compiles(tmp_path):
     """Serve the same mixed-shape fleet twice through the real CLI, two
     fresh processes sharing one --compile-cache directory: the second run
@@ -276,6 +277,7 @@ def test_donation_retrace_after_donated_call():
                                   results[1].final_weights)
 
 
+@pytest.mark.slow
 def test_donation_shrinks_peak_bytes():
     """Donation must show up in the compiled program's memory analysis:
     a non-zero input/output alias and no larger a peak than the
