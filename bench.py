@@ -1779,6 +1779,151 @@ def bench_fused(nsub, nchan, nbin, max_iter=3, chunk=None):
     }
 
 
+def _bf16_exact_archive(nsub, nchan, nbin, seed=0):
+    """Synthetic archive whose WHOLE engine pipeline is bf16-lossless by
+    construction, so the fp32 and bf16 compute paths see bit-identical
+    values: every sample sits on the bfloat16 grid, dm=0 (zero channel
+    shifts; rotation='roll' is then the identity permutation), and the
+    last quarter of every profile is exactly zero — with all samples
+    non-negative the baseline finder's min-mean window lands on (or ties
+    with) that zero run, so the subtracted baseline is exactly 0 and the
+    prepared cube equals the raw one.  RFI spikes stay inside the first
+    half so they cannot perturb the window, and per-subint/per-channel
+    gain slopes keep the cross-cell robust stats non-degenerate."""
+    from iterative_cleaner_tpu.io.synthetic import (
+        bench_rfi_density,
+        make_synthetic_archive,
+    )
+
+    ar, _ = make_synthetic_archive(
+        nsub=nsub, nchan=nchan, nbin=nbin, seed=seed, dtype=np.float32,
+        dm=0.0, disperse=False)
+    rng = np.random.default_rng(seed)
+    phase = (np.arange(nbin) + 0.5) / nbin
+    profile = np.exp(-0.5 * ((phase - 0.3) / 0.05) ** 2)
+    spectrum = 1.0 + 0.5 * np.arange(nchan) / nchan
+    gain = 1.0 + 0.3 * np.arange(nsub) / max(1, nsub)
+    cube = (30.0 * gain[:, None, None] * spectrum[None, :, None]
+            * profile[None, None, :]).astype(np.float32)
+    cube[:, :, 3 * nbin // 4:] = 0.0    # the guaranteed-zero window
+    n_rfi = bench_rfi_density(nsub, nchan)["n_rfi_cells"]
+    cells = rng.choice(nsub * nchan, size=n_rfi, replace=False)
+    for s, c in zip(*np.unravel_index(cells, (nsub, nchan))):
+        bins = rng.integers(0, nbin // 2, size=max(1, nbin // 16))
+        cube[s, c, bins] += 40.0
+    import jax.numpy as jnp
+
+    ar.data[:, 0] = np.asarray(
+        jnp.asarray(cube, jnp.bfloat16).astype(jnp.float32))
+    ar.dm = 0.0
+    return ar
+
+
+def bench_bf16(nsub, nchan, nbin, max_iter=3):
+    """Mixed-precision row (``--compute-dtype bfloat16``): the bf16-stored
+    cube hot path against the fp32 default, same fused-sweep engine, same
+    archive, both warm.
+
+    ``bf16_vs_fp32`` is warm best-of-2 wall clock; on CPU the interpret-
+    mode kernels make it an overhead document, not a win claim — the TPU
+    number comes from tpu_validation_pass.sh step 9.  The CPU-provable
+    wins ARE asserted because they are deterministic: mask parity on a
+    bf16-exact archive (storage is lossless there, so every fp32
+    accumulation sees identical values and the masks are bit-equal by
+    construction — rc-7 fatal), and the traced fused program's cube-tile
+    read traffic at <= 0.6x the fp32 program's (``bf16_cube_bytes_ratio``,
+    counted from the kernel block avals by the --selfcheck contract
+    helper; bf16 tiles are half the bytes, so the true ratio is 0.5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.analysis.jaxpr_contracts import (
+        _cube_pallas_read_bytes,
+    )
+    from iterative_cleaner_tpu.backends import clean_archive
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        build_clean_fn,
+        resolve_compute_dtype,
+        resolve_fft_mode,
+        resolve_median_impl,
+        resolve_stats_frame,
+        resolve_stats_impl,
+    )
+    from iterative_cleaner_tpu.config import CleanConfig
+
+    # the bf16 rung must actually be ON for this row to mean anything: a
+    # parity-probe downgrade on this backend IS a parity failure (rc 7)
+    resolved = resolve_compute_dtype("bfloat16", jnp.float32, stage="bench")
+    assert resolved == "bfloat16", (
+        "compute_dtype parity probe downgraded bf16 to %s on this backend"
+        % resolved)
+
+    ar = _bf16_exact_archive(nsub, nchan, nbin, seed=0)
+    base = dict(backend="jax", dtype="float32", stats_impl="fused",
+                fft_mode="dft", median_impl="pallas", fused_sweep="on",
+                rotation="roll", max_iter=max_iter)
+    results, times = {}, {}
+    for mode in ("bfloat16", "float32"):
+        cfg = CleanConfig(compute_dtype=mode, **base)
+        clean_archive(ar.clone(), cfg)          # compile + warm
+        for _ in range(2):                      # warm best-of-2
+            t0 = time.perf_counter()
+            results[mode] = clean_archive(ar.clone(), cfg)
+            dt = time.perf_counter() - t0
+            times[mode] = min(times.get(mode, dt), dt)
+    assert np.array_equal(results["bfloat16"].final_weights,
+                          results["float32"].final_weights), (
+        "bf16 masks diverged from fp32 on a bf16-exact archive (%d cells)"
+        % int(np.sum(results["bfloat16"].final_weights
+                     != results["float32"].final_weights)))
+
+    # trace-level cube read traffic, bf16 storage vs fp32 — deterministic
+    # on any backend (cost_analysis would mis-attribute the in-kernel
+    # upcast as extra traffic on CPU)
+    c = CleanConfig(**base)
+    dtype = jnp.dtype(c.dtype)
+    fft_mode = resolve_fft_mode(c.fft_mode, dtype)
+
+    def cube_bytes(compute_dtype):
+        fn = build_clean_fn(
+            c.max_iter, c.chanthresh, c.subintthresh, c.pulse_slice,
+            c.pulse_scale, c.pulse_region_active, c.rotation,
+            c.baseline_duty, c.unload_res, fft_mode,
+            resolve_median_impl(c.median_impl, dtype),
+            resolve_stats_impl(c.stats_impl, dtype, nbin, fft_mode),
+            resolve_stats_frame(c.stats_frame, dtype), False,
+            c.baseline_mode, donate=True, fused_sweep="on",
+            compute_dtype=compute_dtype)
+        f32 = jnp.float32
+        avals = (jax.ShapeDtypeStruct((nsub, nchan, nbin), f32),
+                 jax.ShapeDtypeStruct((nsub, nchan), f32),
+                 jax.ShapeDtypeStruct((nchan,), f32),
+                 jax.ShapeDtypeStruct((), f32),
+                 jax.ShapeDtypeStruct((), f32),
+                 jax.ShapeDtypeStruct((), f32))
+        return _cube_pallas_read_bytes(jax.make_jaxpr(fn)(*avals))
+
+    b_bf16, b_f32 = cube_bytes("bfloat16"), cube_bytes("float32")
+    assert 0 < b_bf16 <= 0.6 * b_f32, (
+        "bf16 storage no longer shrinks the traced cube read bytes: "
+        "%d vs %d" % (b_bf16, b_f32))
+    ratio = b_bf16 / b_f32
+
+    _log(f"bf16 ({nsub}x{nchan}x{nbin}): warm best-of-2 "
+         f"{times['bfloat16'] * 1e3:.1f} ms bf16 vs "
+         f"{times['float32'] * 1e3:.1f} ms fp32 "
+         f"({times['bfloat16'] / times['float32']:.2f}x), cube read bytes "
+         f"{b_bf16} vs {b_f32} ({ratio:.2f}x), masks bit-equal")
+    return {
+        "bf16_geometry": f"{nsub}x{nchan}x{nbin}",
+        "bf16_platform": jax.default_backend(),
+        "bf16_vs_fp32": round(times["bfloat16"] / times["float32"], 3),
+        "bf16_cube_bytes_ratio": round(ratio, 3),
+        "bf16_cube_read_bytes": int(b_bf16),
+        "bf16_fp32_cube_read_bytes": int(b_f32),
+    }
+
+
 def bench_mesh(nsub, nchan, nbin, max_iter=3):
     """Sharded fused-sweep row (parallel/shard_sweep.py): the one-launch
     sweep shard_mapped over a cell mesh vs the same engine on one device,
@@ -1953,6 +2098,7 @@ def main():
                            ("BENCH_ONLINE_ONLY", bench_online),
                            ("BENCH_MUX_ONLY", bench_mux),
                            ("BENCH_FUSED_ONLY", bench_fused),
+                           ("BENCH_BF16_ONLY", bench_bf16),
                            ("BENCH_MESH_ONLY", bench_mesh),
                            ("BENCH_MULTIHOST_ONLY", bench_multihost),
                            ("BENCH_ELASTIC_ONLY", bench_elastic)):
@@ -2119,6 +2265,24 @@ def main():
         label="fused")
     if row:
         extras = {**(extras or {}), **row}
+
+    # mixed-precision row (--compute-dtype bfloat16): bf16 cube storage vs
+    # the fp32 default through the same fused-sweep engine, mask parity on
+    # a bf16-exact archive and the deterministic half-the-cube-bytes trace
+    # contract — parity-is-fatal like the rows above.  BENCH_SKIP_BF16=1
+    # opts out: the stage compiles the engine twice, which the tier-1
+    # bench-schema test cannot afford inside its wall-clock budget
+    # (tests/test_bench_config.py pins this row's keys in a dedicated
+    # slow test instead).
+    if os.environ.get("BENCH_SKIP_BF16") != "1":
+        bf_geom = (16, 32, 64) if small else (64, 128, 256)
+        row = _bench_row_subprocess(
+            "BENCH_BF16_ONLY",
+            {"nsub": bf_geom[0], "nchan": bf_geom[1], "nbin": bf_geom[2]},
+            timeout=float(os.environ.get("BENCH_BF16_TIMEOUT", "600")),
+            label="bf16")
+        if row:
+            extras = {**(extras or {}), **row}
 
     # sharded fused-sweep row (parallel/shard_sweep.py): the one-launch
     # sweep shard_mapped over a cell mesh vs the single-device engine.

@@ -282,3 +282,23 @@ BENCH_MESH_ONLY='{"nsub": 1024, "nchan": 4096, "nbin": 128}' \
                  2> "benchmarks/measured/bench_mesh_${STAMP}.stderr.txt"
 python benchmarks/profile_stages.py --nsub 256 --nchan 1024 \
   > "benchmarks/measured/shard_roofline_${STAMP}.txt" 2>&1
+
+# 9. (round 8) MIXED-PRECISION on hardware: the bench_bf16 row at the
+#    bench-config geometry.  CPU CI already proves the deterministic
+#    halves (mask parity on bf16-exact cubes, trace-level cube read
+#    bytes at 0.5x); what only hardware can answer is the wall-clock
+#    ratio — on a memory-bound sweep, halving the HBM cube traffic
+#    should pull bf16_vs_fp32 visibly below 1.0 (target <= 0.75 at the
+#    full bench shape; record the measured ratio in BASELINE.md either
+#    way).  Parity divergence exits rc 7 and must fail the pass — a TPU
+#    whose bf16 convert breaks bit-parity has to downgrade the rung, so
+#    also capture the probe verdict.
+BENCH_BF16_ONLY='{"nsub": 1024, "nchan": 4096, "nbin": 128}' \
+  python bench.py > "benchmarks/measured/bench_bf16_${STAMP}.json" \
+                 2> "benchmarks/measured/bench_bf16_${STAMP}.stderr.txt"
+python - <<'PYEOF' >> "benchmarks/measured/bench_bf16_${STAMP}.stderr.txt" 2>&1
+import jax.numpy as jnp
+from iterative_cleaner_tpu.backends.jax_backend import resolve_compute_dtype
+print("probe verdict:",
+      resolve_compute_dtype("bfloat16", jnp.float32, stage="tpu_pass"))
+PYEOF

@@ -523,6 +523,200 @@ def _sharded_sweep_program() -> ProgramReport:
                          report.alias_bytes, violations)
 
 
+def _cube_pallas_read_bytes(closed_jaxpr) -> int:
+    """Deterministic cube-traffic measure: over every cube-tiled
+    ``pallas_call`` (same launch filter as :func:`_count_cube_ref_reads`),
+    read sites x the cube ref's block aval bytes.  Trace-level and
+    platform-independent — unlike ``cost_analysis()``, whose CPU
+    numbers can ATTRIBUTE the bf16→f32 convert as extra traffic — so the
+    bf16 storage win (half the bytes per read site) is assertable in CI
+    on any backend."""
+    total = 0
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        kernel = eqn.params.get("jaxpr")
+        kernel = getattr(kernel, "jaxpr", kernel)
+        if kernel is None or not getattr(kernel, "invars", None):
+            continue
+        cube_ref = kernel.invars[0]
+        aval = getattr(cube_ref, "aval", None)
+        shape = getattr(aval, "shape", ())
+        if len(shape) != 3 or shape[0] == 1:
+            continue
+        reads = 0
+        for sub in iter_eqns(kernel):
+            if sub.primitive.name in ("get", "masked_load", "load") \
+                    and sub.invars and sub.invars[0] is cube_ref:
+                reads += 1
+        if reads == 0:
+            reads = _dma_cube_read_sites(kernel, cube_ref)
+        import numpy as np
+
+        nbytes = int(np.prod(shape)) * np.dtype(aval.dtype).itemsize
+        total += reads * nbytes
+    return total
+
+
+def _fused_sweep_bf16_program() -> ProgramReport:
+    """The mixed-precision hot program (--compute-dtype bfloat16
+    --fused-sweep on): everything the fp32 fused program promises —
+    callback-free, no f64, pinned equation ceiling, single-cube-read —
+    PLUS the storage contract: the sweep kernel's cube operand aval is
+    bfloat16 (the fp32 upcast happens per staged tile inside the kernel
+    body, never in HBM), so the trace-level cube read bytes land at
+    half the fp32 program's."""
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        build_clean_fn,
+        resolve_fft_mode,
+        resolve_median_impl,
+        resolve_stats_frame,
+        resolve_stats_impl,
+    )
+    from iterative_cleaner_tpu.config import CleanConfig
+
+    c = CleanConfig(backend="jax", dtype="float32", stats_impl="fused",
+                    fft_mode="dft", median_impl="pallas",
+                    compute_dtype="bfloat16")
+    dtype = jnp.dtype(c.dtype)
+    fft_mode = resolve_fft_mode(c.fft_mode, dtype)
+
+    def build(compute_dtype):
+        return build_clean_fn(
+            c.max_iter, c.chanthresh, c.subintthresh, c.pulse_slice,
+            c.pulse_scale, c.pulse_region_active, c.rotation,
+            c.baseline_duty, c.unload_res, fft_mode,
+            resolve_median_impl(c.median_impl, dtype),
+            resolve_stats_impl(c.stats_impl, dtype, NBIN, fft_mode),
+            resolve_stats_frame(c.stats_frame, dtype), False,
+            c.baseline_mode, donate=True, fused_sweep="on",
+            compute_dtype=compute_dtype)
+
+    f32 = jnp.float32
+    avals = (jax.ShapeDtypeStruct((NSUB, NCHAN, NBIN), f32),
+             jax.ShapeDtypeStruct((NSUB, NCHAN), f32),
+             jax.ShapeDtypeStruct((NCHAN,), f32),
+             jax.ShapeDtypeStruct((), f32),
+             jax.ShapeDtypeStruct((), f32),
+             jax.ShapeDtypeStruct((), f32))
+    closed = jax.make_jaxpr(build("bfloat16"))(*avals)
+    count, violations = check_jaxpr("fused_sweep_bf16", closed,
+                                    max_eqns=2600)
+    # the full engine program holds TWO cube-tiled launches per
+    # iteration — the template marginals pass and the sweep — and each
+    # must read its cube tile ref exactly once
+    reads = _count_cube_ref_reads(closed)
+    if not reads or any(r != 1 for r in reads):
+        violations.append(ContractViolation(
+            "fused_sweep_bf16", "single-cube-read",
+            f"every cube-tiled kernel must read its cube tile ref "
+            f"exactly once, found read counts {reads}"))
+    # the storage contract: the sweep kernel's cube operand is bf16
+    cube_dtypes = []
+    for eqn in iter_eqns(closed.jaxpr):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        kernel = eqn.params.get("jaxpr")
+        kernel = getattr(kernel, "jaxpr", kernel)
+        if kernel is None or not getattr(kernel, "invars", None):
+            continue
+        aval = getattr(kernel.invars[0], "aval", None)
+        shape = getattr(aval, "shape", ())
+        if len(shape) == 3 and shape[0] != 1:
+            cube_dtypes.append(str(aval.dtype))
+    if not cube_dtypes or set(cube_dtypes) != {"bfloat16"}:
+        violations.append(ContractViolation(
+            "fused_sweep_bf16", "bf16-cube-storage",
+            f"cube-tiled kernel operand dtypes {cube_dtypes}: the "
+            "mixed-precision program must hand every cube-reading "
+            "kernel a bfloat16 HBM cube and upcast inside the body"))
+    bf16_bytes = _cube_pallas_read_bytes(closed)
+    f32_bytes = _cube_pallas_read_bytes(jax.make_jaxpr(
+        build("float32"))(*avals))
+    if not (0 < bf16_bytes <= 0.6 * f32_bytes):
+        violations.append(ContractViolation(
+            "fused_sweep_bf16", "cube-bytes-ratio",
+            f"trace-level sweep cube read bytes {bf16_bytes} vs fp32 "
+            f"{f32_bytes}: bf16 storage must at least halve the cube "
+            "bytes per iteration (ratio <= 0.6)"))
+    return ProgramReport("fused_sweep_bf16", count, 0, violations)
+
+
+def _mesh_padded_sweep_program() -> ProgramReport:
+    """The pad-and-crop rung of the sharded path: a deliberately
+    mesh-indivisible cell grid, padded exactly as
+    :func:`~iterative_cleaner_tpu.parallel.sharding.clean_cube_sharded`
+    pads it, must still build the ONE-LAUNCH sharded sweep program and
+    honour every hot-program contract at the padded geometry."""
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_fft_mode,
+        resolve_median_impl,
+        resolve_stats_frame,
+        resolve_stats_impl,
+    )
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.parallel.mesh import cell_mesh
+    from iterative_cleaner_tpu.parallel.shard_stats import shard_divisible
+    from iterative_cleaner_tpu.parallel.shard_sweep import (
+        sharded_sweep_eligible,
+    )
+    from iterative_cleaner_tpu.parallel.sharding import (
+        build_sharded_clean_fn,
+    )
+
+    c = CleanConfig(backend="jax", dtype="float32", stats_impl="fused",
+                    fft_mode="dft", median_impl="pallas")
+    dtype = jnp.dtype(c.dtype)
+    fft_mode = resolve_fft_mode(c.fft_mode, dtype)
+    mesh = cell_mesh(min(4, len(jax.devices())))
+    ssub, schan = int(mesh.shape["sub"]), int(mesh.shape["chan"])
+    # one row / one channel past the contract geometry: indivisible on
+    # every mesh with an axis > 1, exactly divisible after the pad
+    raw_s, raw_c = NSUB + 1, NCHAN + 1
+    pad_s, pad_c = (-raw_s) % ssub, (-raw_c) % schan
+    ps, pc = raw_s + pad_s, raw_c + pad_c
+    violations: List[ContractViolation] = []
+    if not shard_divisible(mesh, ps, pc):
+        violations.append(ContractViolation(
+            "mesh_padded_sweep", "pad-geometry",
+            f"padded grid {ps}x{pc} is still indivisible on "
+            f"{dict(mesh.shape)}: the pad arithmetic drifted from "
+            "clean_cube_sharded's"))
+        return ProgramReport("mesh_padded_sweep", 0, 0, violations)
+    if not sharded_sweep_eligible(mesh, ps, pc, NBIN):
+        violations.append(ContractViolation(
+            "mesh_padded_sweep", "mesh-eligible",
+            f"padded geometry {ps}x{pc}x{NBIN} fell off the mesh rung "
+            f"on {dict(mesh.shape)}: padding no longer rescues the "
+            "one-launch sweep"))
+        return ProgramReport("mesh_padded_sweep", 0, 0, violations)
+    fn, cube_sh, w_sh, rep = build_sharded_clean_fn(
+        mesh, c.max_iter, c.chanthresh, c.subintthresh, c.pulse_slice,
+        c.pulse_scale, c.pulse_region_active, c.rotation, c.baseline_duty,
+        fft_mode, resolve_median_impl(c.median_impl, dtype),
+        resolve_stats_frame(c.stats_frame, dtype), False,
+        resolve_stats_impl(c.stats_impl, dtype, NBIN, fft_mode),
+        c.baseline_mode, fused_sweep="on", donate=True)
+    f32 = jnp.float32
+    avals = (jax.ShapeDtypeStruct((ps, pc, NBIN), f32),
+             jax.ShapeDtypeStruct((ps, pc), f32),
+             jax.ShapeDtypeStruct((pc,), f32),
+             jax.ShapeDtypeStruct((), f32),
+             jax.ShapeDtypeStruct((), f32),
+             jax.ShapeDtypeStruct((), f32))
+    report = verify_fn("mesh_padded_sweep", fn, avals, max_eqns=2600,
+                       min_alias_bytes=ps * pc * 4)
+    violations.extend(report.violations)
+    return ProgramReport("mesh_padded_sweep", report.eqn_count,
+                         report.alias_bytes, violations)
+
+
 #: the registered hot programs — every builder whose output owns a
 #: steady-state dispatch loop must appear here (the shardmap builder is
 #: covered through build_batched_clean_fn, which it jit-wraps 1:1)
@@ -532,7 +726,9 @@ HOT_PROGRAMS = (
     ("online_step", _online_step_program),
     ("mux_step", _mux_step_program),
     ("fused_sweep", _fused_sweep_program),
+    ("fused_sweep_bf16", _fused_sweep_bf16_program),
     ("sharded_sweep", _sharded_sweep_program),
+    ("mesh_padded_sweep", _mesh_padded_sweep_program),
 )
 
 

@@ -175,13 +175,130 @@ def resolve_fused_sweep(fused_sweep, stats_impl_resolved: str, *,
     return "on"
 
 
+# --- mixed-precision resolution (compute_dtype) --------------------------
+#
+# The parity self-probe result and the per-(stage, reason) downgrade
+# bookkeeping live at module scope: the probe is one tiny traced program
+# per process (cached — monkeypatchable by tests, unlike an lru_cache),
+# and the counters must survive callers that have no telemetry registry
+# (library users) while still folding into one when the CLI has it.
+_COMPUTE_DTYPE_PROBE_CACHE: dict = {}
+_COMPUTE_DTYPE_LOCK = threading.Lock()
+_COMPUTE_DTYPE_COUNTS: dict = {}
+_COMPUTE_DTYPE_NOTICED: set = set()
+
+
+def _compute_dtype_probe_ok() -> bool:
+    """Build-time parity self-probe: clean one tiny bf16-exact cube (RFI
+    spikes included, so the zap actually fires) under fp32 and under the
+    bf16 storage mode and compare the masks bit-for-bit.  A backend whose
+    bf16 upcast arithmetic diverges (non-IEEE convert, fused rewrites)
+    fails here once per process and every stage downgrades to fp32.
+
+    The probe runs the XLA/sort route with rotation='roll' and zero
+    shifts — bf16 storage is then lossless by construction (the cube is
+    bf16-exact and the rotation a pure permutation), so ANY mask
+    difference is backend arithmetic, not quantization."""
+    nsub, nchan, nbin = 4, 8, 32
+    rng = np.random.default_rng(7)
+    cube = rng.normal(0.0, 1.0, (nsub, nchan, nbin)).astype(np.float32)
+    cube[1, 2] += 40.0
+    cube[3, 5, :8] += 60.0
+    cube = np.asarray(jnp.asarray(cube, jnp.bfloat16).astype(jnp.float32))
+    weights = jnp.ones((nsub, nchan), jnp.float32)
+    shifts = jnp.zeros((nchan,), jnp.float32)
+    masks = []
+    for cd in ("float32", "bfloat16"):
+        outs = clean_dedispersed_jax(
+            jnp.asarray(cube), weights, shifts, max_iter=2,
+            chanthresh=5.0, subintthresh=5.0, pulse_slice=(0, 0),
+            pulse_scale=1.0, pulse_active=False, rotation="roll",
+            fft_mode="fft", median_impl="sort", stats_impl="xla",
+            compute_dtype=cd)
+        masks.append(np.asarray(outs.final_weights))
+    return bool(np.array_equal(masks[0], masks[1]))
+
+
+def _compute_dtype_downgrade(stage: str, reason: str, registry=None) -> str:
+    """One rung of the PR 5 degradation ladder: record the downgrade
+    (module counter + optional telemetry registry), print the one-line
+    notice once per (stage, reason) per process, return 'float32'."""
+    import sys
+
+    from iterative_cleaner_tpu.telemetry.registry import labeled
+
+    key = labeled("compute_dtype_ineligible", stage=stage, reason=reason)
+    with _COMPUTE_DTYPE_LOCK:
+        _COMPUTE_DTYPE_COUNTS[key] = _COMPUTE_DTYPE_COUNTS.get(key, 0) + 1
+        first = (stage, reason) not in _COMPUTE_DTYPE_NOTICED
+        _COMPUTE_DTYPE_NOTICED.add((stage, reason))
+    if registry is not None:
+        registry.counter_inc(key)
+    if first:
+        print("compute_dtype=bfloat16 ineligible at stage '%s' (%s): "
+              "staying in float32 (masks unchanged, full-width HBM "
+              "traffic)" % (stage, reason), file=sys.stderr)
+    return "float32"
+
+
+def compute_dtype_ineligible_counts() -> dict:
+    """Snapshot of the per-process ``compute_dtype_ineligible{...}``
+    counters (labeled-key -> count); the CLI folds these into its run
+    registry, tests assert the fallback actually fired."""
+    with _COMPUTE_DTYPE_LOCK:
+        return dict(_COMPUTE_DTYPE_COUNTS)
+
+
+def resolve_compute_dtype(compute_dtype, dtype, *, stage: str = "engine",
+                          registry=None) -> str:
+    """Resolve the mixed-precision knob to 'float32'/'bfloat16'.
+
+    ``None`` defers to the ``ICLEAN_COMPUTE_DTYPE`` env mirror, then
+    'float32'.  'bfloat16' is a request, not a promise — two rungs of the
+    PR 5 degradation ladder live here and downgrade THIS stage to fp32
+    with a one-line notice + ``compute_dtype_ineligible{stage=,reason=}``
+    counter, never an error:
+
+    * ``reason=dtype`` — the pipeline dtype is not float32 (the f64
+      oracle path has no bf16 storage rung; the fp32-bit-pattern-keyed
+      kth-select would also be meaningless there).
+    * ``reason=parity_probe`` — the build-time self-probe
+      (:func:`_compute_dtype_probe_ok`, one tiny traced program cached
+      per process) found a mask mismatch between the fp32 and bf16
+      routes on bf16-exact inputs.
+    """
+    import os
+
+    if compute_dtype is None:
+        compute_dtype = os.environ.get("ICLEAN_COMPUTE_DTYPE", "") \
+            or "float32"
+    if compute_dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"unknown compute dtype {compute_dtype!r} (choose 'float32' "
+            "or 'bfloat16')")
+    if compute_dtype == "float32":
+        return "float32"
+    if jnp.dtype(dtype) != jnp.float32:
+        return _compute_dtype_downgrade(stage, "dtype", registry)
+    with _COMPUTE_DTYPE_LOCK:
+        ok = _COMPUTE_DTYPE_PROBE_CACHE.get("parity")
+    if ok is None:
+        ok = _compute_dtype_probe_ok()
+        with _COMPUTE_DTYPE_LOCK:
+            _COMPUTE_DTYPE_PROBE_CACHE.setdefault("parity", ok)
+    if not ok:
+        return _compute_dtype_downgrade(stage, "parity_probe", registry)
+    return "bfloat16"
+
+
 @functools.lru_cache(maxsize=None)
 def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                    pulse_scale, pulse_active, rotation, baseline_duty,
                    unload_res, fft_mode="fft", median_impl="sort",
                    stats_impl="xla", stats_frame="dispersed",
                    dedispersed=False, baseline_mode="profile",
-                   donate=False, fused_sweep="off"):
+                   donate=False, fused_sweep="off",
+                   compute_dtype="float32"):
     """Build (and cache) the jitted whole-archive cleaning program for one
     static configuration.
 
@@ -221,6 +338,7 @@ def build_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             stats_impl=stats_impl, stats_frame=stats_frame,
             baseline_corr=baseline_corr, disp_iteration=disp_iteration,
             fused_sweep=(fused_sweep == "on"),
+            compute_dtype=compute_dtype,
         )
         if not unload_res:
             return outs, None
@@ -281,6 +399,8 @@ def clean_cube(cube, orig_weights, freqs_mhz, dm, ref_freq_mhz, period_s,
         config.baseline_mode,
         donate=donate,
         fused_sweep=resolve_fused_sweep(config.fused_sweep, stats_impl),
+        compute_dtype=resolve_compute_dtype(config.compute_dtype, dtype,
+                                            stage="engine"),
     )
     outs, resid = fn(
         jnp.asarray(cube, dtype=dtype),

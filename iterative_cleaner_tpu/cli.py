@@ -122,6 +122,21 @@ def build_parser() -> argparse.ArgumentParser:
                              "the geometry gate admits; 'off' keeps the "
                              "multi-kernel route. Masks are bit-equal at "
                              "every setting.")
+    parser.add_argument("--compute-dtype", "--compute_dtype",
+                        choices=("float32", "bfloat16"), default=None,
+                        dest="compute_dtype",
+                        help="Mixed-precision hot path on the jax path: "
+                             "'bfloat16' stores the cube (and rotated "
+                             "templates) in bf16 HBM — half the cube "
+                             "bytes per sweep read — while ALL arithmetic "
+                             "upcasts to float32 in VMEM/registers, so "
+                             "masks stay bit-equal on bf16-exact cubes "
+                             "and any stage whose build-time parity probe "
+                             "disagrees falls back to float32 with a "
+                             "notice (never an error). Default: the "
+                             "ICLEAN_COMPUTE_DTYPE env var, else "
+                             "float32. Requires --dtype float32; excluded "
+                             "from checkpoint identity.")
     parser.add_argument("--stats_frame",
                         choices=("auto", "dispersed", "dedispersed"),
                         default="auto",
@@ -566,8 +581,9 @@ def build_parser() -> argparse.ArgumentParser:
                         default="off",
                         help="Multi-device execution: 'cell' shards each "
                              "archive's (subint x channel) grid over all "
-                             "visible devices (parallel/sharding.py; each "
-                             "mesh axis must divide the grid); 'batch' "
+                             "visible devices (parallel/sharding.py; "
+                             "uneven grids are zero-weight padded up to "
+                             "mesh divisibility and cropped back); 'batch' "
                              "shards the --batch groups across devices "
                              "(parallel/batch.py). On CPU test meshes "
                              "combine 'cell' with --rotation roll "
@@ -614,6 +630,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         stats_impl=args.stats_impl,
         stats_frame=args.stats_frame,
         fused_sweep=args.fused_sweep,
+        compute_dtype=getattr(args, "compute_dtype", None),
         fft_mode=args.fft_mode,
         baseline_mode=args.baseline_mode,
         stream_hbm_mb=getattr(args, "stream_hbm_mb", None),
@@ -686,6 +703,29 @@ def _notice_sweep_downgrade(cfg, mesh, shape, *, quiet, telemetry):
     return reason
 
 
+def _notice_compute_dtype_downgrade(cfg, *, telemetry):
+    """Mixed-precision rung of the degradation ladder: resolve an
+    EXPLICIT ``--compute-dtype bfloat16`` (or ``ICLEAN_COMPUTE_DTYPE``)
+    once with the session's telemetry registry, so a downgraded stage's
+    ``compute_dtype_ineligible{stage=,reason=}`` counter lands in the run
+    report (:func:`resolve_compute_dtype` itself prints the one-line
+    notice and never errors).  Returns the resolved dtype string."""
+    knob = cfg.compute_dtype
+    if knob is None:
+        knob = os.environ.get("ICLEAN_COMPUTE_DTYPE", "") or None
+    if knob != "bfloat16" or cfg.backend != "jax":
+        return "float32"
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_compute_dtype,
+    )
+
+    return resolve_compute_dtype(
+        cfg.compute_dtype, jnp.dtype(cfg.dtype), stage="engine",
+        registry=(telemetry.registry if telemetry is not None else None))
+
+
 def clean_one(in_path: str, args: argparse.Namespace,
               timer=None, preloaded=None, result=None,
               telemetry=None) -> str:
@@ -728,6 +768,7 @@ def clean_one(in_path: str, args: argparse.Namespace,
                   % ckpt.checkpoint_path(args.checkpoint, in_path))
     if result is None:
         with timer.phase("clean"):
+            _notice_compute_dtype_downgrade(cfg, telemetry=telemetry)
             mesh_mode = getattr(args, "mesh", "off")
             stream = getattr(args, "stream", 0)
             if stream > 0:

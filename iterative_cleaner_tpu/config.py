@@ -81,6 +81,20 @@ class CleanConfig:
     # parallel/streaming_exact's host-RAM note).
     baseline_mode: str = "integration"
     dtype: str = "float32"       # compute dtype on the jax path
+    # mixed-precision hot path (jax backend): "bfloat16" stores the cube
+    # (and its dispersed-frame twin) in bf16 HBM while EVERY arithmetic
+    # stage — subtraction, the radix-bisection kth-select (whose
+    # order-preserving key mapping is float32-bit-pattern-keyed and must
+    # stay fp32), scalers, threshold/zap — accumulates in fp32: the Pallas
+    # routes upcast each staged tile in VMEM, the XLA routes upcast at the
+    # read site.  Halves the per-iteration HBM read budget of the fused
+    # sweep (bench_bf16's bf16_cube_bytes_ratio).  Masks are bit-equal to
+    # the fp32 route whenever the inputs are bf16-exact; a build-time
+    # parity self-probe guards every stage and downgrades it to fp32
+    # (compute_dtype_ineligible{stage=,reason=}) on any mismatch, so the
+    # knob is excluded from the checkpoint/journal config identity.
+    # None defers to ICLEAN_COMPUTE_DTYPE, then "float32".
+    compute_dtype: Optional[str] = None
     # HBM byte budget (MiB) for the exact streaming mode's device tile
     # cache (parallel/tile_cache.py).  None defers to the
     # ICLEAN_STREAM_HBM_MB env var and then a device-sized default; 0
@@ -218,6 +232,16 @@ class CleanConfig:
             raise ValueError(f"unknown fused sweep mode {self.fused_sweep!r}")
         if self.baseline_mode not in ("integration", "profile"):
             raise ValueError(f"unknown baseline mode {self.baseline_mode!r}")
+        if self.compute_dtype is not None \
+                and self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown compute dtype {self.compute_dtype!r} (choose "
+                "'float32' or 'bfloat16')")
+        if self.compute_dtype == "bfloat16" and self.dtype != "float32":
+            raise ValueError(
+                "compute_dtype='bfloat16' requires dtype='float32' (the "
+                "bf16 storage mode upcasts into fp32 accumulation; an f64 "
+                "pipeline has no bf16 rung)")
         if self.stats_impl == "fused" and self.dtype != "float32":
             raise ValueError("stats_impl='fused' requires dtype='float32'")
         if self.stats_impl == "fused" and self.fft_mode == "fft":

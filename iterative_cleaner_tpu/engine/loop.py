@@ -89,6 +89,29 @@ def iter_quality_series(iter_metrics, n_cells: int) -> dict:
     }
 
 
+def _acc(x):
+    """fp32 accumulation view of a bf16-STORED array, identity otherwise.
+
+    The mixed-precision mode (``compute_dtype='bfloat16'``) keeps the
+    cube-sized operands in bf16 HBM; every XLA read site goes through
+    this upcast so ALL arithmetic — subtraction, the radix-bisection
+    kth-select (whose order-preserving key mapping is float32-bit-
+    pattern-keyed), scalers, threshold/zap — stays fp32.  The Pallas
+    routes do the same upcast per staged tile inside the kernel bodies
+    (stats/pallas_kernels), so the f32 paths are bit-unchanged (astype
+    to the same dtype is a no-op)."""
+    if x is not None and x.dtype == jnp.bfloat16:
+        return x.astype(jnp.float32)
+    return x
+
+
+def _arith_dtype(x):
+    """The dtype arithmetic runs in for a given stored array: fp32 for
+    bf16 storage (see :func:`_acc`), the array's own dtype otherwise
+    (f64 oracle runs stay f64)."""
+    return jnp.float32 if x.dtype == jnp.bfloat16 else x.dtype
+
+
 def _pulse_window(nbin, pulse_slice, pulse_scale, pulse_active, dtype):
     """(nbin,) multiplier the reference applies to the residual's on-pulse
     bins (reference :280-283): 1 everywhere, ``pulse_scale`` on
@@ -212,7 +235,8 @@ def _build_template(ded_cube, disp_base, weights, back_shifts, *, rotation,
 
         _, base_offsets, duty = baseline_corr
         use_pallas_marginals = False
-        if stats_impl == "fused" and disp_base.dtype == jnp.float32:
+        if stats_impl == "fused" \
+                and disp_base.dtype in (jnp.float32, jnp.bfloat16):
             from iterative_cleaner_tpu.stats.pallas_kernels import (
                 marginals_pallas_eligible,
                 weighted_marginals_pallas,
@@ -234,7 +258,7 @@ def _build_template(ded_cube, disp_base, weights, back_shifts, *, rotation,
             # it twice: TPU does not fuse sibling dots)
             a, t1 = weighted_marginals_pallas(disp_base, weights)
         else:
-            a, t1 = weighted_marginal_totals(disp_base, weights, jnp)
+            a, t1 = weighted_marginal_totals(_acc(disp_base), weights, jnp)
         num = template_numerator_from_channel_profiles(
             a, back_shifts, rotation, jnp)
         den = jnp.sum(weights)
@@ -243,7 +267,7 @@ def _build_template(ded_cube, disp_base, weights, back_shifts, *, rotation,
         template = template + template_correction_from_totals(
             t1, base_offsets, weights, duty, jnp)
     else:
-        template = weighted_template(ded_cube, weights, jnp)
+        template = weighted_template(_acc(ded_cube), weights, jnp)
         if baseline_corr is not None:
             # integration baseline mode: the reference recomputes baselines
             # on every template build with the CURRENT weights (:88-94);
@@ -256,7 +280,7 @@ def _build_template(ded_cube, disp_base, weights, back_shifts, *, rotation,
 
             disp_clean, base_offsets, duty = baseline_corr
             template = template + template_correction(
-                disp_clean, base_offsets, weights, duty, jnp)
+                _acc(disp_clean), base_offsets, weights, duty, jnp)
     return template * 10000.0  # ref :94
 
 
@@ -354,10 +378,13 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
             )
 
             if stats_frame == "dedispersed":
+                # arithmetic operands (window/rows) stay fp32 under bf16
+                # cube storage: only the cube rides HBM narrow, the
+                # kernels upcast each staged tile in VMEM
                 m = _pulse_window(nbin, pulse_slice, pulse_scale,
-                                  pulse_active, ded_cube.dtype)
-                window = jnp.ones((nbin,), ded_cube.dtype) if m is None \
-                    else m
+                                  pulse_active, _arith_dtype(ded_cube))
+                window = jnp.ones((nbin,), _arith_dtype(ded_cube)) \
+                    if m is None else m
                 if shard_mesh is not None:
                     new_weights, scores, d_std = sharded_fused_sweep_dedisp(
                         shard_mesh, ded_cube, template, window,
@@ -374,7 +401,7 @@ def iteration_step(ded_cube, disp_base, weights, orig_weights, cell_mask,
                     jnp.broadcast_to(template, (nchan, nbin)), back_shifts,
                     jnp, method=rotation)
                 nyq_row = _nyq_correction_row(back_shifts, nbin, rotation,
-                                              ded_cube.dtype)
+                                              _arith_dtype(ded_cube))
                 if shard_mesh is not None:
                     new_weights, scores, d_std = sharded_fused_sweep(
                         shard_mesh, disp_base, rot_t, nyq_row, template,
@@ -442,9 +469,10 @@ def diagnostics_given_template(ded_cube, disp_base, template, orig_weights,
     evaluate it per subint tile and concatenate."""
     nsub, nchan, nbin = ded_cube.shape
     m = _pulse_window(nbin, pulse_slice, pulse_scale, pulse_active,
-                      ded_cube.dtype)
+                      _arith_dtype(ded_cube))
     if stats_frame == "dedispersed":
-        window = jnp.ones((nbin,), ded_cube.dtype) if m is None else m
+        window = jnp.ones((nbin,), _arith_dtype(ded_cube)) if m is None \
+            else m
         if stats_impl == "fused":
             if shard_mesh is not None:
                 from iterative_cleaner_tpu.parallel.shard_stats import (
@@ -462,8 +490,9 @@ def diagnostics_given_template(ded_cube, disp_base, template, orig_weights,
                 diags = cell_diagnostics_pallas_dedisp(
                     ded_cube, template, window, orig_weights, cell_mask)
         else:
-            amps = fit_template_amplitudes(ded_cube, template, jnp)
-            resid = (amps[:, :, None] * template - ded_cube) * window
+            ded = _acc(ded_cube)
+            amps = fit_template_amplitudes(ded, template, jnp)
+            resid = (amps[:, :, None] * template - ded) * window
             weighted = resid * orig_weights[:, :, None]
             diags = cell_diagnostics_jax(weighted, cell_mask, fft_mode)
     else:
@@ -512,14 +541,15 @@ def diagnostics_given_template(ded_cube, disp_base, template, orig_weights,
                 fit_template_amplitudes_disp,
             )
 
-            amps = fit_template_amplitudes_disp(disp_base, rot_t, template,
+            dispb = _acc(disp_base)
+            amps = fit_template_amplitudes_disp(dispb, rot_t, template,
                                                 jnp)
-            base = disp_base
+            base = dispb
             if apply_nyq:
                 alt = (1.0 - 2.0 * (jnp.arange(nbin) % 2)).astype(
-                    ded_cube.dtype)
-                nyqcoef = jnp.sum(disp_base * alt, axis=-1)       # (S, C)
-                base = disp_base + nyqcoef[:, :, None] * nyq_row[None]
+                    _arith_dtype(ded_cube))
+                nyqcoef = jnp.sum(dispb * alt, axis=-1)           # (S, C)
+                base = dispb + nyqcoef[:, :, None] * nyq_row[None]
             resid = amps[:, :, None] * rot_t[None] - base
             weighted = resid * orig_weights[:, :, None]
             return cell_diagnostics_jax(weighted, cell_mask, fft_mode)
@@ -541,8 +571,8 @@ def diagnostics_given_template(ded_cube, disp_base, template, orig_weights,
                     ded_cube, disp_base, rot_t, template, orig_weights,
                     cell_mask)
         else:
-            amps = fit_template_amplitudes(ded_cube, template, jnp)
-            resid = amps[:, :, None] * rot_t[None] - disp_base  # ref :277-279
+            amps = fit_template_amplitudes(_acc(ded_cube), template, jnp)
+            resid = amps[:, :, None] * rot_t[None] - _acc(disp_base)  # ref :277-279
             weighted = resid * orig_weights[:, :, None]  # apply_weights :291-297
             diags = cell_diagnostics_jax(weighted, cell_mask, fft_mode)
     return diags
@@ -558,7 +588,8 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
                           shard_mesh=None,
                           baseline_corr=None,
                           disp_iteration=False,
-                          fused_sweep=False) -> CleanOutputs:
+                          fused_sweep=False,
+                          compute_dtype="float32") -> CleanOutputs:
     """Run the full iteration loop on an already-prepared cube.
 
     ``ded_cube``: baseline-removed, dedispersed (nsub, nchan, nbin) cube.
@@ -585,6 +616,16 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
     post-template half of every iteration (see :func:`iteration_step`) —
     ONE cube read per iteration where its trace-time gate admits it,
     bit-equal masks everywhere.
+
+    ``compute_dtype='bfloat16'`` (resolved by the caller —
+    :func:`iterative_cleaner_tpu.backends.jax_backend.
+    resolve_compute_dtype` owns the env mirror and the parity-probe
+    fallback ladder): the cube-sized operands are stored bf16 in HBM
+    after the f32 preamble, halving every per-iteration cube read; ALL
+    arithmetic stays fp32 (:func:`_acc` at the XLA read sites, in-VMEM
+    upcast of each staged tile inside the Pallas kernels), so the int32
+    key machinery of the kth-select and the shard-merge collectives are
+    untouched.  Requires an f32 pipeline (``orig_weights`` float32).
     """
     nsub, nchan, _ = ded_cube.shape
     wdtype = orig_weights.dtype
@@ -606,6 +647,34 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
             pulse_scale=pulse_scale, pulse_active=pulse_active,
             rotation=rotation,
         )
+    if compute_dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"unknown compute dtype {compute_dtype!r}")
+    if compute_dtype == "bfloat16" and wdtype != jnp.float32:
+        raise ValueError(
+            "compute_dtype='bfloat16' requires a float32 pipeline "
+            "(resolve_compute_dtype downgrades this case; direct engine "
+            "callers must not request bf16 storage of a non-f32 cube)")
+    if compute_dtype == "bfloat16":
+        # bf16 HBM storage of the cube-sized operands, AFTER the f32
+        # preamble (rotation/baseline math full-width).  Every consumer
+        # upcasts back to f32 at its read site (_acc / in-kernel astype),
+        # so this is the only narrowing in the whole program — lossless
+        # whenever the prepared cube is bf16-exact.
+        ded_cube = ded_cube.astype(jnp.bfloat16)
+        if disp_base is not None:
+            disp_base = disp_base.astype(jnp.bfloat16)
+        if not disp_iteration and baseline_corr is not None \
+                and baseline_corr[0] is not None:
+            # the integration-mode template correction re-reads disp_clean
+            # every iteration — store it narrow too (_build_template
+            # upcasts); under disp_iteration disp_base IS that array
+            baseline_corr = (baseline_corr[0].astype(jnp.bfloat16),
+                             *baseline_corr[1:])
+
+    # Arithmetic dtype for the score/fraction carries: bf16 storage never
+    # leaks into the loop state (the while_loop carry typing and the
+    # host-side telemetry stay f32); f64 oracle runs keep f64.
+    sdtype = _arith_dtype(ded_cube)
 
     history = jnp.zeros((max_iter + 1, nsub, nchan), dtype=wdtype)
     history = history.at[0].set(orig_weights)  # pre-loop seed, ref :78-79
@@ -617,10 +686,10 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
         count=jnp.int32(1),
         converged=jnp.bool_(False),
         loops=jnp.int32(max_iter),
-        scores=jnp.zeros((nsub, nchan), dtype=ded_cube.dtype),
+        scores=jnp.zeros((nsub, nchan), dtype=sdtype),
         template_weights=orig_weights,
         loop_diffs=jnp.zeros((max_iter,), dtype=jnp.int32),
-        loop_rfi_frac=jnp.zeros((max_iter,), dtype=ded_cube.dtype),
+        loop_rfi_frac=jnp.zeros((max_iter,), dtype=sdtype),
         iter_metrics=jnp.zeros((max_iter, ITER_METRICS_WIDTH),
                                dtype=jnp.float32),
     )
@@ -646,7 +715,7 @@ def clean_dedispersed_jax(ded_cube, orig_weights, back_shifts, *,
         history = lax.dynamic_update_index_in_dim(c.history, new_w, c.count, 0)
         # per-loop operator telemetry (reference :129-134)
         diff = jnp.sum(new_w != c.weights).astype(jnp.int32)
-        frac = jnp.mean((new_w == 0).astype(ded_cube.dtype))
+        frac = jnp.mean((new_w == 0).astype(sdtype))
         # convergence telemetry row (telemetry.ITER_METRIC_FIELDS order);
         # zap_count includes pre-zapped cells so the final row equals the
         # returned weights' zero-cell count

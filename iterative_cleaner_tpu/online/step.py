@@ -48,6 +48,7 @@ def step_build_key(config, nchan: int, nbin: int, dedispersed: bool,
     import jax.numpy as jnp
 
     from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_compute_dtype,
         resolve_fft_mode,
         resolve_fused_sweep,
         resolve_median_impl,
@@ -62,6 +63,7 @@ def step_build_key(config, nchan: int, nbin: int, dedispersed: bool,
         int(nchan), int(nbin), bool(dedispersed), str(dtype), fft_mode,
         resolve_median_impl(config.median_impl, dtype), stats_impl,
         resolve_fused_sweep(config.fused_sweep, stats_impl),
+        resolve_compute_dtype(config.compute_dtype, dtype, stage="online"),
         float(config.chanthresh), float(config.subintthresh),
         float(config.baseline_duty), config.rotation,
         tuple(config.pulse_slice) if config.pulse_slice else None,
@@ -97,10 +99,20 @@ def build_subint_step(config, nchan: int, nbin: int, dedispersed: bool,
     from iterative_cleaner_tpu.online.ewt import ew_update, subint_profile
     from iterative_cleaner_tpu.stats.masked_jax import scale_and_combine
 
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_compute_dtype,
+    )
+
     cfg = config
     dtype = jnp.dtype(cfg.dtype)
     fft_mode = resolve_fft_mode(cfg.fft_mode, dtype)
     median_impl = resolve_median_impl(cfg.median_impl, dtype)
+    # mixed-precision rung: the prepared subint tile downcasts to bf16
+    # before the provisional zap (the sweep kernel / XLA diagnostics
+    # upcast per read), AFTER the fp32 profile extraction — the EW
+    # template stays a full-precision fp32 carry across the stream
+    compute_dtype = resolve_compute_dtype(cfg.compute_dtype, dtype,
+                                          stage="online")
     alpha = float(alpha)
     # One-launch SWEEP route for the provisional zap (the same fused
     # tile step as the batch engine's fused route, at nsub=1): engages
@@ -146,6 +158,8 @@ def build_subint_step(config, nchan: int, nbin: int, dedispersed: bool,
             updated, ew_update(template, count, profile, alpha, jnp),
             template)
         cell_mask = w_row == 0
+        if compute_dtype == "bfloat16":
+            ded = ded.astype(jnp.bfloat16)
         if use_sweep:
             new_w, scores, _ = fused_sweep_pallas_dedisp(
                 ded, new_template, sweep_window, w_row, cell_mask,

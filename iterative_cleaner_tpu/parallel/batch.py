@@ -62,6 +62,7 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
                            fft_mode, median_impl="sort",
                            stats_frame="dispersed", dedispersed=False,
                            stats_impl="xla", baseline_mode="profile",
+                           compute_dtype="float32",
                            fused_sweep="off", donate=False):
     """Jitted batched cleaner: every per-archive input gains a leading batch
     axis; scalars (dm, period, ref freq) are per-archive vectors.  The
@@ -108,6 +109,7 @@ def build_batched_clean_fn(max_iter, chanthresh, subintthresh, pulse_slice,
             disp_iteration=disp_iteration_enabled(
                 baseline_mode, stats_frame, pulse_active, dedispersed),
             fused_sweep=(fused_sweep == "on"),
+            compute_dtype=compute_dtype,
         )
 
     if donate:
@@ -174,6 +176,7 @@ def resolve_batch_build_args(config: CleanConfig, nbin: int,
     import jax.numpy as jnp
 
     from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_compute_dtype,
         resolve_fft_mode,
         resolve_fused_sweep,
         resolve_median_impl,
@@ -225,8 +228,10 @@ def resolve_batch_build_args(config: CleanConfig, nbin: int,
         bool(dedispersed),
         stats_impl,
         config.baseline_mode,
+        resolve_compute_dtype(config.compute_dtype, dtype, stage="batch"),
         # the sweep's 'auto' follows the resolved stats route, so the
         # GSPMD branches above (stats_impl forced to xla) resolve it off
+        # — fused_sweep stays LAST (_program_label keys on build_args[-1])
         resolve_fused_sweep(config.fused_sweep, stats_impl),
     )
     use_shardmap = (kernel_route

@@ -28,7 +28,8 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
                            baseline_duty, fft_mode, median_impl="sort",
                            stats_frame="dispersed", dedispersed=False,
                            stats_impl="xla", baseline_mode="profile",
-                           fused_sweep="off", donate=False):
+                           compute_dtype="float32", fused_sweep="off",
+                           donate=False):
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -73,6 +74,7 @@ def build_sharded_clean_fn(mesh_ref, max_iter, chanthresh, subintthresh,
             disp_iteration=disp_iteration_enabled(
                 baseline_mode, stats_frame, pulse_active, dedispersed),
             fused_sweep=(fused_sweep == "on"),
+            compute_dtype=compute_dtype,
         )
 
     kwargs = {}
@@ -129,15 +131,37 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
 
     dtype = jnp.dtype(config.dtype)
     fft_mode = resolve_fft_mode(config.fft_mode, dtype)
-    # Fail fast on uneven layouts: NamedSharding's device_put rejects them
-    # anyway (deep inside jit), and the shard_map-routed Pallas kernels
-    # (parallel/shard_stats) require exact division too.
-    if not shard_divisible(mesh, cube.shape[0], cube.shape[1]):
-        raise ValueError(
-            f"each mesh axis must divide the cell grid exactly: grid "
-            f"{cube.shape[0]}x{cube.shape[1]} vs mesh {dict(mesh.shape)}; "
-            "pad the archive or pick a mesh whose axis sizes divide "
-            "(nsub, nchan)")
+    # Donate only buffers this call owns (clean_cube's rule): host inputs
+    # become fresh sharded uploads below, while a caller-held jax.Array
+    # would lose its buffer to the donation.  Decided before any padding —
+    # a padded copy is always ours, but the ownership question is about
+    # what the CALLER handed in.
+    donate = (config.donate_buffers
+              and not isinstance(cube, jax.Array)
+              and not isinstance(weights, jax.Array))
+    # Uneven layouts: NamedSharding's device_put rejects them deep inside
+    # jit and the shard_map-routed Pallas kernels (parallel/shard_stats)
+    # need exact division, so pad the cell grid up to mesh divisibility
+    # with zero-weight rows/channels (the --bucket-pad idiom: weight-0
+    # cells are masked out of every statistic and can never change), run
+    # the padded grid — keeping the one-launch sharded sweep — then crop
+    # the outputs and correct the zap telemetry below.
+    nsub_raw, nchan_raw = int(cube.shape[0]), int(cube.shape[1])
+    axes = dict(mesh.shape)
+    pad_s = (-nsub_raw) % int(axes["sub"])
+    pad_c = (-nchan_raw) % int(axes["chan"])
+    pad_cells = ((nsub_raw + pad_s) * (nchan_raw + pad_c)
+                 - nsub_raw * nchan_raw)
+    if pad_cells:
+        cube = jnp.pad(jnp.asarray(cube, dtype),
+                       ((0, pad_s), (0, pad_c), (0, 0)))
+        weights = jnp.pad(jnp.asarray(weights, dtype),
+                          ((0, pad_s), (0, pad_c)))
+        # edge-pad: padded channels are weight-0 (never read) but their
+        # dispersion shifts must stay finite
+        freqs_mhz = jnp.pad(jnp.asarray(freqs_mhz, dtype), (0, pad_c),
+                            mode="edge")
+    assert shard_divisible(mesh, cube.shape[0], cube.shape[1])
     median_impl = resolve_median_impl(config.median_impl, dtype)
     stats_impl = resolve_stats_impl(config.stats_impl, dtype,
                                     cube.shape[-1], fft_mode)
@@ -145,14 +169,15 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
         resolve_fused_sweep,
     )
 
+    # the PADDED shape: a pad-rescued geometry is sweep-eligible
     fused_sweep = resolve_fused_sweep(config.fused_sweep, stats_impl,
                                       mesh=mesh, shape=cube.shape)
-    # Donate only buffers this call owns (clean_cube's rule): host inputs
-    # become fresh sharded uploads below, while a caller-held jax.Array
-    # would lose its buffer to the donation.
-    donate = (config.donate_buffers
-              and not isinstance(cube, jax.Array)
-              and not isinstance(weights, jax.Array))
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_compute_dtype,
+    )
+
+    compute_dtype = resolve_compute_dtype(config.compute_dtype, dtype,
+                                          stage="mesh")
     fn, cube_sh, w_sh, rep = build_sharded_clean_fn(
         mesh, config.max_iter, config.chanthresh, config.subintthresh,
         config.pulse_slice, config.pulse_scale, config.pulse_region_active,
@@ -160,7 +185,7 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
         fft_mode, median_impl,
         resolve_stats_frame(config.stats_frame, dtype),
         bool(dedispersed), stats_impl, config.baseline_mode,
-        fused_sweep=fused_sweep, donate=donate,
+        compute_dtype=compute_dtype, fused_sweep=fused_sweep, donate=donate,
     )
     with mesh:
         outs = fn(
@@ -176,14 +201,27 @@ def clean_cube_sharded(cube, weights, freqs_mhz, dm, centre_freq_mhz,
 
     outs = host_fetch(outs)
     loops = int(outs.loops)
+    fw = np.asarray(outs.final_weights)
+    sc = np.asarray(outs.scores)
+    fr = np.asarray(outs.loop_rfi_frac)[:loops]
+    im = np.asarray(outs.iter_metrics)[:loops]
+    if pad_cells:
+        # crop the pad rows/channels back off BEFORE apply_bad_parts
+        # (zero-weight pad lines would corrupt the bad-line fractions)
+        # and correct the always-zero pad cells out of the zap telemetry
+        # — same arithmetic as parallel.batch.unpack_batch_results
+        fw, sc = fw[:nsub_raw, :nchan_raw], sc[:nsub_raw, :nchan_raw]
+        im = im.copy()
+        im[:, 0] -= pad_cells  # zap_count counts pad zeros too
+        fr = (im[:, 0] / float(nsub_raw * nchan_raw)).astype(fr.dtype)
     result = CleanResult(
-        final_weights=np.asarray(outs.final_weights),
-        scores=np.asarray(outs.scores),
+        final_weights=fw,
+        scores=sc,
         loops=loops,
         converged=bool(outs.converged),
         loop_diffs=np.asarray(outs.loop_diffs)[:loops],
-        loop_rfi_frac=np.asarray(outs.loop_rfi_frac)[:loops],
-        iter_metrics=np.asarray(outs.iter_metrics)[:loops],
+        loop_rfi_frac=fr,
+        iter_metrics=im,
     )
     if apply_bad_parts:
         base.apply_bad_parts(result, config)

@@ -205,13 +205,24 @@ def _clean_exact_numpy(cube, weights, freqs, dm, ref_freq, period, config,
 
 
 def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
-                  mesh=None):
+                  mesh=None, compute_dtype="float32"):
     """Jitted per-tile programs for one static config (cached on the jit
     side by shape/dtype).  With ``mesh`` (a ('sub','chan') cell mesh) the
     cube-sized tile work is GSPMD-sharded over the devices: the template/
     correction contractions become psums, and the Pallas kernels route
     per-shard through parallel/shard_stats — composing long-observation
-    exact streaming with multi-chip execution."""
+    exact streaming with multi-chip execution.
+
+    ``compute_dtype='bfloat16'`` is the streaming face of the engine's
+    mixed-precision mode: the CUBE-SIZED tiles (prepared and, in raw-
+    retaining configs, raw) are stored bf16 — on the host backing store,
+    on the wire (every H2D/D2H halves), and in the device tile cache,
+    DOUBLING the effective ``stream_hbm_mb`` budget — while every tile
+    program upcasts its cube-sized operands to fp32 at entry (XLA
+    routes) or per staged tile in the kernel body (Pallas routes), so
+    all arithmetic matches the fp32 engine's.  prep still computes in
+    fp32 and downcasts only its OUTPUT, mirroring the engine's
+    post-prepare downcast."""
     import jax
     import jax.numpy as jnp
 
@@ -230,7 +241,10 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
         scale_and_combine_compact,
     )
 
+    from iterative_cleaner_tpu.engine.loop import _acc
+
     dtype = jnp.dtype(config.dtype)
+    store_dtype = jnp.bfloat16 if compute_dtype == "bfloat16" else dtype
     fft_mode = resolve_fft_mode(config.fft_mode, dtype)
     median_impl = resolve_median_impl(config.median_impl, dtype)
     stats_impl = resolve_stats_impl(config.stats_impl, dtype, nbin, fft_mode)
@@ -282,10 +296,10 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
             # the DISP tile is the iteration's working cube; ded is unused
             # downstream, so XLA dead-code-eliminates its rotation here
             _, shifts, disp_t, v_t = prepare_cube_integration(
-                cube_t, w_t, freqs, dm, ref_freq, period, jnp,
+                _acc(cube_t), w_t, freqs, dm, ref_freq, period, jnp,
                 baseline_duty=config.baseline_duty,
                 rotation=config.rotation, dedispersed=dedispersed)
-            return disp_t, shifts, v_t
+            return disp_t.astype(store_dtype), shifts, v_t
     elif integration:
         def prep(cube_t, w_t, freqs, dm, ref_freq, period):
             from iterative_cleaner_tpu.ops.dsp import (
@@ -293,19 +307,19 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
             )
 
             ded_t, shifts, _, v_t = prepare_cube_integration(
-                cube_t, w_t, freqs, dm, ref_freq, period, jnp,
+                _acc(cube_t), w_t, freqs, dm, ref_freq, period, jnp,
                 baseline_duty=config.baseline_duty,
                 rotation=config.rotation, dedispersed=dedispersed)
-            return ded_t, shifts, v_t
+            return ded_t.astype(store_dtype), shifts, v_t
     else:
         def prep(cube_t, w_t, freqs, dm, ref_freq, period):
             del w_t  # per-profile windows are weight-independent
             ded_t, shifts = prepare_cube_jax(
-                cube_t, freqs, dm, ref_freq, period,
+                _acc(cube_t), freqs, dm, ref_freq, period,
                 baseline_duty=config.baseline_duty,
                 rotation=config.rotation, dedispersed=dedispersed,
             )
-            return ded_t, shifts, None
+            return ded_t.astype(store_dtype), shifts, None
 
     prep = tile_jit(prep, ("cube", "cell", "rep", "rep", "rep", "rep"))
 
@@ -322,7 +336,7 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
                 template_correction_numerator_from_totals,
             )
 
-            a_part, t1 = weighted_marginal_totals(disp_t, w_t, jnp)
+            a_part, t1 = weighted_marginal_totals(_acc(disp_t), w_t, jnp)
             corr = template_correction_numerator_from_totals(
                 t1, v_t, w_t, config.baseline_duty, jnp)
             return a_part, corr
@@ -332,7 +346,7 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
         correction_partial = None
     else:
         def template_partial(ded_t, w_t):
-            return weighted_template_numerator(ded_t, w_t, jnp)
+            return weighted_template_numerator(_acc(ded_t), w_t, jnp)
 
         template_partial = tile_jit(template_partial, ("cube", "cell"))
 
@@ -342,7 +356,7 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
             )
 
             return template_correction_numerator_raw(
-                cube_t, v_t, w_t, config.baseline_duty, jnp)
+                _acc(cube_t), v_t, w_t, config.baseline_duty, jnp)
 
         correction_partial = tile_jit(correction_partial,
                                       ("cube", "cell", "cell"))
@@ -395,8 +409,10 @@ def _jax_tile_fns(config: CleanConfig, nbin: int, dedispersed: bool,
             )
         disp_base = None
         if stats_frame != "dedispersed":
+            # fp32 base from the (possibly bf16-stored) tile, mirroring
+            # the engine's compute-before-downcast ordering
             disp_base = dispersed_residual_base(
-                ded_t, shifts, pulse_slice=config.pulse_slice,
+                _acc(ded_t), shifts, pulse_slice=config.pulse_slice,
                 pulse_scale=config.pulse_scale,
                 pulse_active=config.pulse_region_active,
                 rotation=config.rotation,
@@ -573,12 +589,25 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
         resolve_budget_bytes,
     )
 
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_compute_dtype,
+    )
+
     dtype = jnp.dtype(config.dtype)
+    compute_dtype = resolve_compute_dtype(config.compute_dtype, dtype,
+                                          stage="streaming",
+                                          registry=registry)
+    # bf16 storage dtype for everything CUBE-SIZED (prepared tiles, raw
+    # tiles, their uploads): halves host RAM, H2D/D2H bytes and cache
+    # residency per tile, so the same stream_hbm_mb budget pins twice the
+    # tiles.  Plane-sized operands and all arithmetic stay in `dtype`.
+    store_dtype = jnp.bfloat16 if compute_dtype == "bfloat16" else dtype
     integration = config.baseline_mode == "integration"
     chunk = tiles[0].stop - tiles[0].start
     (prep, template_partial, correction_partial, diag_tile,
      combine, disp_mode, use_fused_combine) = _jax_tile_fns(
-         config, cube.shape[-1], bool(dedispersed), mesh)
+         config, cube.shape[-1], bool(dedispersed), mesh,
+         compute_dtype=compute_dtype)
     if mesh is not None:
         # meshes can span processes: every sharded tile output is gathered
         # to the host before reassembly (parallel/distributed.host_fetch)
@@ -631,7 +660,7 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
     # dispersed-frame mode derives the correction from the DISP tiles'
     # own marginal pass, so no raw retention and no raw uploads.
     keep_raw = integration and not disp_mode
-    cube_host = [pad_tile(np.asarray(cube[sl]).astype(dtype))
+    cube_host = [pad_tile(np.asarray(cube[sl]).astype(store_dtype))
                  for sl in tiles] if keep_raw else None
     nsub = cube.shape[0]
     n_tiles = len(tiles)
@@ -644,7 +673,7 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
     # smaller.  Planes first (near-free, always help), then the prepared
     # tiles (two uploads per iteration saved each), then the raw tiles.
     tile_nbytes = int(chunk) * int(cube.shape[1]) * int(cube.shape[-1]) \
-        * dtype.itemsize
+        * jnp.dtype(store_dtype).itemsize
     plan_items = [(("cell_mask",), cell_mask_full.nbytes),
                   (("orig_w",), orig_w_dtype.nbytes)]
     for i in range(n_tiles):
@@ -667,7 +696,7 @@ def _clean_exact_jax(cube, weights, freqs, dm, ref_freq, period, config,
     warm_futures = []
     for i, sl in enumerate(tiles):
         cube_t = cube_host[i] if keep_raw \
-            else pad_tile(np.asarray(cube[sl]).astype(dtype))
+            else pad_tile(np.asarray(cube[sl]).astype(store_dtype))
         # raw-tile uploads route through the cache: counted H2D always,
         # pinned for the template pass when the plan covers them
         cube_d = cache.get(("raw", i) if keep_raw else None, cube_t,

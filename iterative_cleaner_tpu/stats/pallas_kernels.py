@@ -649,7 +649,8 @@ def _marginals_kernel(disp_ref, w_ref, a_ref, t1_ref, a_acc, t1_acc):
         a_acc[...] = jnp.zeros_like(a_acc)
         t1_acc[...] = jnp.zeros_like(t1_acc)
 
-    wx = disp_ref[:] * w_ref[0][:, :, None]         # (S, C, B)
+    # bf16-stored cubes upcast per staged block: accumulation stays f32
+    wx = disp_ref[:].astype(jnp.float32) * w_ref[0][:, :, None]  # (S, C, B)
     a_acc[pl.ds(j * c_blk, c_blk), :] += jnp.sum(wx, axis=0)
     t1_acc[pl.ds(i * s_blk, s_blk), :] += jnp.sum(wx, axis=1)
 
@@ -729,7 +730,9 @@ def _marginals_fn():
 
         disp, weights = _batch_args(axis_size, in_batched, disp, weights)
         outs = jax.vmap(
-            lambda d, w: weighted_marginal_totals(d, w, jnp))(disp, weights)
+            lambda d, w: weighted_marginal_totals(
+                d.astype(jnp.float32) if d.dtype == jnp.bfloat16 else d,
+                w, jnp))(disp, weights)
         return outs, (True, True)
 
     return f
@@ -744,9 +747,9 @@ def weighted_marginals_pallas(disp, weights):
     Callers must check :data:`MARGINALS_PALLAS_MAX_BYTES` (scratch =
     2 * (nchan + nsub) * nbin * 4 bytes) and fall back to the XLA form.
     Under ``vmap`` the XLA form takes over (see the custom_vmap rule)."""
-    if disp.dtype != jnp.float32:
-        raise TypeError("weighted_marginals_pallas requires float32, got %s"
-                        % disp.dtype)
+    if disp.dtype not in (jnp.float32, jnp.bfloat16):
+        raise TypeError("weighted_marginals_pallas requires float32 (or a "
+                        "bf16-stored f32 pipeline), got %s" % disp.dtype)
     return _marginals_fn()(disp, weights.astype(jnp.float32))
 
 
@@ -851,11 +854,14 @@ def _cell_stats_kernel(ded_ref, disp_ref, rott_ref, t_ref, w_ref, m_ref,
                        std_ref, mean_ref, ptp_ref, fft_ref, *, num_k):
     t = t_ref[0]                                    # (B,)
     tt_safe, tt_zero = tt_ref[0, 0], tt_ref[0, 1]
-    ded = ded_ref[:]                                # (S, C, B)
+    # bf16-stored cubes upcast per staged (VMEM) block: the fit/residual
+    # arithmetic below is always fp32 (identity astype on f32 cubes)
+    ded = ded_ref[:].astype(jnp.float32)            # (S, C, B)
     # closed-form fit (dsp.fit_template_amplitudes, same ops/order)
     tp = jnp.sum(ded * t[None, None, :], axis=2)
     amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
-    resid = amp[:, :, None] * rott_ref[0][None] - disp_ref[:]
+    resid = amp[:, :, None] * rott_ref[0][None] - disp_ref[:].astype(
+        jnp.float32)
     wres = resid * w_ref[0][:, :, None]             # apply_weights
     _write_diags(wres, m_ref[0], cos_ref, sin_ref,
                  std_ref, mean_ref, ptp_ref, fft_ref, num_k)
@@ -866,7 +872,14 @@ def _wres_disp(disp, rott, nyq, tt_safe, tt_zero, w, *, apply_nyq):
     block: fit against the rotated template, Nyquist round-trip
     correction, weighting.  The shared body of
     :func:`_cell_stats_disp_kernel` and the sweep kernel — one op
-    sequence, bit-identical residuals by construction."""
+    sequence, bit-identical residuals by construction.
+
+    The single upcast point of the mixed-precision mode: a bf16-stored
+    cube block becomes fp32 here, INSIDE the kernel (after the HBM read /
+    DMA stage, before any arithmetic), so the sweep, multi-kernel and DMA
+    routes all inherit bf16 support from this one line — and the f32
+    routes are bit-unchanged (astype to the same dtype is a no-op)."""
+    disp = disp.astype(jnp.float32)
     tp = jnp.sum(disp * rott[None], axis=2)
     amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
     base = disp
@@ -883,7 +896,10 @@ def _wres_disp(disp, rott, nyq, tt_safe, tt_zero, w, *, apply_nyq):
 def _wres_dedisp(ded, t, win, tt_safe, tt_zero, w):
     """Dedispersed-frame weighted residual of a (S, C, B) cube block:
     ``(amp*t - ded) * window``, weighted.  Shared by
-    :func:`_cell_stats_dedisp_kernel` and the sweep kernel."""
+    :func:`_cell_stats_dedisp_kernel` and the sweep kernel.  Like
+    :func:`_wres_disp`, the bf16 storage mode upcasts here — one line
+    covers every route that stages this body's cube blocks."""
+    ded = ded.astype(jnp.float32)
     tp = jnp.sum(ded * t[None, None, :], axis=2)
     amp = jnp.where(tt_zero != 0, jnp.ones_like(tp), tp / tt_safe)
     resid = (amp[:, :, None] * t[None, None, :] - ded) * win[None, None, :]
@@ -1104,10 +1120,15 @@ def _cell_stats_call(ded, disp_base, rot_t, template, tt_info, weights,
 
 def _fused_tables(nbin, dtype):
     """Shared validation + DFT tables for the fused kernels.
-    Returns (cos_t, sin_t, num_k, interpret)."""
-    if dtype != jnp.float32:
-        raise TypeError("fused cell diagnostics require float32, got %s"
-                        % dtype)
+    Returns (cos_t, sin_t, num_k, interpret).
+
+    bf16 is admitted alongside f32: it is the mixed-precision STORAGE
+    dtype of an f32 pipeline — the kernel bodies upcast each staged cube
+    block (:func:`_wres_disp`/:func:`_wres_dedisp`) and every
+    table/output/accumulator here stays f32."""
+    if dtype not in (jnp.float32, jnp.bfloat16):
+        raise TypeError("fused cell diagnostics require float32 (or a "
+                        "bf16-stored f32 pipeline), got %s" % dtype)
     if nbin > FUSED_STATS_MAX_NBIN:
         raise ValueError(
             f"fused cell diagnostics support nbin <= {FUSED_STATS_MAX_NBIN} "
@@ -1393,8 +1414,11 @@ def _dma_dedisp_kernel(ded_hbm, t_ref, win_ref, w_ref, m_ref,
                  std_ref, mean_ref, ptp_ref, fft_ref, num_k)
 
 
-def _dma_scratch(sc):
-    return [pltpu.VMEM((2, sc.s_blk, sc.c_blk, sc.nbin), jnp.float32),
+def _dma_scratch(sc, dtype=jnp.float32):
+    # the staging buffer matches the cube's STORAGE dtype (bf16 under the
+    # mixed-precision mode — the DMA moves narrow bytes; the kernel body
+    # upcasts after the wait), not the f32 compute dtype
+    return [pltpu.VMEM((2, sc.s_blk, sc.c_blk, sc.nbin), dtype),
             pltpu.SemaphoreType.DMA((2,))]
 
 
@@ -1416,7 +1440,8 @@ def _shard_diags_disp_call(disp, rot_t, nyq_row, tt_info, weights,
          sc.pad_chan_row(nyq_row), weights, cell_mask),
         (pl.BlockSpec(memory_space=pltpu.ANY), sc.chan_row_spec,
          sc.chan_row_spec, sc.cell_spec, sc.cell_spec),
-        cos_t, sin_t, tt_info, interpret, scratch_shapes=_dma_scratch(sc),
+        cos_t, sin_t, tt_info, interpret,
+        scratch_shapes=_dma_scratch(sc, disp.dtype),
     )
 
 
@@ -1436,7 +1461,8 @@ def _shard_diags_dedisp_call(ded, template, window, tt_info, weights,
         (sc.pad_cube(ded), template, window, weights, cell_mask),
         (pl.BlockSpec(memory_space=pltpu.ANY), sc.row_spec, sc.row_spec,
          sc.cell_spec, sc.cell_spec),
-        cos_t, sin_t, tt_info, interpret, scratch_shapes=_dma_scratch(sc),
+        cos_t, sin_t, tt_info, interpret,
+        scratch_shapes=_dma_scratch(sc, ded.dtype),
     )
 
 

@@ -72,6 +72,14 @@ TRACKED: Dict[str, Track] = {
     "streaming_tile_passes_per_s": Track("higher", 0.35,
                                          "streaming_platform"),
     "fused_vs_unfused": Track("higher", 0.30, "fused_platform"),
+    # bf16/fp32 warm wall-clock ratio: lower is better; wide band — on
+    # CPU the interpret-mode kernels make it an overhead document and
+    # committed rounds mix machines
+    "bf16_vs_fp32": Track("lower", 0.50, "bf16_platform"),
+    # trace-level cube read bytes bf16/fp32: deterministic 0.5 (half the
+    # bytes per read site), so a tight band — any rise means a kernel
+    # stopped taking its cube in bf16 storage
+    "bf16_cube_bytes_ratio": Track("lower", 0.25, "bf16_platform"),
     "online_subint_p99_ms": Track("lower", 0.50, "online_platform"),
     "mux_vs_sequential": Track("higher", 0.30, "mux_platform"),
     "mux_aggregate_subints_per_s": Track("higher", 0.35, "mux_platform"),

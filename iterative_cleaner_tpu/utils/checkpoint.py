@@ -40,6 +40,13 @@ _IDENTITY_EXCLUDE = {"unload_res", "record_history",
                      # at every setting (tests/test_fused_sweep.py), so a
                      # resume under a different --fused-sweep must match
                      "fused_sweep",
+                     # compute_dtype=bfloat16 only changes WHERE the fp32
+                     # upcast happens (bf16 HBM storage, fp32 arithmetic);
+                     # masks are bit-equal on bf16-exact inputs and any
+                     # stage whose parity probe disagrees falls back to
+                     # fp32 (tests/test_mixed_precision.py), so a resume
+                     # under a different --compute-dtype must match
+                     "compute_dtype",
                      "fleet_retries", "stage_timeout_s",
                      # host placement/lease knobs: which process serves a
                      # bucket never changes its mask — stolen work must

@@ -47,15 +47,17 @@ def test_bench_small_end_to_end_json_schema():
     contract: one JSON line with the driver-read keys."""
     import json
 
-    # BENCH_SKIP_MULTIHOST / BENCH_SKIP_ELASTIC / BENCH_SKIP_MESH: those
-    # rows launch several CLI/daemon processes (or compile the sharded
-    # program twice) — more wall-clock than this tier-1 test's budget
-    # allows.  test_bench_multihost_row_keys, test_bench_elastic_row_keys
-    # and test_bench_mesh_row_keys (slow) pin their keys instead; CI's
-    # bench smoke runs the full BENCH_SMALL set including them.
+    # BENCH_SKIP_MULTIHOST / BENCH_SKIP_ELASTIC / BENCH_SKIP_MESH /
+    # BENCH_SKIP_BF16: those rows launch several CLI/daemon processes (or
+    # compile the engine/sharded program twice) — more wall-clock than
+    # this tier-1 test's budget allows.  test_bench_multihost_row_keys,
+    # test_bench_elastic_row_keys, test_bench_mesh_row_keys and
+    # test_bench_bf16_row_keys (slow) pin their keys instead; CI's bench
+    # smoke runs the full BENCH_SMALL set including them.
     proc = _run_repo_script("bench.py", extra_env=(
         ("BENCH_SMALL", "1"), ("BENCH_SKIP_MULTIHOST", "1"),
-        ("BENCH_SKIP_ELASTIC", "1"), ("BENCH_SKIP_MESH", "1")))
+        ("BENCH_SKIP_ELASTIC", "1"), ("BENCH_SKIP_MESH", "1"),
+        ("BENCH_SKIP_BF16", "1")))
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, proc.stdout
@@ -262,6 +264,31 @@ def test_bench_mesh_row_keys():
     assert out["mesh_devices"] == 4
     assert out["mesh_vs_single"] > 0
     assert out["mesh_sweep_cube_reads"] == 1
+
+
+@pytest.mark.slow
+def test_bench_bf16_row_keys():
+    """The mixed-precision row (--compute-dtype bfloat16 vs the fp32
+    default through the fused-sweep engine) in isolation: the driver and
+    CI read these keys from the headline JSON.  Mask parity on the
+    bf16-exact archive and the probe's bf16 eligibility are rc-7-fatal
+    inside the stage; the cube-bytes ratio is a deterministic
+    trace-level measure (half the bytes per read site)."""
+    import json
+
+    proc = _run_repo_script("bench.py", extra_env=(
+        ("BENCH_BF16_ONLY", json.dumps(
+            {"nsub": 16, "nchan": 32, "nbin": 64, "max_iter": 2})),))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    err = proc.stderr[-3000:]
+    for key in ("bf16_geometry", "bf16_platform", "bf16_vs_fp32",
+                "bf16_cube_bytes_ratio", "bf16_cube_read_bytes",
+                "bf16_fp32_cube_read_bytes"):
+        assert key in out, (key, err)
+    assert out["bf16_vs_fp32"] > 0
+    assert 0 < out["bf16_cube_bytes_ratio"] <= 0.6
+    assert out["bf16_cube_read_bytes"] > 0
 
 
 @pytest.mark.slow

@@ -1,0 +1,343 @@
+"""Mixed-precision hot path (``--compute-dtype bfloat16``): knob
+resolution, parity, and fallback contracts.
+
+The tentpole promise is narrow and checkable: bf16 is a STORAGE format
+for cube-sized operands only — every accumulation, the float32-bit-
+pattern-keyed kth-select, scalers and thresholds stay fp32 — so on a
+bf16-exact cube (every sample on the bfloat16 grid, zero channel
+shifts, rotation='roll', exactly-zero baseline window) the downcast is
+lossless and the masks must be BIT-EQUAL to the fp32 run on every
+route: engine, batch, streaming-exact, online, mux, forced-4-device
+mesh.  Where the backend cannot honour that (wide dtype, parity-probe
+mismatch) the resolve helper downgrades the stage to fp32 with a
+labeled counter — never an error, and never a checkpoint-identity
+change.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io import make_synthetic_archive
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("ICLEAN_SKIP_JAX") == "1", reason="jax-only suite")
+
+
+def _bf16_exact_archive(nsub=8, nchan=16, nbin=32, seed=0):
+    """An archive whose whole engine pipeline is bf16-lossless: samples
+    on the bf16 grid, dm=0 (zero shifts), the last quarter of every
+    profile exactly zero (with non-negative samples the min-mean
+    baseline window lands there, so the subtracted baseline is exactly
+    0), RFI spikes confined to the first half."""
+    import jax.numpy as jnp
+
+    ar, _ = make_synthetic_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                   seed=seed, dtype=np.float32, dm=0.0,
+                                   disperse=False)
+    rng = np.random.default_rng(seed)
+    phase = (np.arange(nbin) + 0.5) / nbin
+    profile = np.exp(-0.5 * ((phase - 0.3) / 0.05) ** 2)
+    spectrum = 1.0 + 0.5 * np.arange(nchan) / nchan
+    gain = 1.0 + 0.3 * np.arange(nsub) / max(1, nsub)
+    cube = (30.0 * gain[:, None, None] * spectrum[None, :, None]
+            * profile[None, None, :]).astype(np.float32)
+    cube[:, :, 3 * nbin // 4:] = 0.0
+    cells = rng.choice(nsub * nchan, size=max(4, nsub * nchan // 24),
+                       replace=False)
+    for s, c in zip(*np.unravel_index(cells, (nsub, nchan))):
+        bins = rng.integers(0, nbin // 2, size=max(1, nbin // 16))
+        cube[s, c, bins] += 40.0
+    ar.data[:, 0] = np.asarray(
+        jnp.asarray(cube, jnp.bfloat16).astype(jnp.float32))
+    ar.dm = 0.0
+    return ar
+
+
+def _cfg(**kw):
+    kw.setdefault("backend", "jax")
+    kw.setdefault("dtype", "float32")
+    kw.setdefault("rotation", "roll")
+    kw.setdefault("max_iter", 3)
+    return CleanConfig(**kw)
+
+
+# ------------------------------------------------- knob resolution
+
+
+def test_config_rejects_unknown_and_wide_compute_dtype():
+    with pytest.raises(ValueError, match="unknown compute dtype"):
+        CleanConfig(backend="jax", compute_dtype="float16")
+    # f64 compute was never offered; the rejection is unchanged
+    with pytest.raises(ValueError, match="unknown compute dtype"):
+        CleanConfig(backend="jax", compute_dtype="float64")
+    with pytest.raises(ValueError, match="requires dtype='float32'"):
+        CleanConfig(backend="jax", dtype="float64",
+                    compute_dtype="bfloat16")
+
+
+def test_resolve_compute_dtype_default_env_and_validation(monkeypatch):
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_compute_dtype,
+    )
+
+    monkeypatch.delenv("ICLEAN_COMPUTE_DTYPE", raising=False)
+    assert resolve_compute_dtype(None, jnp.float32) == "float32"
+    assert resolve_compute_dtype("float32", jnp.float64) == "float32"
+    with pytest.raises(ValueError, match="unknown compute dtype"):
+        resolve_compute_dtype("float16", jnp.float32)
+    # the env mirror only fills an unset knob; explicit wins
+    monkeypatch.setenv("ICLEAN_COMPUTE_DTYPE", "bfloat16")
+    assert resolve_compute_dtype("float32", jnp.float32) == "float32"
+    assert resolve_compute_dtype(None, jnp.float32) in ("bfloat16",
+                                                        "float32")
+
+
+def test_resolve_downgrades_wide_dtype_with_counter():
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        compute_dtype_ineligible_counts,
+        resolve_compute_dtype,
+    )
+    from iterative_cleaner_tpu.telemetry import MetricsRegistry
+    from iterative_cleaner_tpu.telemetry.registry import labeled
+
+    key = labeled("compute_dtype_ineligible", stage="t_wide",
+                  reason="dtype")
+    before = compute_dtype_ineligible_counts().get(key, 0)
+    reg = MetricsRegistry()
+    out = resolve_compute_dtype("bfloat16", jnp.float64, stage="t_wide",
+                                registry=reg)
+    assert out == "float32"
+    assert compute_dtype_ineligible_counts().get(key, 0) == before + 1
+    assert reg.counters.get(key) == 1
+
+
+def test_forced_probe_mismatch_downgrades_per_stage(monkeypatch):
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends import jax_backend as jb
+
+    monkeypatch.setitem(jb._COMPUTE_DTYPE_PROBE_CACHE, "parity", False)
+    from iterative_cleaner_tpu.telemetry import MetricsRegistry
+    from iterative_cleaner_tpu.telemetry.registry import labeled
+
+    reg = MetricsRegistry()
+    out = jb.resolve_compute_dtype("bfloat16", jnp.float32,
+                                   stage="t_probe", registry=reg)
+    assert out == "float32"
+    key = labeled("compute_dtype_ineligible", stage="t_probe",
+                  reason="parity_probe")
+    assert reg.counters.get(key) == 1
+    # the downgrade is a rung, not an error: the engine still cleans
+    res = None
+    from iterative_cleaner_tpu.backends import clean_archive
+
+    res = clean_archive(_bf16_exact_archive(4, 8, 32),
+                        _cfg(compute_dtype="bfloat16", max_iter=2))
+    assert res.final_weights.shape == (4, 8)
+
+
+def test_probe_passes_on_this_backend():
+    """The CPU/TPU backends this repo targets convert bf16<->fp32
+    IEEE-correctly; the cached probe must agree or every other parity
+    test below would be vacuously comparing fp32 against fp32."""
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        resolve_compute_dtype,
+    )
+
+    assert resolve_compute_dtype("bfloat16", jnp.float32) == "bfloat16"
+
+
+def test_checkpoint_identity_excludes_compute_dtype():
+    from iterative_cleaner_tpu.utils.checkpoint import (
+        config_hash,
+        config_identity,
+    )
+
+    a = _cfg(compute_dtype="float32")
+    b = _cfg(compute_dtype="bfloat16")
+    assert config_identity(a) == config_identity(b)
+    assert config_hash(a) == config_hash(b)
+
+
+# --------------------------------------------------- route parity
+
+
+def _final_weights(ar, cfg):
+    from iterative_cleaner_tpu.backends import clean_archive
+
+    return np.asarray(clean_archive(ar.clone(), cfg).final_weights)
+
+
+@pytest.mark.parametrize("route", [
+    dict(median_impl="sort", stats_impl="xla"),
+    dict(median_impl="pallas", stats_impl="fused", fft_mode="dft",
+         fused_sweep="on"),
+])
+def test_engine_masks_bit_equal_on_bf16_exact_cube(route):
+    ar = _bf16_exact_archive()
+    w32 = _final_weights(ar, _cfg(compute_dtype="float32", **route))
+    w16 = _final_weights(ar, _cfg(compute_dtype="bfloat16", **route))
+    np.testing.assert_array_equal(w16, w32)
+    assert np.sum(w16 == 0) > 0          # the zap actually fired
+
+
+def test_batch_masks_bit_equal_on_bf16_exact_cubes():
+    from iterative_cleaner_tpu.parallel import clean_archives_batched
+
+    ars = [_bf16_exact_archive(seed=s) for s in (0, 1, 2)]
+    outs = {}
+    for mode in ("float32", "bfloat16"):
+        cfg = _cfg(compute_dtype=mode, max_iter=2)
+        outs[mode] = clean_archives_batched([a.clone() for a in ars], cfg)
+    for r16, r32 in zip(outs["bfloat16"], outs["float32"]):
+        np.testing.assert_array_equal(r16.final_weights, r32.final_weights)
+
+
+def test_streaming_masks_bit_equal_and_h2d_halves():
+    from iterative_cleaner_tpu.parallel import clean_streaming_exact
+    from iterative_cleaner_tpu.telemetry import MetricsRegistry
+
+    ar = _bf16_exact_archive()
+    res, h2d, peak = {}, {}, {}
+    for mode in ("float32", "bfloat16"):
+        reg = MetricsRegistry()
+        res[mode] = clean_streaming_exact(
+            ar.clone(), 2, _cfg(compute_dtype=mode, max_iter=2),
+            registry=reg)
+        h2d[mode] = int(reg.counters.get("stream_h2d_bytes", 0))
+        peak[mode] = int(reg.gauges.get("stream_cache_peak_bytes", 0))
+    np.testing.assert_array_equal(res["bfloat16"].final_weights,
+                                  res["float32"].final_weights)
+    # cube-SIZED traffic exactly halves (plane-sized operands and their
+    # uploads stay fp32 in both runs, so the saving is precisely half
+    # the fp32 cube bytes) and cache residency follows: the same
+    # stream_hbm_mb budget therefore pins twice the tiles
+    cube_f32_bytes = ar.nsub * ar.nchan * ar.nbin * 4
+    assert h2d["float32"] - h2d["bfloat16"] == cube_f32_bytes // 2, h2d
+    assert 0 < peak["bfloat16"] < peak["float32"], peak
+
+
+def test_streaming_integration_mode_masks_bit_equal():
+    from iterative_cleaner_tpu.parallel import clean_streaming_exact
+
+    ar = _bf16_exact_archive()
+    res = {}
+    for mode in ("float32", "bfloat16"):
+        res[mode] = clean_streaming_exact(
+            ar.clone(), 2, _cfg(compute_dtype=mode, max_iter=2,
+                                baseline_mode="integration"))
+    np.testing.assert_array_equal(res["bfloat16"].final_weights,
+                                  res["float32"].final_weights)
+
+
+def test_online_step_masks_bit_equal_and_key_carries_dtype():
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.online.step import (
+        build_subint_step,
+        step_build_key,
+    )
+
+    ar = _bf16_exact_archive(4, 8, 32)
+    cube = np.asarray(ar.total_intensity(), np.float32)
+    freqs = np.asarray(ar.freqs_mhz, np.float32)
+    outs = {}
+    for mode in ("float32", "bfloat16"):
+        cfg = _cfg(compute_dtype=mode, max_iter=2)
+        step, dtype = build_subint_step(cfg, 8, 32, False, 0.125)
+        step = jax.jit(step)
+        tmpl = jnp.zeros((32,), dtype)
+        outs[mode] = step(
+            jnp.asarray(cube[:1], dtype), jnp.ones((1, 8), dtype),
+            jnp.asarray(freqs, dtype), jnp.asarray(0.0, dtype),
+            jnp.asarray(ar.centre_freq_mhz, dtype),
+            jnp.asarray(ar.period_s, dtype), tmpl,
+            jnp.asarray(0, jnp.int32))
+    for a, b in zip(outs["bfloat16"], outs["float32"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    k32 = step_build_key(_cfg(compute_dtype="float32"), 8, 32, False, 0.1)
+    k16 = step_build_key(_cfg(compute_dtype="bfloat16"), 8, 32, False, 0.1)
+    assert k32 != k16                     # distinct compile buckets
+    assert "bfloat16" in k16
+
+
+def test_mux_masks_bit_equal_with_fp32_solo_sessions():
+    from iterative_cleaner_tpu.online import OnlineSession, StreamMeta
+    from iterative_cleaner_tpu.online.mux import StreamMux
+
+    n_sub = 4
+    streams = []
+    for s in range(2):
+        ar = _bf16_exact_archive(n_sub, 8, 32, seed=50 + s)
+        streams.append((StreamMeta.from_archive(ar),
+                        np.asarray(ar.total_intensity(), np.float64)))
+    cfg16 = _cfg(compute_dtype="bfloat16", max_iter=2,
+                 stream_reconcile_every=0)
+    cfg32 = _cfg(compute_dtype="float32", max_iter=2,
+                 stream_reconcile_every=0)
+    refs = []
+    for meta, cube in streams:
+        sess = OnlineSession(meta, cfg32)
+        for i in range(n_sub):
+            sess.ingest(cube[i])
+        refs.append(np.asarray(sess.provisional_weights))
+    mux = StreamMux(max_batch=2, max_wait_ms=0.0)
+    for k, (meta, _) in enumerate(streams):
+        mux.open(f"s{k}", meta, cfg16)
+    for i in range(n_sub):
+        for k, (_, cube) in enumerate(streams):
+            mux.ingest(f"s{k}", cube[i])
+        mux.pump(force=True)
+    for k, ref in enumerate(refs):
+        np.testing.assert_array_equal(
+            np.asarray(mux.session(f"s{k}").provisional_weights), ref)
+
+
+def test_mesh_masks_bit_equal_on_forced_mesh():
+    import jax
+
+    from iterative_cleaner_tpu.backends.jax_backend import clean_cube
+    from iterative_cleaner_tpu.parallel.mesh import cell_mesh
+    from iterative_cleaner_tpu.parallel.sharding import clean_cube_sharded
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the forced multi-device CPU platform")
+    mesh = cell_mesh(4)
+    ar = _bf16_exact_archive()
+    args_of = lambda: (ar.total_intensity(), ar.weights, ar.freqs_mhz,
+                       ar.dm, ar.centre_freq_mhz, ar.period_s)
+    w = {}
+    for mode in ("float32", "bfloat16"):
+        cfg = _cfg(compute_dtype=mode, max_iter=2)
+        w[mode, "single"] = np.asarray(
+            clean_cube(*args_of(), cfg).final_weights)
+        w[mode, "mesh"] = np.asarray(
+            clean_cube_sharded(*args_of(), cfg, mesh).final_weights)
+    np.testing.assert_array_equal(w["bfloat16", "mesh"],
+                                  w["float32", "mesh"])
+    np.testing.assert_array_equal(w["bfloat16", "mesh"],
+                                  w["bfloat16", "single"])
+
+
+# ------------------------------------------------------- CLI wiring
+
+
+def test_cli_flag_parses_into_config(tmp_path):
+    from iterative_cleaner_tpu.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        ["x.npz", "--backend", "jax", "--compute-dtype", "bfloat16"])
+    cfg = config_from_args(args)
+    assert cfg.compute_dtype == "bfloat16"
+    args = build_parser().parse_args(["x.npz", "--backend", "jax"])
+    assert config_from_args(args).compute_dtype is None

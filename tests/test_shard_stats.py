@@ -171,21 +171,44 @@ def test_sharded_honours_dedispersed_flag():
                                   sharded.final_weights)
 
 
-def test_uneven_grid_fails_fast():
-    """NamedSharding rejects uneven shards deep inside jit; the sharded
-    entry point surfaces that as an immediate, actionable error instead."""
-    ar = _archive(nsub=10, nchan=34)  # 10 % 2 == 0 but 34 % 4 != 0
-    mesh = _mesh()
-    assert not shard_divisible(mesh, 10, 34)
-    for cfg in (CleanConfig(median_impl="pallas", max_iter=2,
-                            rotation="roll", fft_mode="dft",
+def test_uneven_grid_pads_and_crops():
+    """An indivisible cell grid no longer fails fast: the sharded entry
+    point zero-weight pads up to mesh divisibility (pad cells are masked
+    out of every statistic and can never change), cleans the padded grid
+    — keeping the one-launch sharded route — and crops the outputs +
+    corrects the zap telemetry back to the raw geometry, bit-equal to the
+    single-device engine."""
+    from iterative_cleaner_tpu.backends.jax_backend import clean_cube
+
+    # deliberately indivisible on BOTH axes of the forced 4-device (2, 2)
+    # mesh: 9 % 2 != 0 and 33 % 2 != 0
+    ar = _archive(nsub=9, nchan=33)
+    mesh = cell_mesh(4)
+    assert dict(mesh.shape) == {"sub": 2, "chan": 2}
+    assert not shard_divisible(mesh, 9, 33)
+    for cfg in (CleanConfig(median_impl="pallas", stats_impl="fused",
+                            max_iter=2, rotation="roll", fft_mode="dft",
                             dtype="float32"),
                 CleanConfig(max_iter=2, rotation="roll", fft_mode="dft",
                             dtype="float32")):
-        with pytest.raises(ValueError, match="mesh axis must divide"):
-            clean_cube_sharded(ar.total_intensity(), ar.weights,
-                               ar.freqs_mhz, ar.dm, ar.centre_freq_mhz,
-                               ar.period_s, cfg, mesh)
+        single = clean_cube(ar.total_intensity(), ar.weights, ar.freqs_mhz,
+                            ar.dm, ar.centre_freq_mhz, ar.period_s, cfg)
+        sharded = clean_cube_sharded(ar.total_intensity(), ar.weights,
+                                     ar.freqs_mhz, ar.dm,
+                                     ar.centre_freq_mhz, ar.period_s,
+                                     cfg, mesh)
+        assert sharded.final_weights.shape == (9, 33)
+        assert sharded.scores.shape == (9, 33)
+        np.testing.assert_array_equal(single.final_weights,
+                                      sharded.final_weights)
+        assert sharded.loops == single.loops
+        assert sharded.converged == single.converged
+        # zap telemetry corrected for the always-zero pad cells: the
+        # counts must match the unpadded engine's raw device values
+        np.testing.assert_array_equal(single.iter_metrics[:, 0],
+                                      sharded.iter_metrics[:, 0])
+        np.testing.assert_allclose(single.loop_rfi_frac,
+                                   sharded.loop_rfi_frac, rtol=1e-6)
 
 
 # --- tree-reduced kth-select merges (the sharded fused sweep's combine) ----
