@@ -51,8 +51,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="include suppressed findings in text output")
     p.add_argument("--journal-fsck", action="append", default=[],
                    metavar="JOURNAL",
-                   help="validate a fleet journal file against the "
-                        "protocol state machine (grammar, request "
+                   help="validate a fleet journal — a file, or a "
+                        "segmented journal directory (manifest grammar "
+                        "+ every segment + shard routing) — against "
+                        "the protocol state machine (grammar, request "
                         "lifecycle, lease monotonicity, torn tail); "
                         "repeatable; standalone — skips the lint pass")
     p.add_argument("--race-sweep", action="store_true",

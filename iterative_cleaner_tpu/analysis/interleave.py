@@ -33,6 +33,14 @@ Seeded-bug scenarios (:func:`build_scenario` with ``bug=...``) revert
 known fixes in memory — the PR-12 admit-ordering and pool-count fixes
 among them — and the test suite asserts the checker catches every one;
 the CI gate runs the clean variants and must come back green.
+
+Every scenario is additionally parameterized over the journal
+**backend** (``build_scenario(..., backend="segmented")`` /
+``sweep(backends=...)``): the same actors, invariants and seeded bugs
+run against a segmented journal directory with a few-hundred-byte seal
+threshold, so schedules constantly cross seal and compaction boundaries
+— the machine-checked form of the fold-equivalence contract the
+segmented backend claims (resilience/segmented.py).
 """
 
 from __future__ import annotations
@@ -47,6 +55,13 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from iterative_cleaner_tpu.resilience.journal import FleetJournal
+
+#: journal backends every scenario can run against
+BACKENDS = ("file", "segmented")
+
+#: a few hundred bytes: segmented scenarios seal every couple of lines,
+#: so every schedule crosses seal/compaction boundaries mid-protocol
+_SEGMENT_MB = 0.0003
 
 #: scenario name -> the seeded bugs build_scenario() accepts for it
 SCENARIOS: Dict[str, Tuple[str, ...]] = {
@@ -135,12 +150,18 @@ class Env:
     in-memory operations (scheduler calls, clock advances)."""
 
     def __init__(self, controller: "_Controller", path: str,
-                 tmpdir: str) -> None:
+                 tmpdir: str, backend: str = "file") -> None:
         self._controller = controller
         self.path = path
         self.tmpdir = tmpdir
+        self.backend = backend
+        #: how to build a journal over ``path`` with this backend —
+        #: scenarios that substitute their own journal subclass reuse it
+        self.journal_kwargs: Dict[str, object] = (
+            {"backend": "segmented", "segment_mb": _SEGMENT_MB}
+            if backend == "segmented" else {})
         self.clock = VirtualClock()
-        self.journal = InstrumentedJournal(path)
+        self.journal = InstrumentedJournal(path, **self.journal_kwargs)
         self.journal._env = self
         self.data: Dict[str, object] = {}
 
@@ -216,6 +237,7 @@ class Scenario:
     invariant_step: Optional[Callable[[Env], None]] = None
     invariant_final: Optional[Callable[[Env], None]] = None
     bug: Optional[str] = None
+    backend: str = "file"
 
 
 @dataclasses.dataclass
@@ -292,8 +314,10 @@ class _Controller:
 
     # ----------------------------------------------- controller side
     def run(self, tmpdir: str) -> RunResult:
-        path = os.path.join(tmpdir, "journal.jsonl")
-        env = Env(self, path, tmpdir)
+        backend = self.scenario.backend
+        path = os.path.join(tmpdir, "journal.d" if backend == "segmented"
+                            else "journal.jsonl")
+        env = Env(self, path, tmpdir, backend=backend)
         if self.scenario.setup is not None:
             self.scenario.setup(env)
         threads = []
@@ -434,11 +458,14 @@ class ExploreResult:
     elapsed_s: float
     budget_exhausted: bool = False
     counterexample: Optional[RunResult] = None
+    backend: str = "file"
 
     def render(self) -> str:
         plural = "" if self.schedules == 1 else "s"
         head = (f"{self.scenario}"
                 + (f" [bug={self.bug}]" if self.bug else "")
+                + (f" [backend={self.backend}]"
+                   if self.backend != "file" else "")
                 + f": {'ok' if self.ok else 'FAILED'}, "
                 + f"{self.schedules} schedule{plural} "
                 + f"in {self.elapsed_s:.2f}s"
@@ -527,10 +554,12 @@ def explore(scenario: Scenario, *, mode: str = "dfs",
                 return ExploreResult(scenario.name, scenario.bug, False,
                                      schedules,
                                      time.monotonic() - t0,
-                                     counterexample=res)
+                                     counterexample=res,
+                                     backend=scenario.backend)
         return ExploreResult(scenario.name, scenario.bug, True, schedules,
                              time.monotonic() - t0,
-                             budget_exhausted=budget_exhausted)
+                             budget_exhausted=budget_exhausted,
+                             backend=scenario.backend)
 
     if mode != "dfs":
         raise ValueError(f"unknown mode {mode!r}")
@@ -554,7 +583,8 @@ def explore(scenario: Scenario, *, mode: str = "dfs",
             res = minimize(scenario, res, max_steps=max_steps)
             return ExploreResult(scenario.name, scenario.bug, False,
                                  schedules, time.monotonic() - t0,
-                                 counterexample=res)
+                                 counterexample=res,
+                                 backend=scenario.backend)
         for i in range(len(prefix), len(res.decisions)):
             d = res.decisions[i]
             explored = [d.chosen]
@@ -585,7 +615,8 @@ def explore(scenario: Scenario, *, mode: str = "dfs",
             budget_exhausted = True
     return ExploreResult(scenario.name, scenario.bug, True, schedules,
                          time.monotonic() - t0,
-                         budget_exhausted=budget_exhausted)
+                         budget_exhausted=budget_exhausted,
+                         backend=scenario.backend)
 
 
 # --------------------------------------------------------------------------
@@ -596,18 +627,17 @@ def _fsck_step(env: Env) -> None:
     """Every prefix of the journal must satisfy the fsck state machine
     — 'accepted' strictly precedes 'running'/'done' in FILE order, no
     line after terminal, leases monotone.  This is the live bridge
-    between the model checker and ``--journal-fsck``."""
-    from iterative_cleaner_tpu.analysis.journal_fsck import fsck_text
+    between the model checker and ``--journal-fsck`` (which handles
+    segment directories natively, manifest and shard routing included)."""
+    from iterative_cleaner_tpu.analysis.journal_fsck import fsck_journal
 
     if not os.path.exists(env.path):
         return
-    with open(env.path, "r", encoding="utf-8", errors="replace") as f:
-        text = f.read()
-    issues, _counts, _n = fsck_text(text)
-    errors = [i for i in issues if i.severity == "error"]
-    if errors:
+    report = fsck_journal(env.path)
+    if report.errors:
         raise InvariantViolation(
-            "journal fsck failed mid-schedule: " + errors[0].render())
+            "journal fsck failed mid-schedule: "
+            + report.errors[0].render())
 
 
 def _scenario_claim_race(bug: Optional[str]) -> Scenario:
@@ -902,7 +932,7 @@ def _scenario_compact_prefix(bug: Optional[str]) -> Scenario:
             return out + list(last_claim.values())
 
     def setup(env: Env) -> None:
-        journal = _MirroredJournal(env.path)
+        journal = _MirroredJournal(env.path, **env.journal_kwargs)
         journal._mirror = os.path.join(env.tmpdir, "mirror.jsonl")
         journal._env = env
         env.journal = journal
@@ -959,9 +989,11 @@ _BUILDERS = {
 }
 
 
-def build_scenario(name: str, bug: Optional[str] = None) -> Scenario:
+def build_scenario(name: str, bug: Optional[str] = None,
+                   backend: str = "file") -> Scenario:
     """A scenario by name; ``bug`` seeds the named in-memory revert
-    (must be one of ``SCENARIOS[name]``)."""
+    (must be one of ``SCENARIOS[name]``); ``backend`` picks the journal
+    storage the drill runs against (one of ``BACKENDS``)."""
     if name not in _BUILDERS:
         raise ValueError(
             f"unknown scenario {name!r} (known: {', '.join(sorted(_BUILDERS))})")
@@ -969,31 +1001,43 @@ def build_scenario(name: str, bug: Optional[str] = None) -> Scenario:
         raise ValueError(
             f"scenario {name!r} has no seeded bug {bug!r} "
             f"(known: {', '.join(SCENARIOS[name])})")
-    return _BUILDERS[name](bug)
+    if backend not in BACKENDS:
+        raise ValueError(
+            f"unknown journal backend {backend!r} "
+            f"(known: {', '.join(BACKENDS)})")
+    scenario = _BUILDERS[name](bug)
+    scenario.backend = backend
+    return scenario
 
 
 def sweep(*, max_schedules: int = 2000, max_steps: int = 400,
           budget_s: float = 60.0, seed: int = 0,
+          backends: Sequence[str] = BACKENDS,
           stream=None) -> List[ExploreResult]:
-    """The CI gate: exhaustively explore every CLEAN scenario (plus a
-    short seeded-random tail for depth) within one shared budget.  All
-    results must be ok; any counterexample is the caller's artifact."""
+    """The CI gate: exhaustively explore every CLEAN scenario against
+    every journal backend (plus a short seeded-random tail for depth)
+    within one shared budget.  All results must be ok; any
+    counterexample is the caller's artifact."""
     t0 = time.monotonic()
     results: List[ExploreResult] = []
     for name in sorted(SCENARIOS):
-        remaining = max(budget_s - (time.monotonic() - t0), 1.0)
-        res = explore(build_scenario(name), mode="dfs",
-                      max_schedules=max_schedules, max_steps=max_steps,
-                      budget_s=remaining, seed=seed)
-        if res.ok and not res.budget_exhausted:
+        for backend in backends:
             remaining = max(budget_s - (time.monotonic() - t0), 1.0)
-            tail = explore(build_scenario(name), mode="random",
-                           max_schedules=25, max_steps=max_steps,
-                           budget_s=min(remaining, budget_s / 10.0),
-                           seed=seed + 1)
-            if not tail.ok:
-                res = tail
-        results.append(res)
-        if stream is not None:
-            print(res.render(), file=stream)
+            res = explore(build_scenario(name, backend=backend),
+                          mode="dfs",
+                          max_schedules=max_schedules,
+                          max_steps=max_steps,
+                          budget_s=remaining, seed=seed)
+            if res.ok and not res.budget_exhausted:
+                remaining = max(budget_s - (time.monotonic() - t0), 1.0)
+                tail = explore(build_scenario(name, backend=backend),
+                               mode="random",
+                               max_schedules=25, max_steps=max_steps,
+                               budget_s=min(remaining, budget_s / 10.0),
+                               seed=seed + 1)
+                if not tail.ok:
+                    res = tail
+            results.append(res)
+            if stream is not None:
+                print(res.render(), file=stream)
     return results

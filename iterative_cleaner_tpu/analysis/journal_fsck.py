@@ -31,9 +31,20 @@ checks any journal file against it:
   writer bypassed the locked append path or replayed stale lines —
   either breaks the fold's "everyone reads the same order" guarantee.
 
-Entry points: :func:`fsck_journal` (one file → :class:`FsckReport`),
-``icln-lint --journal-fsck PATH`` (analysis/cli.py) and
-:func:`record_fsck` (counters for /metrics — the CI gate and the serve
+* **segment directories** — a segmented journal (``--journal DIR``,
+  resilience/segmented.py) is checked as a whole: the manifest must
+  parse under its own schema and only name well-formed segment files of
+  the right shard; each shard's stream (live sealed segments in
+  sequence order, then the active segment) runs through the same state
+  machine — per-key total order is preserved within a shard, so the
+  lifecycle and lease checks stay valid verbatim; and every line must
+  actually ROUTE to the shard it lives in (``entry_key`` →
+  ``stable_shard``), because a mis-routed line breaks the per-key
+  ordering guarantee every fold depends on.
+
+Entry points: :func:`fsck_journal` (one file or segment directory →
+:class:`FsckReport`), ``icln-lint --journal-fsck PATH`` (analysis/cli.py)
+and :func:`record_fsck` (counters for /metrics — the CI gate and the serve
 daemon both publish the verdict of the journals they actually produced).
 """
 
@@ -78,6 +89,8 @@ class FsckIssue:
 class FsckReport:
     path: str
     n_lines: int = 0
+    #: segment files examined (0 for a single-file journal)
+    n_segments: int = 0
     counts: Dict[str, int] = dataclasses.field(
         default_factory=lambda: {k: 0 for k in EVENT_KINDS})
     issues: List[FsckIssue] = dataclasses.field(default_factory=list)
@@ -101,6 +114,7 @@ class FsckReport:
             "path": self.path,
             "ok": self.ok,
             "n_lines": self.n_lines,
+            "n_segments": self.n_segments,
             "counts": dict(self.counts),
             "errors": [dataclasses.asdict(i) for i in self.errors],
             "warnings": [dataclasses.asdict(i) for i in self.warnings],
@@ -110,9 +124,13 @@ class FsckReport:
         out = [i.render() for i in self.issues]
         tally = ", ".join("%d %s" % (self.counts[k], k)
                           for k in EVENT_KINDS if self.counts[k])
-        out.append("%s: %s — %d line%s (%s), %d error%s, %d warning%s"
+        seg = ("" if not self.n_segments
+               else " in %d segment%s" % (self.n_segments,
+                                          "" if self.n_segments == 1
+                                          else "s"))
+        out.append("%s: %s — %d line%s%s (%s), %d error%s, %d warning%s"
                    % (self.path, "ok" if self.ok else "FAILED",
-                      self.n_lines, "" if self.n_lines == 1 else "s",
+                      self.n_lines, "" if self.n_lines == 1 else "s", seg,
                       tally or "empty",
                       len(self.errors), "" if len(self.errors) == 1 else "s",
                       len(self.warnings),
@@ -327,9 +345,158 @@ def fsck_text(text: str, *, skew_s: float = 0.0) -> Tuple[
     return issues, counts, len(lines)
 
 
+def _check_manifest(path: str, report: FsckReport) -> Optional[dict]:
+    """Validate a segment directory's manifest grammar; returns the
+    parsed manifest, or None when it is too broken to fold over (the
+    errors are already on the report)."""
+    from iterative_cleaner_tpu.resilience.segmented import (
+        MANIFEST_NAME, MANIFEST_SCHEMA, segment_parts)
+
+    man_path = os.path.join(path, MANIFEST_NAME)
+    if not os.path.isfile(man_path):
+        report.issues.append(FsckIssue(
+            0, "manifest", "error",
+            f"segment directory has no {MANIFEST_NAME}: not a segmented "
+            f"journal (or its atomic initial write never landed)"))
+        return None
+    try:
+        with open(man_path, "r", encoding="utf-8") as f:
+            man = json.load(f)
+    except ValueError as exc:
+        report.issues.append(FsckIssue(
+            0, "manifest", "error",
+            f"{MANIFEST_NAME} is not valid JSON ({exc}): manifest "
+            f"rewrites are atomic, so a torn manifest means a writer "
+            f"bypassed the locked rewrite path"))
+        return None
+    if not isinstance(man, dict) or man.get("schema") != MANIFEST_SCHEMA:
+        report.issues.append(FsckIssue(
+            0, "manifest", "error",
+            f"{MANIFEST_NAME} schema is "
+            f"{man.get('schema') if isinstance(man, dict) else man!r}, "
+            f"expected {MANIFEST_SCHEMA!r}"))
+        return None
+    n_shards = man.get("n_shards")
+    if not isinstance(n_shards, int) or isinstance(n_shards, bool) \
+            or n_shards <= 0:
+        report.issues.append(FsckIssue(
+            0, "manifest", "error",
+            f"n_shards is {n_shards!r}, expected a positive int"))
+        return None
+    shards = man.get("shards")
+    if not isinstance(shards, dict):
+        report.issues.append(FsckIssue(
+            0, "manifest", "error",
+            f"shards is {_type_name(shards)}, expected an object"))
+        return None
+    ok = True
+    for key in sorted(shards):
+        ent = shards[key]
+        if not (key.isdigit() and int(key) < n_shards):
+            report.issues.append(FsckIssue(
+                0, "manifest", "error",
+                f"shard key {key!r} is not a decimal index in "
+                f"[0, {n_shards})"))
+            ok = False
+            continue
+        if not isinstance(ent, dict):
+            report.issues.append(FsckIssue(
+                0, "manifest", "error",
+                f"shard {key} entry is {_type_name(ent)}, expected an "
+                f"object"))
+            ok = False
+            continue
+        for field in ("segments", "dead"):
+            names = ent.get(field)
+            if not isinstance(names, list):
+                report.issues.append(FsckIssue(
+                    0, "manifest", "error",
+                    f"shard {key} {field!r} is "
+                    f"{_type_name(names)}, expected a list"))
+                ok = False
+                continue
+            for name in names:
+                parts = (segment_parts(name)
+                         if isinstance(name, str) else None)
+                if parts is None or parts[1] != int(key):
+                    report.issues.append(FsckIssue(
+                        0, "manifest", "error",
+                        f"shard {key} {field} entry {name!r} is not a "
+                        f"segment name of this shard"))
+                    ok = False
+    return man if ok else None
+
+
+def _fsck_segment_dir(path: str, *, skew_s: float) -> FsckReport:
+    """Validate a segmented journal directory: manifest grammar, every
+    shard's stream through the single-file state machine (per-key order
+    is preserved within a shard, so lifecycle/lease checks carry over
+    verbatim), plus the shard-routing invariant."""
+    from iterative_cleaner_tpu.parallel.distributed import stable_shard
+    from iterative_cleaner_tpu.resilience.journal import entry_key
+    from iterative_cleaner_tpu.resilience.segmented import SegmentedLog
+
+    report = FsckReport(path=path)
+    man = _check_manifest(path, report)
+    if man is None:
+        return report
+    log = SegmentedLog(path)  # manifest exists: read-only construction
+    n_shards = log.n_shards
+    names = log._names_on_disk()
+    for shard in range(n_shards):
+        chunks = []
+        for name in log._effective(shard, man, names):
+            seg_path = os.path.join(path, name)
+            try:
+                chunks.append(log._read_file(seg_path))
+                report.n_segments += 1
+            except OSError:
+                report.issues.append(FsckIssue(
+                    0, "manifest", "error",
+                    f"shard {shard}: listed segment {name} is missing "
+                    f"on disk (and not on the dead list) — a manifest "
+                    f"swap retired it without listing it dead"))
+        try:
+            chunks.append(log._read_file(log._active_path(shard)))
+            report.n_segments += 1
+        except OSError:
+            pass  # no active segment: this shard is fully sealed
+        text = "".join(chunks)
+        issues, counts, n_lines = fsck_text(text, skew_s=skew_s)
+        report.issues.extend(dataclasses.replace(
+            i, message=f"shard {shard}: {i.message}") for i in issues)
+        for kind, n in counts.items():
+            report.counts[kind] += n
+        report.n_lines += n_lines
+        for lineno, raw in enumerate(text.splitlines(), start=1):
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                continue  # torn line: already a warning above
+            if not isinstance(entry, dict) \
+                    or entry.get("event") not in EVENT_KINDS:
+                continue  # grammar error: already reported above
+            want = stable_shard(entry_key(entry), n_shards)
+            if want != shard:
+                report.issues.append(FsckIssue(
+                    lineno, "shard-routing", "error",
+                    f"shard {shard}: {entry.get('event')} line with key "
+                    f"{entry_key(entry)!r} routes to shard {want} — a "
+                    f"mis-routed line breaks per-key total order, the "
+                    f"one property every fold depends on"))
+    return report
+
+
 def fsck_journal(path: str, *, skew_s: float = 0.0) -> FsckReport:
-    """Validate one journal file.  A missing file is an error (the gate
-    is pointed at journals a drill claims to have produced)."""
+    """Validate one journal — a single file, or a segmented journal
+    directory (dispatches on ``os.path.isdir``).  A missing path is an
+    error (the gate is pointed at journals a drill claims to have
+    produced)."""
+    if os.path.isdir(path):
+        return _fsck_segment_dir(path, skew_s=skew_s)
     report = FsckReport(path=path)
     if not os.path.isfile(path):
         report.issues.append(FsckIssue(
@@ -350,6 +517,7 @@ def record_fsck(registry, report: FsckReport) -> None:
 
     registry.gauge_set("journal_fsck_ok", 1 if report.ok else 0)
     registry.gauge_set("journal_fsck_lines", report.n_lines)
+    registry.gauge_set("journal_fsck_segments", report.n_segments)
     for issue in report.issues:
         name = ("journal_fsck_errors" if issue.severity == "error"
                 else "journal_fsck_warnings")

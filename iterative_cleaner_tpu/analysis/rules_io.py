@@ -26,8 +26,8 @@ FLOCK_IMPL = ("utils/logging.py",)
 
 #: helpers that take the per-file flock internally
 LOCK_HELPERS = frozenset({
-    "locked_append", "compact_under_lock", "trim_log", "rotate_log",
-    "append_clean_log",
+    "locked_append", "compact_under_lock", "seal_log", "trim_log",
+    "rotate_log", "append_clean_log",
 })
 
 

@@ -55,7 +55,7 @@ CLAIM_ACQUIRERS = frozenset({"try_claim", "_claim_for_execute"})
 JOURNAL_MUTATORS = frozenset({
     "record_done", "record_request", "record_claim", "record_member",
     "record_cache", "record_host_stats", "try_claim", "heartbeat",
-    "release", "compact",
+    "release", "compact", "compact_shard", "seal",
 })
 
 #: request states only the execution-claim holder may journal
@@ -457,6 +457,7 @@ class JournalClaimRule(Rule):
 
     def check(self, ctx: FileContext) -> Iterable[Tuple[int, str]]:
         if ctx.rel.endswith("resilience/journal.py") \
+                or ctx.rel.endswith("resilience/segmented.py") \
                 or "/analysis/" in ctx.rel:
             return
         # grammar bypass: raw _append anywhere outside the journal impl

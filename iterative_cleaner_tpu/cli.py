@@ -203,10 +203,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--journal-fsck", action="append", default=[],
                         metavar="JOURNAL", dest="journal_fsck",
                         help="With --selfcheck: additionally validate "
-                             "a fleet journal file against the "
-                             "protocol state machine (request "
-                             "lifecycle, claim/member lease grammar, "
-                             "torn-tail healing, lease monotonicity). "
+                             "a fleet journal file or segmented "
+                             "journal directory against the protocol "
+                             "state machine (request lifecycle, claim/"
+                             "member lease grammar, torn-tail healing, "
+                             "lease monotonicity; plus manifest and "
+                             "shard-routing checks for directories). "
                              "Repeatable; fsck errors fail the check.")
     parser.add_argument("--no-donate", "--no_donate", action="store_true",
                         dest="no_donate",
@@ -361,7 +363,18 @@ def build_parser() -> argparse.ArgumentParser:
                              "config hash; a later --resume run skips "
                              "journaled work. With --serve, overrides the "
                              "daemon's request-lifecycle journal path "
-                             "(default serve.journal.jsonl).")
+                             "(default serve.journal.jsonl). A DIRECTORY "
+                             "(or a path ending in a separator, created on "
+                             "demand) selects the segmented backend: "
+                             "hash-partitioned segment files sealed and "
+                             "compacted concurrently with live traffic.")
+    parser.add_argument("--journal-segment-mb", "--journal_segment_mb",
+                        type=float, default=None, dest="journal_segment_mb",
+                        metavar="MB",
+                        help="Segmented journal only: seal a shard's active "
+                             "segment once it exceeds MB megabytes (default "
+                             "4). Mirrors ICLEAN_JOURNAL_SEGMENT_MB; "
+                             "ignored for single-file journals.")
     parser.add_argument("--resume", action="store_true",
                         help="Skip archives the --journal records as "
                              "complete under the same config, after "
@@ -1133,7 +1146,9 @@ def _run_fleet(args, telemetry=None) -> list:
                 if args.faults else FaultInjector.from_env()),
         retry=RetryPolicy(max_retries=resolve_retries(cfg.fleet_retries)),
         stage_timeout_s=resolve_stage_timeout(cfg.stage_timeout_s),
-        journal=(FleetJournal(journal_path) if journal_path else None),
+        journal=(FleetJournal(journal_path,
+                              segment_mb=args.journal_segment_mb)
+                 if journal_path else None),
         resume=args.resume,
     )
 
@@ -1221,6 +1236,7 @@ def _run_serve(args, telemetry=None) -> int:
             http_port=args.http_port,
             max_inflight=args.max_inflight,
             journal_path=args.journal or None,
+            journal_segment_mb=args.journal_segment_mb,
             trace_out=args.trace_out or None,
             # store_true flags: absent means "defer to the env mirror"
             join=args.join or None,

@@ -347,6 +347,11 @@ class ServeConfig:
     # exceeds journal_max_mb, trim clean.log beyond log_max_mb
     journal_max_mb: float = 16.0
     log_max_mb: float = 16.0
+    # segmented journal (``--journal DIR``): seal a shard's active
+    # segment once it reaches this size (``--journal-segment-mb`` /
+    # ``ICLEAN_JOURNAL_SEGMENT_MB``); None = backend default (4 MB).
+    # Ignored by the single-file backend.
+    journal_segment_mb: Optional[float] = None
     # Perfetto/Chrome trace_events export path: every finished span also
     # spools to `<trace_out>.spans.jsonl` and the daemon renders the full
     # trace file at shutdown; None disables the export (spans still live
@@ -407,6 +412,8 @@ class ServeConfig:
             "queue_limit": env("ICLEAN_SERVE_QUEUE", int, 64),
             "trace_out": env("ICLEAN_TRACE_OUT", str, None),
             "join": env("ICLEAN_JOIN", flag, False),
+            "journal_segment_mb": env("ICLEAN_JOURNAL_SEGMENT_MB",
+                                      float, None),
             "member_ttl_s": env("ICLEAN_MEMBER_TTL", float, 15.0),
             "result_cache": env("ICLEAN_RESULT_CACHE", flag, False),
             "profile_dir": env("ICLEAN_PROFILE_DIR", str, None),
@@ -448,6 +455,11 @@ class ServeConfig:
                              "crash-safe queue state lives there)")
         if self.journal_max_mb <= 0 or self.log_max_mb <= 0:
             raise ValueError("journal_max_mb/log_max_mb must be > 0")
+        if self.journal_segment_mb is not None \
+                and self.journal_segment_mb <= 0:
+            raise ValueError(
+                f"journal_segment_mb must be > 0 (the segmented "
+                f"backend's seal threshold), got {self.journal_segment_mb}")
         if self.member_ttl_s <= 0:
             raise ValueError(
                 f"member_ttl_s must be > 0 (the membership lease "

@@ -72,7 +72,11 @@ This module is that bucketing, plus the serving pipeline around it:
    with bit-equal masks (``fleet_stolen``/``fleet_buckets_owned``/
    ``fleet_claim_conflicts``).  No collectives on the serve path, so a
    dead host can never hang the survivors; whole-slice telemetry folds
-   from per-host journal 'stats' snapshots instead.
+   from per-host journal 'stats' snapshots instead.  The journal path may
+   be a single file or a segmented directory
+   (:mod:`iterative_cleaner_tpu.resilience.segmented`) — every fold here
+   is backend-agnostic, and a multi-host run seals its shards on exit so
+   the next maintenance pass can compact them.
 
 Mask parity: with quantization off (``bucket_pad=(0, 0)``, the default) every
 archive's results are bit-equal to the sequential per-archive path — batch
@@ -671,6 +675,10 @@ def clean_fleet(paths: Sequence[str], config: CleanConfig, *,
                 reg.counter_inc("fleet_remote_done")
         _publish_host_stats(topo, reg, report, res.journal,
                             reg.counters_since(mark))
+        # on a segmented journal, seal each shard's active segment so a
+        # short-lived batch run leaves compactable sealed segments behind
+        # (a long-lived pool seals by size; nobody seals for us here)
+        res.journal.seal()
     record_builder_cache_stats(reg)
     if fleet_span is not None:
         fleet_span.set("n_cleaned", len(report.results))

@@ -114,6 +114,19 @@ inputs that actually exist).  The rewrite runs under the appenders'
 flock via
 :func:`~iterative_cleaner_tpu.utils.logging.compact_under_lock`, so
 compacting under live traffic loses no entries.
+
+**Backends.**  :class:`FleetJournal` delegates storage to a
+:class:`JournalLog` backend: :class:`SingleFileLog` (the historical
+one-file layout above — the default, byte-compatible, zero migration)
+or the segmented backend
+(:class:`~iterative_cleaner_tpu.resilience.segmented.SegmentedLog`,
+selected by pointing ``--journal`` at a DIRECTORY): per-shard sealed
+segment files hash-partitioned by each entry's identity key
+(:func:`entry_key`), an ``icln-journal/2`` manifest, and compaction
+that touches only sealed files so it runs concurrently with live
+appends.  The line grammar, the folds and every protocol invariant
+are backend-independent — which the PR-13 interleaving model checker
+verifies by re-running all five protocol scenarios against both.
 """
 
 from __future__ import annotations
@@ -173,33 +186,225 @@ def _parse_lines(text: str):
             yield entry
 
 
-class FleetJournal:
-    """Append-only completion log for one fleet output set.
+def entry_key(entry: dict) -> str:
+    """One entry's identity key — the string every fold groups by, and
+    therefore the segmented backend's shard-routing key.  Partitioning
+    by this key preserves each key's total line order across segments,
+    which is the one property the folds need (every fold is
+    per-identity-key; none observes cross-key interleaving)."""
+    event = entry.get("event", "")
+    if event == "done":
+        return "done:%s" % entry.get("path", "")
+    if event == "req":
+        return "req:%s" % entry.get("req", "")
+    if event == "claim":
+        return "claim:%s" % entry.get("work", "")
+    if event == "member":
+        return "member:%s" % entry.get("member", "")
+    if event == "cache":
+        return "cache:%s" % entry.get("key", "")
+    if event == "stats":
+        return "stats:%s" % entry.get("host", "")
+    return "event:%s" % event
 
-    Sharing one journal between concurrent fleets over disjoint path sets
-    is safe (flock'd appends, per-path keys); the reader keeps the LAST
-    entry per path, so re-cleans of a changed input supersede."""
+
+class JournalLog:
+    """The storage contract :class:`FleetJournal` folds over: append /
+    scan / seal / compact.  Two implementations — the historical
+    :class:`SingleFileLog` and the per-shard
+    :class:`~iterative_cleaner_tpu.resilience.segmented.SegmentedLog` —
+    must be fold-equivalent: for any append sequence, ``scan_text``
+    parses to the same per-key line order, so every fold produces the
+    same tables (the backend-equivalence test fixture and the PR-13
+    model checker both enforce exactly this)."""
+
+    backend = "abstract"
+    n_shards = 1
+
+    def append(self, key: str, text: str) -> bool:
+        """Durably append one pre-serialized line routed by ``key``;
+        returns True when a torn-tail heal fired."""
+        raise NotImplementedError
+
+    def scan_text(self) -> str:
+        """Every live line as one text (the folds' input)."""
+        raise NotImplementedError
+
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+    def size_bytes(self) -> int:
+        """The bytes a fold must read (the compaction trigger)."""
+        raise NotImplementedError
+
+    def seal(self) -> int:
+        """Retire open segments (no-op for a single file); returns how
+        many sealed."""
+        raise NotImplementedError
+
+    def compact(self, live_lines_fn, now=None) -> bool:
+        """Rewrite keeping only ``live_lines_fn(text, now)``; True when
+        a rewrite happened."""
+        raise NotImplementedError
+
+    def compact_shard(self, shard: int, live_lines_fn, now=None) -> bool:
+        """Compact one shard (the maintenance role's unit of work)."""
+        raise NotImplementedError
+
+    def segment_counts(self) -> Dict[int, int]:
+        """shard -> live sealed segment count ({} for a single file)."""
+        raise NotImplementedError
+
+
+class SingleFileLog(JournalLog):
+    """The historical backend: one flock'd JSON-lines file.  Default,
+    byte-compatible with every journal ever written, zero migration."""
+
+    backend = "file"
+    n_shards = 1
 
     def __init__(self, path: str) -> None:
         self.path = os.path.abspath(path)
 
-    def _append(self, entry: dict) -> None:
+    def append(self, key: str, text: str) -> bool:
         from iterative_cleaner_tpu.utils.logging import locked_append
 
-        text = json.dumps(entry, sort_keys=True) + "\n"
         # heal a torn tail: a writer killed mid-line leaves no trailing
         # newline, and appending straight after it would glue THIS line
         # onto the garbage — losing a good entry, not just the torn one.
         # The probe races other appenders at worst into a spurious blank
         # line, which readers skip.
+        healed = False
         try:
             with open(self.path, "rb") as f:
                 f.seek(-1, os.SEEK_END)
                 if f.read(1) != b"\n":
                     text = "\n" + text
+                    healed = True
         except (OSError, ValueError):
             pass          # absent or empty file: nothing to heal
         locked_append(self.path, text)
+        return healed
+
+    def scan_text(self) -> str:
+        if not os.path.exists(self.path):
+            return ""
+        with open(self.path, "r") as f:
+            return f.read()
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def size_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def seal(self) -> int:
+        return 0
+
+    def compact(self, live_lines_fn, now=None) -> bool:
+        from iterative_cleaner_tpu.utils.logging import compact_under_lock
+
+        def rewrite(text: str) -> str:
+            return "".join(ln + "\n" for ln in live_lines_fn(text, now))
+
+        return compact_under_lock(self.path, rewrite)
+
+    def compact_shard(self, shard: int, live_lines_fn, now=None) -> bool:
+        # one file IS one shard; any shard id maps onto it
+        return self.compact(live_lines_fn, now=now)
+
+    def segment_counts(self) -> Dict[int, int]:
+        return {}
+
+
+def _looks_segmented(abs_path: str, raw_path: str) -> bool:
+    """Backend auto-detection: a directory (existing, or spelled with a
+    trailing separator, or holding an ``icln-journal/2`` manifest)
+    selects the segmented backend; any plain file path keeps the
+    byte-compatible single-file backend — zero migration."""
+    from iterative_cleaner_tpu.resilience.segmented import MANIFEST_NAME
+
+    if os.path.isdir(abs_path):
+        return True
+    if str(raw_path).endswith(("/", os.sep)):
+        return True
+    return os.path.isfile(os.path.join(abs_path, MANIFEST_NAME))
+
+
+class FleetJournal:
+    """Append-only completion log for one fleet output set.
+
+    Sharing one journal between concurrent fleets over disjoint path sets
+    is safe (flock'd appends, per-path keys); the reader keeps the LAST
+    entry per path, so re-cleans of a changed input supersede.
+
+    ``path`` names either a single journal file (default backend) or a
+    segment directory (segmented backend — see :func:`_looks_segmented`
+    for the detection rule; ``backend=`` forces one).  ``registry`` (a
+    ``MetricsRegistry``) turns on journal health telemetry:
+    ``journal_torn_heals``, ``journal_compactions`` and the
+    ``journal_fold_s`` histogram."""
+
+    def __init__(self, path: str, *, backend: Optional[str] = None,
+                 segment_mb: Optional[float] = None,
+                 n_shards: Optional[int] = None,
+                 registry=None) -> None:
+        self.path = os.path.abspath(path)
+        self.registry = registry
+        if backend is None:
+            backend = ("segmented" if _looks_segmented(self.path, path)
+                       else "file")
+        if backend == "segmented":
+            from iterative_cleaner_tpu.resilience.segmented import (
+                SegmentedLog,
+            )
+
+            seg_bytes = (int(segment_mb * 1e6)
+                         if segment_mb else None)
+            self.log: JournalLog = SegmentedLog(
+                self.path, segment_bytes=seg_bytes, n_shards=n_shards)
+        elif backend == "file":
+            self.log = SingleFileLog(self.path)
+        else:
+            raise ValueError(f"unknown journal backend {backend!r}")
+
+    @property
+    def backend(self) -> str:
+        return self.log.backend
+
+    def _append(self, entry: dict) -> None:
+        text = json.dumps(entry, sort_keys=True) + "\n"
+        healed = self.log.append(entry_key(entry), text)
+        if healed:
+            # a heal means some writer died mid-line here since the last
+            # append — count it and leave a flight-recorder breadcrumb
+            # so post-crash restarts are diagnosable, not silent
+            if self.registry is not None:
+                self.registry.counter_inc("journal_torn_heals")
+            from iterative_cleaner_tpu.telemetry.recorder import (
+                record_active,
+            )
+
+            record_active("journal", "event",
+                          {"name": "torn_heal", "path": self.path,
+                           "backend": self.log.backend})
+
+    def _scan_text(self) -> str:
+        """The backend's full text, fold-timed into ``journal_fold_s``
+        when a registry is attached (every fold below starts here, so
+        one observation point covers them all)."""
+        if self.registry is None:
+            return self.log.scan_text()
+        t0 = time.perf_counter()
+        text = self.log.scan_text()
+        from iterative_cleaner_tpu.telemetry.registry import SECONDS
+
+        self.registry.histogram_observe(
+            "journal_fold_s", time.perf_counter() - t0, buckets=SECONDS)
+        return text
 
     def record_done(self, in_path: str, *, config_hash: str,
                     out_path: Optional[str] = None,
@@ -229,15 +434,12 @@ class FleetJournal:
         Unparseable lines (the torn tail of a killed writer) and entries
         from other configs/schemas are skipped, never fatal."""
         out: Dict[str, dict] = {}
-        if not os.path.exists(self.path):
-            return out
-        with open(self.path, "r") as f:
-            for entry in _parse_lines(f.read()):
-                if (entry.get("event") != "done"
-                        or entry.get("config") != config_hash
-                        or not entry.get("path")):
-                    continue
-                out[entry["path"]] = entry
+        for entry in _parse_lines(self._scan_text()):
+            if (entry.get("event") != "done"
+                    or entry.get("config") != config_hash
+                    or not entry.get("path")):
+                continue
+            out[entry["path"]] = entry
         return out
 
     # ---------------------------------------------- request lifecycle
@@ -260,17 +462,14 @@ class FleetJournal:
         state seen.  The torn-tail/foreign-line tolerance of
         :meth:`completed` applies."""
         out: Dict[str, dict] = {}
-        if not os.path.exists(self.path):
-            return out
-        with open(self.path, "r") as f:
-            for entry in _parse_lines(f.read()):
-                if entry.get("event") != "req" or not entry.get("req"):
-                    continue
-                rid = entry["req"]
-                prev = out.get(rid, {})
-                merged = dict(prev)
-                merged.update(entry)
-                out[rid] = merged
+        for entry in _parse_lines(self._scan_text()):
+            if entry.get("event") != "req" or not entry.get("req"):
+                continue
+            rid = entry["req"]
+            prev = out.get(rid, {})
+            merged = dict(prev)
+            merged.update(entry)
+            out[rid] = merged
         return out
 
     # ------------------------------------------------------ work claims
@@ -347,10 +546,7 @@ class FleetJournal:
         foreign lines are skipped, never fatal."""
         if now is None:
             now = time.time()
-        if not os.path.exists(self.path):
-            return {}
-        with open(self.path, "r") as f:
-            owners = self._fold_claims(_parse_lines(f.read()))
+        owners = self._fold_claims(_parse_lines(self._scan_text()))
         for own in owners.values():
             own["live"] = own["expires"] > now
         return owners
@@ -395,19 +591,16 @@ class FleetJournal:
     def host_stats(self) -> Dict[int, dict]:
         """host id -> last recorded counter snapshot."""
         out: Dict[int, dict] = {}
-        if not os.path.exists(self.path):
-            return out
-        with open(self.path, "r") as f:
-            for entry in _parse_lines(f.read()):
-                if entry.get("event") != "stats":
-                    continue
-                try:
-                    host = int(entry.get("host"))
-                except (TypeError, ValueError):
-                    continue
-                counters = entry.get("counters")
-                if isinstance(counters, dict):
-                    out[host] = counters
+        for entry in _parse_lines(self._scan_text()):
+            if entry.get("event") != "stats":
+                continue
+            try:
+                host = int(entry.get("host"))
+            except (TypeError, ValueError):
+                continue
+            counters = entry.get("counters")
+            if isinstance(counters, dict):
+                out[host] = counters
         return out
 
     # ------------------------------------------------- pool membership
@@ -455,10 +648,7 @@ class FleetJournal:
         skipped, never fatal."""
         if now is None:
             now = time.time()
-        if not os.path.exists(self.path):
-            return {}
-        with open(self.path, "r") as f:
-            members = self._fold_members(_parse_lines(f.read()))
+        members = self._fold_members(_parse_lines(self._scan_text()))
         for m in members.values():
             m["live"] = m["expires"] > now
         return members
@@ -501,13 +691,10 @@ class FleetJournal:
         proof: a reader must re-verify the recorded signatures
         (:func:`entry_is_current`) before serving the recorded output."""
         out: Dict[str, dict] = {}
-        if not os.path.exists(self.path):
-            return out
-        with open(self.path, "r") as f:
-            for entry in _parse_lines(f.read()):
-                if entry.get("event") != "cache" or not entry.get("key"):
-                    continue
-                out[entry["key"]] = entry
+        for entry in _parse_lines(self._scan_text()):
+            if entry.get("event") != "cache" or not entry.get("key"):
+                continue
+            out[entry["key"]] = entry
         return out
 
     # ----------------------------------------------------- compaction
@@ -606,16 +793,43 @@ class FleetJournal:
         return lines
 
     def compact(self) -> bool:
-        """Atomically rewrite the journal keeping only the live lines
+        """Rewrite the journal keeping only the live lines
         (:meth:`live_lines`) — the long-lived daemon's growth bound.
-        Concurrent appenders lose nothing: the rewrite holds their flock
-        and they detect the inode swap
-        (:func:`~iterative_cleaner_tpu.utils.logging.compact_under_lock`).
-        Returns True when a rewrite happened."""
-        from iterative_cleaner_tpu.utils.logging import compact_under_lock
+        Single-file backend: one atomic rewrite under the appenders'
+        flock (concurrent appenders detect the inode swap and lose
+        nothing).  Segmented backend: per-shard compaction of SEALED
+        segments only, fully concurrent with live appends.  Returns
+        True when a rewrite happened."""
+        changed = self.log.compact(self.live_lines)
+        if changed and self.registry is not None:
+            self.registry.counter_inc("journal_compactions")
+        return changed
 
-        def rewrite(text: str) -> str:
-            lines = self.live_lines(text)
-            return "".join(ln + "\n" for ln in lines)
+    def compact_shard(self, shard: int) -> bool:
+        """Compact one shard — the maintenance role's unit of work, so
+        members holding a ``maint:<shard>`` lease each grind their own
+        shard without contending.  On the single-file backend every
+        shard id maps onto the one file."""
+        changed = self.log.compact_shard(int(shard), self.live_lines)
+        if changed and self.registry is not None:
+            self.registry.counter_inc("journal_compactions")
+        return changed
 
-        return compact_under_lock(self.path, rewrite)
+    def seal(self) -> int:
+        """Retire open segments (segmented backend; no-op on a single
+        file) so a short-lived writer leaves its lines compactable by
+        whoever holds the maintenance lease next."""
+        return self.log.seal()
+
+    def n_shards(self) -> int:
+        return self.log.n_shards
+
+    def size_bytes(self) -> int:
+        """The bytes a fold must read — the daemon's compaction
+        trigger, meaningful on both backends."""
+        return self.log.size_bytes()
+
+    def segment_counts(self) -> Dict[int, int]:
+        """shard -> live sealed segment count ({} on the single-file
+        backend) — the healthz / telemetry view of journal shape."""
+        return self.log.segment_counts()

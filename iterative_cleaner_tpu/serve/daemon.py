@@ -139,7 +139,9 @@ class ServeDaemon:
         self.io_workers = io_workers
         self.quiet = quiet
         self.events = events
-        self.journal = FleetJournal(serve_config.journal_path)
+        self.journal = FleetJournal(
+            serve_config.journal_path, registry=self.registry,
+            segment_mb=getattr(serve_config, "journal_segment_mb", None))
         # the black box: always armed in a daemon (a crash with no dump
         # is the failure mode this PR exists to kill); path "" disables.
         self.recorder = (FlightRecorder(path=serve_config.flight_recorder)
@@ -632,6 +634,15 @@ class ServeDaemon:
             "journal_lag_s": (round(now - self._journal_read_ts, 3)
                               if self._journal_read_ts is not None
                               else None),
+            # which JournalLog backend this pool folds over, and — for
+            # the segmented one — the live sealed-segment count per
+            # shard (the shape a maintenance-role stall shows up in)
+            "journal_backend": self.journal.backend,
+            "journal_segments": ({str(k): v for k, v in
+                                  sorted(self.journal.segment_counts()
+                                         .items())}
+                                 if self.journal.backend == "segmented"
+                                 else None),
             "accepted": int(counters.get("serve_accepted", 0)),
             "completed": int(counters.get("serve_completed", 0)),
             "failed": int(counters.get("serve_failed", 0)),
@@ -1173,20 +1184,29 @@ class ServeDaemon:
     def _maintain(self) -> None:
         """Idle-time growth bounds: compact the journal, trim clean.log
         and rotate the event log once they cross their configured sizes.
-        All three hold the appenders' flock, so maintenance is safe under
-        live traffic."""
+        Single-file journal compaction holds the appenders' flock (safe
+        under live traffic); segmented compaction touches only sealed
+        segments, so it does not even contend — pool members coordinate
+        per shard through ``maint:<shard>`` leases instead
+        (:meth:`_maintain_segments`)."""
+        from iterative_cleaner_tpu.telemetry.registry import labeled
         from iterative_cleaner_tpu.utils.logging import rotate_log, trim_log
 
         cfg = self.serve_config
-        try:
-            jsz = os.path.getsize(self.journal.path)
-        except OSError:
-            jsz = 0
-        if jsz > cfg.journal_max_mb * 1e6:
+        jsz = self.journal.size_bytes()
+        self.registry.gauge_set("journal_live_bytes", float(jsz))
+        seg_counts = self.journal.segment_counts()
+        for shard, n in sorted(seg_counts.items()):
+            self.registry.gauge_set(
+                labeled("journal_segments", shard=str(shard)), float(n))
+        if self.journal.backend == "segmented":
+            self._maintain_segments(
+                seg_counts, force=jsz > cfg.journal_max_mb * 1e6)
+        elif jsz > cfg.journal_max_mb * 1e6:
             if self.journal.compact():
                 self.registry.counter_inc("serve_journal_compactions")
                 self._say("serve: compacted journal (%d -> %d bytes)"
-                          % (jsz, os.path.getsize(self.journal.path)))
+                          % (jsz, self.journal.size_bytes()))
         if trim_log("clean.log", int(cfg.log_max_mb * 1e6)):
             self.registry.counter_inc("serve_log_trims")
         # the event log is append-only spans/events: unlike clean.log its
@@ -1197,6 +1217,35 @@ class ServeDaemon:
             self.registry.counter_inc("serve_eventlog_rotations")
             self._say("serve: rotated event log %s -> %s.1"
                       % (ev_path, ev_path))
+
+    def _maintain_segments(self, seg_counts: Dict[int, int],
+                           force: bool) -> None:
+        """The segmented journal's background maintenance role: compact
+        any shard with a sealed backlog (≥ 2 live segments; with
+        ``force`` — live bytes over ``--journal-max-mb`` — a lone
+        uncompacted segment qualifies too).  In a pool, a member only
+        grinds a shard after winning its ``maint:<shard>`` lease through
+        the ordinary claim grammar, so concurrent members shard the
+        maintenance work instead of duplicating it; compaction itself
+        touches only sealed segments, concurrent with everyone's live
+        appends."""
+        for shard, n in sorted(seg_counts.items()):
+            if n < (1 if force else 2):
+                continue
+            if self.membership is not None:
+                if not self.membership.claim_maintenance(shard):
+                    continue  # another member holds this shard's lease
+                try:
+                    self._compact_one_shard(shard)
+                finally:
+                    self.membership.release_maintenance(shard)
+            else:
+                self._compact_one_shard(shard)
+
+    def _compact_one_shard(self, shard: int) -> None:
+        if self.journal.compact_shard(shard):
+            self.registry.counter_inc("serve_journal_compactions")
+            self._say("serve: compacted journal shard %d" % shard)
 
     # ------------------------------------------------------------ signals
     def _on_signal(self, signum, _frame) -> None:

@@ -136,6 +136,24 @@ class PoolMembership:
         self.journal.record_member(self.member_id, "leave",
                                    host=self.host, ttl_s=0.0, now=now)
 
+    # ------------------------------------------------------ maintenance
+    def claim_maintenance(self, shard: int,
+                          now: Optional[float] = None) -> bool:
+        """Try to win the ``maint:<shard>`` lease — the segmented
+        journal's background maintenance role, taken through the
+        ordinary claim grammar so ANY member may grind any shard and
+        two members never compact the same shard concurrently.  The
+        ttl covers one compaction pass; a member that dies mid-grind
+        simply lets the lease lapse and a peer takes over."""
+        return self.journal.try_claim(
+            "maint:%d" % int(shard), host=self.host,
+            nonce=self.member_id, ttl_s=max(self.ttl_s, 30.0), now=now)
+
+    def release_maintenance(self, shard: int,
+                            now: Optional[float] = None) -> None:
+        self.journal.release("maint:%d" % int(shard), host=self.host,
+                             nonce=self.member_id, now=now)
+
     # ------------------------------------------------------------- view
     def members(self, now: Optional[float] = None) -> Dict[str, dict]:
         """The folded roster: member-id -> ``{"host", "expires", "live"}``."""

@@ -27,6 +27,11 @@ through to a real clean — a broken cache can cost time, never
 correctness.  A request is served from cache only when EVERY path
 verifies (all-or-nothing): partial hits run the fleet, whose journaled
 resume skips the already-done archives anyway.
+
+The index fold (:meth:`FleetJournal.cache_index`) is backend-agnostic:
+cache lines hash to one shard of a segmented journal by their cache
+key, so compaction retires superseded entries per shard without the
+cache ever seeing a torn index.
 """
 
 from __future__ import annotations

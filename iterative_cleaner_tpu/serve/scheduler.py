@@ -23,7 +23,10 @@ fold) lets admission see the tenant's unfinished requests across every
 member, so a greedy tenant cannot multiply its cap by spraying
 submissions at each member's front door.  A failing pool view falls
 back to the local count — admission degrades to per-host fairness,
-it never wedges intake.
+it never wedges intake.  The fold is backend-agnostic: on a segmented
+journal it reads only manifest-listed live segments, so admission
+latency stays flat as the journal ages (sealed history is compacted
+away underneath it, concurrently with this very fold).
 
 ``kind: "stream"`` requests pass admission here (``submit`` with
 ``enqueue=False`` — the per-tenant cap counts an OPEN stream as one

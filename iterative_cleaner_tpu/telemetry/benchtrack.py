@@ -81,6 +81,15 @@ TRACKED: Dict[str, Track] = {
     # stopped taking its cube in bf16 storage
     "bf16_cube_bytes_ratio": Track("lower", 0.25, "bf16_platform"),
     "online_subint_p99_ms": Track("lower", 0.50, "online_platform"),
+    # segmented-journal scale claim: admission latency aged/fresh must
+    # stay flat-ish.  Very wide band — the figure is sub-millisecond
+    # flock latency amortized against GIL contention with the
+    # concurrent compactor, so committed rounds wobble hard; the gate
+    # is for the ratio collapsing into "fold in the admission path"
+    # territory (an order of magnitude), not scheduling noise
+    "journal_admit_aged_vs_fresh": Track("lower", 1.50,
+                                         "journal_backend"),
+    "journal_fold_aged_s": Track("lower", 0.75, "journal_backend"),
     "mux_vs_sequential": Track("higher", 0.30, "mux_platform"),
     "mux_aggregate_subints_per_s": Track("higher", 0.35, "mux_platform"),
     "mux_subint_p99_ms": Track("lower", 0.50, "mux_platform"),

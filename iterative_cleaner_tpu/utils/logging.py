@@ -96,6 +96,54 @@ def compact_under_lock(path: str, rewrite) -> bool:
             fcntl.flock(f.fileno(), fcntl.LOCK_UN)
 
 
+def seal_log(path: str, sealed_path: str) -> bool:
+    """Atomically retire the append-log file at ``path`` to
+    ``sealed_path`` while excluding concurrent :func:`locked_append`
+    writers — the segmented journal's seal step.
+
+    The flock is taken on the CURRENT inode (same discipline as
+    :func:`compact_under_lock`); the rename happens while that lock is
+    held, so every append lands either in the sealed file or in the
+    fresh active file an appender re-creates after its inode-swap
+    recheck.  ``sealed_path`` must not already exist — sealed segments
+    are immutable and a clobber would silently drop a whole segment;
+    the caller guarantees freshness by minting monotonic sequence
+    numbers.  Returns False (no rename) when ``path`` does not exist,
+    ``sealed_path`` already does, or flock is unavailable — on hosts
+    without advisory locks the log simply stays unsealed."""
+    try:
+        import fcntl
+    except ImportError:
+        return False
+    while True:
+        if os.path.exists(sealed_path):
+            return False
+        try:
+            f = open(path, "rb")
+        except OSError:
+            return False
+        try:
+            try:
+                fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            except OSError:
+                return False
+            try:
+                if os.stat(path).st_ino != os.fstat(f.fileno()).st_ino:
+                    continue  # swapped while we waited: retry on the new one
+            except OSError:
+                return False  # unlinked/sealed by a racing sealer
+            if os.path.exists(sealed_path):
+                return False  # racing sealer won while we waited
+            os.replace(path, sealed_path)
+            return True
+        finally:
+            try:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+            except OSError:
+                pass
+            f.close()
+
+
 def trim_log(path: str, max_bytes: int, keep_lines: int = 10000) -> bool:
     """Bound an append-only log for long-lived processes: when ``path``
     exceeds ``max_bytes``, atomically rewrite it as its last

@@ -87,3 +87,27 @@ def repo_subprocess_env(**extra):
     env["PYTHONPATH"] = os.pathsep.join(
         [repo] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
     return env
+
+
+@pytest.fixture(params=["file", "segmented"])
+def journal_backend(request):
+    """Parameterizes journal drills over both storage backends — the
+    historical single file and the segmented directory — so every
+    protocol test asserts fold equivalence for free."""
+    return request.param
+
+
+@pytest.fixture
+def make_journal(tmp_path, journal_backend):
+    """Factory for a FleetJournal on the parameterized backend.  The
+    segmented variant uses a ~2 KB seal threshold so even short drills
+    cross seal (and therefore compaction) boundaries."""
+    from iterative_cleaner_tpu.resilience.journal import FleetJournal
+
+    def make(name="j", **kwargs):
+        if journal_backend == "segmented":
+            kwargs.setdefault("segment_mb", 0.002)
+            return FleetJournal(str(tmp_path / (name + ".d")) + os.sep,
+                                **kwargs)
+        return FleetJournal(str(tmp_path / (name + ".jsonl")), **kwargs)
+    return make

@@ -90,6 +90,16 @@ def test_bench_small_end_to_end_json_schema():
     assert out["batch_n"] >= 8
     assert out["batch_h2d_bytes"] > 0
     assert out["batch_cell_iters_per_s"] > 0
+    # segmented-journal row: device-free, so it runs even in the small
+    # smoke (BENCH_SMALL shrinks the synthetic pool; the exactly-once
+    # fold contract is rc-7-fatal inside the stage)
+    for key in ("journal_backend", "journal_members", "journal_requests",
+                "journal_admit_fresh_ms", "journal_admit_aged_ms",
+                "journal_admit_aged_vs_fresh", "journal_fold_aged_s",
+                "journal_segments_total", "journal_compactions"):
+        assert key in out, (key, err)
+    assert out["journal_backend"] == "segmented"
+    assert out["journal_admit_aged_vs_fresh"] > 0
     # fleet row (mixed-shape archives through parallel/fleet.py): the
     # compile-amortization contract is one program per bucket, and the
     # ratio must be a real measurement (parity divergence exits rc 7
@@ -232,14 +242,44 @@ def test_bench_elastic_row_keys():
     for key in ("elastic_members", "elastic_platform", "serve_failover_s",
                 "members_evicted", "requests_stolen", "elastic_takeover_s",
                 "cache_hits", "cache_hit_vs_clean", "cache_clean_s",
-                "cache_served_s"):
+                "cache_served_s", "elastic_journal_backend"):
         assert key in out, (key, err)
+    assert out["elastic_journal_backend"] == "segmented"
     assert out["elastic_members"] == 2
     assert out["members_evicted"] >= 1
     assert out["requests_stolen"] >= 1
     assert out["serve_failover_s"] > 0
     assert out["cache_hits"] >= 1
     assert out["cache_hit_vs_clean"] > 0
+
+
+def test_bench_journal_row_keys():
+    """The segmented-journal scale row in isolation (small synthetic
+    pool — the stage is device-free journal I/O, so it stays in the
+    tier-1 run): the driver and CI read these keys from the headline
+    JSON.  The exactly-once fold-under-concurrent-compaction contract
+    is rc-7-fatal inside the stage."""
+    import json
+
+    proc = _run_repo_script("bench.py", extra_env=(
+        ("BENCH_JOURNAL_ONLY", json.dumps(
+            {"n_members": 8, "n_requests": 2000, "probe": 100})),))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    err = proc.stderr[-3000:]
+    for key in ("journal_backend", "journal_members", "journal_requests",
+                "journal_admit_fresh_ms", "journal_admit_aged_ms",
+                "journal_admit_aged_vs_fresh", "journal_admit_aged_p99_ms",
+                "journal_fold_fresh_s", "journal_fold_aged_s",
+                "journal_live_bytes", "journal_segments_total",
+                "journal_compactions"):
+        assert key in out, (key, err)
+    assert out["journal_backend"] == "segmented"
+    assert out["journal_requests"] == 2000
+    assert out["journal_admit_fresh_ms"] > 0
+    assert out["journal_admit_aged_ms"] > 0
+    assert out["journal_live_bytes"] > 0
+    assert out["journal_segments_total"] >= 1
 
 
 @pytest.mark.slow

@@ -108,8 +108,9 @@ def _write_cacheable(tmp_path, name):
     return p, out
 
 
-def test_compaction_keeps_live_member_and_cache_drops_ghosts(tmp_path):
-    j = FleetJournal(str(tmp_path / "j.jsonl"))
+def test_compaction_keeps_live_member_and_cache_drops_ghosts(
+        tmp_path, make_journal):
+    j = make_journal()
     now = time.time()
     j.record_member("alive", "join", host=1, ttl_s=1e6, now=now)
     j.record_member("alive", "hb", host=1, ttl_s=1e6, now=now + 1)
@@ -118,8 +119,9 @@ def test_compaction_keeps_live_member_and_cache_drops_ghosts(tmp_path):
     j.record_member("gone", "leave", host=3, ttl_s=0.0, now=now + 1)
     p, out = _write_cacheable(tmp_path, "a.npz")
     j.record_cache(p, config_hash="cfg1", out_path=out)
+    j.seal()  # segmented: compaction only ever touches sealed segments
     assert j.compact()
-    text = open(j.path).read()
+    text = j.log.scan_text()
     assert "lapsed" not in text and "gone" not in text
     roster = j.member_table(now=now + 2)
     assert list(roster) == ["alive"] and roster["alive"]["live"]
@@ -353,18 +355,19 @@ def test_result_cache_cross_path_same_signature_misses(tmp_path):
     assert rc.lookup([p], "cfg") is not None  # original still serves
 
 
-def test_compaction_ages_out_dead_cache_lines(tmp_path):
+def test_compaction_ages_out_dead_cache_lines(tmp_path, make_journal):
     # a cache line whose signatures no longer verify can never hit again
     # (lookup re-checks the same evidence) — compaction must drop it, or
     # a long-lived daemon's journal grows one dead line per distinct
     # input forever and every pool fold re-reads them all
-    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    j = make_journal()
     pa, outa = _write_cacheable(tmp_path, "a.npz")
     pb, outb = _write_cacheable(tmp_path, "b.npz")
     j.record_cache(pa, config_hash="cfg", out_path=outa)
     j.record_cache(pb, config_hash="cfg", out_path=outb)
     assert len(j.cache_index()) == 2
     os.unlink(outb)  # b's entry is now unverifiable: dead weight
+    j.seal()
     assert j.compact()
     idx = j.cache_index()
     assert len(idx) == 1
@@ -620,26 +623,37 @@ def _start_member(tmp_path, tag, jpath, extra=(), **env):
 
 
 @pytest.mark.slow
-def test_elastic_kill9_front_door_survivor_finishes_everything(tmp_path):
-    """The elastic pool's crash contract: two members share one journal;
-    the front-door member wedges mid-request and is SIGKILLed; the
-    survivor observes the eviction, adopts the queued intake, steals the
-    in-flight request's lease and finishes every accepted request exactly
-    once, byte-identical to a batch CLI run — then answers an identical
-    resubmission from the result cache with zero device work."""
+def test_elastic_kill9_front_door_survivor_finishes_everything(
+        tmp_path, journal_backend):
+    """The elastic pool's crash contract, on both journal backends: two
+    members share one journal; the front-door member wedges mid-request
+    and is SIGKILLed; the survivor observes the eviction, adopts the
+    queued intake, steals the in-flight request's lease and finishes
+    every accepted request exactly once, byte-identical to a batch CLI
+    run — then answers an identical resubmission from the result cache
+    with zero device work.  The segmented variant seals at 10 KB, so the
+    failover happens across sealed segments and concurrent compaction."""
     geoms = [(6, 16, 32)] * 2 + [(8, 16, 32)] * 2 + [(6, 16, 32)]
     paths = _write_fleet(tmp_path, geoms, ext=".icar")
     ref_dir = tmp_path / "ref"
     ref_dir.mkdir()
     ref_paths = _write_fleet(ref_dir, geoms, ext=".icar")
     _run_batch_reference(ref_dir, ref_paths)
-    jpath = str(tmp_path / "pool.journal.jsonl")
+    if journal_backend == "segmented":
+        jpath = str(tmp_path / "pool.journal.d")
+        # pre-create so both members auto-detect the directory backend
+        FleetJournal(jpath + os.sep)
+        jflags = ["--journal-segment-mb", "0.01"]
+    else:
+        jpath = str(tmp_path / "pool.journal.jsonl")
+        jflags = []
 
     # member A (the front door): the 3rd load hangs 600s, so request
     # "big" journals its first bucket (2 archives) and wedges; the burst
     # lands entirely on A — "extra" stays journaled 'accepted' behind it
     proc_a, out_a = _start_member(tmp_path, "a", jpath,
-                                  extra=["--faults", "load:hang@3"],
+                                  extra=["--faults", "load:hang@3",
+                                         *jflags],
                                   ICLEAN_FAULT_HANG_S="600")
     _daemon_port(proc_a, out_a)
     _spool_submit(str(tmp_path / "spool_a"), "big",
@@ -662,7 +676,7 @@ def test_elastic_kill9_front_door_survivor_finishes_everything(tmp_path):
     # member B joins the pool while A is wedged; it shares A's queued
     # intake ("extra" has no execution lease, so B takes it) but must
     # not touch "big": A is alive and holds its lease
-    proc_b, out_b = _start_member(tmp_path, "b", jpath)
+    proc_b, out_b = _start_member(tmp_path, "b", jpath, extra=jflags)
     _daemon_port(proc_b, out_b)
     assert _wait_request_done(jpath, "extra", proc_b) == "done"
     assert FleetJournal(jpath).request_states()["big"]["state"] == "running"
@@ -711,3 +725,7 @@ def test_elastic_kill9_front_door_survivor_finishes_everything(tmp_path):
     assert "evicted member" in text_b
     assert "stole big from lapsed member" in text_b
     assert "adopted" in text_b
+    # the whole failover history — kill -9, steal, adoption, cache hit,
+    # and (segmented) any mid-flight seals/compactions — fscks clean
+    report = fsck_journal(jpath)
+    assert report.ok, [i.render() for i in report.issues]

@@ -1,0 +1,438 @@
+"""Segmented journal backend: crash-safety matrix, fold equivalence,
+manifest/orphan semantics, telemetry, and directory fsck.
+
+The centerpiece is the seeded-crash matrix: every ``os.replace`` call a
+seal/compact workload makes is a kill -9 boundary, and for each boundary
+we crash exactly there, reopen the directory cold, and require the folds
+to equal a reference journal that replayed the same operations without
+crashing.  This is the on-disk complement to the scheduler-level model
+checker in analysis/interleave.py.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+from iterative_cleaner_tpu.analysis.journal_fsck import (
+    fsck_journal,
+    record_fsck,
+)
+from iterative_cleaner_tpu.parallel.distributed import stable_shard
+from iterative_cleaner_tpu.resilience.journal import FleetJournal, entry_key
+from iterative_cleaner_tpu.resilience.segmented import (
+    MANIFEST_NAME,
+    MANIFEST_SCHEMA,
+    SegmentedLog,
+    compacted_name,
+    sealed_name,
+)
+from iterative_cleaner_tpu.serve.membership import PoolMembership
+from iterative_cleaner_tpu.telemetry.recorder import (
+    FlightRecorder,
+    set_active,
+)
+from iterative_cleaner_tpu.telemetry.registry import MetricsRegistry
+
+
+CFG = "cfg-seg-test"
+
+# lease timestamps sit far in the future so mid-workload compactions
+# (which age out lapsed leases against the wall clock) are fold-neutral
+# — the crash matrix then has ONE legitimate fold answer per boundary
+T0 = 4.0e9
+
+
+def _seg_dir(tmp_path, name="journal.d", **kwargs):
+    kwargs.setdefault("segment_mb", 0.0008)   # ~800 B: seals constantly
+    return FleetJournal(str(tmp_path / name) + os.sep, **kwargs)
+
+
+def _write_pair(tmp_path):
+    a = tmp_path / "in.icar"
+    b = tmp_path / "out.icar"
+    a.write_bytes(b"input-bytes")
+    b.write_bytes(b"output-bytes")
+    return str(a), str(b)
+
+
+def _workload_ops(a, b):
+    """A deterministic op tape exercising all six event kinds, with
+    seals and compactions interleaved.  Each element is (kind, fn);
+    ``seal``/``compact`` ops mutate storage only, every other op
+    appends exactly one line."""
+    ops = []
+    for i in range(6):
+        ops.append(("req", lambda j, i=i: j.record_request(
+            "r%03d" % i, "accepted", paths=["/in/%d" % i])))
+        ops.append(("claim", lambda j, i=i: j.record_claim(
+            "bucket-%d" % i, host=i % 3, nonce="n%d" % i, ttl_s=60.0,
+            now=T0 + i)))
+    ops.append(("seal", lambda j: j.seal()))
+    for i in range(3):
+        ops.append(("member", lambda j, i=i: j.record_member(
+            "m%d" % i, "join", host=i, ttl_s=60.0, now=T0 + i)))
+        ops.append(("stats", lambda j, i=i: j.record_host_stats(
+            i, {"cleaned": float(i)})))
+    ops.append(("done", lambda j: j.record_done(
+        a, config_hash=CFG, out_path=b)))
+    ops.append(("cache", lambda j: j.record_cache(
+        a, config_hash=CFG, out_path=b)))
+    ops.append(("compact", lambda j: j.compact()))
+    for i in range(6):
+        ops.append(("req", lambda j, i=i: j.record_request(
+            "r%03d" % i, "done")))
+        ops.append(("claim", lambda j, i=i: j.record_claim(
+            "bucket-%d" % i, host=i % 3, nonce="n%d" % i, ttl_s=0.0,
+            state="release", now=T0 + 200.0 + i)))
+    ops.append(("seal", lambda j: j.seal()))
+    ops.append(("compact", lambda j: j.compact()))
+    for i in range(3):
+        ops.append(("req", lambda j, i=i: j.record_request(
+            "s%d" % i, "accepted", paths=["/late/%d" % i])))
+    return ops
+
+
+def _folds(j, now=T0 + 30.0):
+    return {
+        "requests": j.request_states(),
+        "claims": j.claim_table(now=now),
+        "members": j.member_table(now=now),
+        "stats": j.host_stats(),
+        "completed": j.completed(CFG),
+        "cache": j.cache_index(),
+    }
+
+
+class _Boom(RuntimeError):
+    """The injected crash — deliberately NOT an OSError, so no heal /
+    retry path in the journal can swallow it."""
+
+
+def _run_ops(j, ops, crash_at=None):
+    """Execute the op tape against ``j`` with ``os.replace`` counted and
+    (optionally) crashed at call number ``crash_at``.  Returns (ops that
+    put a line on disk, replace-call count, crashed?).  Append ops are
+    recorded BEFORE execution: the flocked append lands before any seal
+    rename, so a crash mid-op still leaves the line durable."""
+    real = os.replace
+    calls = {"n": 0}
+
+    def patched(src, dst, *args, **kwargs):
+        calls["n"] += 1
+        if crash_at is not None and calls["n"] == crash_at:
+            raise _Boom("injected at os.replace #%d" % calls["n"])
+        return real(src, dst, *args, **kwargs)
+
+    durable = []
+    crashed = False
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(os, "replace", patched)
+        try:
+            for kind, fn in ops:
+                if kind not in ("seal", "compact"):
+                    durable.append((kind, fn))
+                fn(j)
+        except _Boom:
+            crashed = True
+    return durable, calls["n"], crashed
+
+
+def test_crash_matrix_every_replace_boundary(tmp_path):
+    """kill -9 at EVERY os.replace boundary of a seal/compact workload
+    leaves a directory a cold reopen folds identically to a journal
+    that never crashed, and that fsck passes."""
+    a, b = _write_pair(tmp_path)
+    ops = _workload_ops(a, b)
+
+    # dry run: count the replace boundaries this workload crosses
+    dry = _seg_dir(tmp_path, "dry.d")
+    _, n_boundaries, crashed = _run_ops(dry, ops)
+    assert not crashed
+    assert n_boundaries >= 15, \
+        "workload too tame to exercise seal/compact boundaries"
+
+    for k in range(1, n_boundaries + 1):
+        root = tmp_path / ("crash-%03d" % k)
+        root.mkdir()
+        j = _seg_dir(root)
+        durable, _, crashed = _run_ops(j, ops, crash_at=k)
+        assert crashed, f"boundary {k}: workload outran the dry-run count"
+
+        # replay exactly the durable prefix on a plain single-file journal
+        ref = FleetJournal(str(root / "ref.jsonl"))
+        for _, fn in durable:
+            fn(ref)
+
+        j2 = FleetJournal(str(root / "journal.d"))   # cold reopen
+        assert j2.backend == "segmented"
+        assert _folds(j2) == _folds(ref), f"boundary {k}: folds diverge"
+        report = fsck_journal(j2.path)
+        assert report.ok, f"boundary {k}: fsck: {report.render_text()}"
+
+        # the survivor keeps working: seal + compact heal any leftover
+        # orphans/dead entries.  Compaction ages out expired leases on
+        # BOTH backends (live_lines is shared), so compact the
+        # reference too before comparing.
+        j2.seal()
+        j2.compact()
+        ref.compact()
+        assert _folds(j2) == _folds(ref), \
+            f"boundary {k}: post-recovery compaction changed folds"
+        assert fsck_journal(j2.path).ok
+
+
+def test_fold_equivalence_file_vs_segmented(tmp_path):
+    """The same op tape folds identically through both backends, before
+    and after seal/compaction."""
+    a, b = _write_pair(tmp_path)
+    ops = _workload_ops(a, b)
+    jf = FleetJournal(str(tmp_path / "ref.jsonl"))
+    js = _seg_dir(tmp_path)
+    for _, fn in ops:
+        fn(jf)
+        fn(js)
+    assert _folds(js) == _folds(jf)
+    assert js.seal() >= 0 and js.compact()
+    assert jf.compact()
+    assert _folds(js) == _folds(jf)
+
+
+def test_manifest_n_shards_persists_across_reopen(tmp_path):
+    j = _seg_dir(tmp_path, n_shards=4)
+    for i in range(10):
+        j.record_request("r%d" % i, "accepted")
+    j2 = FleetJournal(j.path, n_shards=16)   # constructor loses
+    assert j2.n_shards() == 4
+    assert len(j2.request_states()) == 10
+
+
+def test_sealed_orphan_is_adopted_and_seq_stays_monotone(tmp_path):
+    """A crashed seal (rename landed, manifest update did not) leaves a
+    ``seg-`` orphan that folds still read and whose sequence number the
+    next seal skips past."""
+    j = _seg_dir(tmp_path)
+    j.record_request("orphan-req", "accepted")
+    assert j.seal() == 1
+    man_path = os.path.join(j.path, MANIFEST_NAME)
+    man = json.loads(open(man_path).read())
+    (shard_key, ent), = [(k, v) for k, v in man["shards"].items()
+                         if v["segments"]]
+    (orphan_name,) = ent["segments"]
+    ent["segments"] = []                      # simulate the crashed seal
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+
+    j2 = FleetJournal(j.path)
+    assert j2.request_states()["orphan-req"]["state"] == "accepted"
+    j2.record_request("orphan-req", "running")
+    assert j2.seal() == 1
+    seq_of = lambda n: int(re.search(r"-(\d+)\.jsonl$", n).group(1))
+    names = [n for n in os.listdir(j2.path)
+             if n.startswith("seg-%02d" % int(shard_key))]
+    assert orphan_name in names
+    assert max(seq_of(n) for n in names) > seq_of(orphan_name)
+    assert j2.request_states()["orphan-req"]["state"] == "running"
+
+
+def test_compacted_orphan_is_never_adopted(tmp_path):
+    """A ``cmp-`` file the manifest does not list is a crashed
+    compactor's unpublished output — reading it would double-count, so
+    folds must ignore it."""
+    j = _seg_dir(tmp_path)
+    j.record_request("real", "accepted")
+    shard = stable_shard("req:ghost", j.n_shards())
+    ghost = {"schema": "icln-fleet-journal/1", "event": "req",
+             "req": "ghost", "state": "accepted"}
+    with open(os.path.join(j.path, compacted_name(shard, 99)), "w") as f:
+        f.write(json.dumps(ghost) + "\n")
+    states = FleetJournal(j.path).request_states()
+    assert "real" in states and "ghost" not in states
+
+
+def test_dead_listed_file_is_excluded_then_gced(tmp_path):
+    """A file on the dead list is invisible to folds even while it still
+    exists (crash between manifest swap and unlink), and the next
+    compaction pass actually removes it and clears the entry."""
+    j = _seg_dir(tmp_path)
+    j.record_request("keep", "accepted")
+    shard = stable_shard("req:keep", j.n_shards())
+    assert j.seal() == 1
+    man_path = os.path.join(j.path, MANIFEST_NAME)
+    man = json.loads(open(man_path).read())
+    ent = man["shards"][str(shard)]
+    (seg,) = ent["segments"]
+    # fake a finished compaction whose retirement crashed mid-way: the
+    # cmp output is listed, the input is dead but still on disk
+    cmp_name = compacted_name(shard, 1)
+    with open(os.path.join(j.path, cmp_name), "w") as f:
+        f.write(json.dumps({"schema": "icln-fleet-journal/1",
+                            "event": "req", "req": "keep",
+                            "state": "done"}) + "\n")
+    ent["segments"] = [cmp_name]
+    ent["dead"] = [seg]
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+
+    j2 = FleetJournal(j.path)
+    assert j2.request_states()["keep"]["state"] == "done"
+    j2.compact()                              # drives _gc_dead
+    assert not os.path.exists(os.path.join(j2.path, seg))
+    man = json.loads(open(man_path).read())
+    assert man["shards"][str(shard)]["dead"] == []
+    assert j2.request_states()["keep"]["state"] == "done"
+
+
+def test_torn_tail_heal_counts_and_leaves_flight_event(make_journal):
+    """A torn active tail is healed on the next append — and is COUNTED
+    (journal_torn_heals) and flight-recorded, never silent."""
+    reg = MetricsRegistry()
+    rec = FlightRecorder()
+    set_active(rec)
+    try:
+        j = make_journal(registry=reg)
+        j.record_request("t1", "accepted")
+        if j.backend == "segmented":
+            victim = j.log._active_path(
+                stable_shard("req:t1", j.n_shards()))
+        else:
+            victim = j.path
+        with open(victim, "rb+") as f:
+            f.truncate(os.path.getsize(victim) - 3)   # tear the tail
+        j.record_request("t1", "running")
+        assert reg.snapshot()["counters"]["journal_torn_heals"] == 1
+        events = rec.snapshot("test")["rings"].get("journal", [])
+        assert any(e.get("name") == "torn_heal"
+                   and e.get("backend") == j.backend for e in events)
+        # the torn line is gone, the healed append is authoritative
+        assert j.request_states()["t1"]["state"] == "running"
+        assert fsck_journal(j.path).ok
+    finally:
+        set_active(None)
+
+
+def test_fold_timer_and_compaction_counter(make_journal):
+    reg = MetricsRegistry()
+    j = make_journal(registry=reg)
+    for i in range(5):
+        j.record_request("r%d" % i, "accepted")
+    j.request_states()
+    snap = reg.snapshot()
+    assert snap["histograms"]["journal_fold_s"]["count"] >= 1
+    j.seal()
+    assert j.compact()
+    assert reg.snapshot()["counters"]["journal_compactions"] == 1
+
+
+def test_segment_counts_and_size_bytes(tmp_path):
+    j = _seg_dir(tmp_path, segment_mb=0.0001)   # 100 B: seal every line
+    for i in range(12):
+        j.record_request("r%d" % i, "accepted", paths=["/x/%d" % i])
+    counts = j.segment_counts()
+    assert sum(counts.values()) >= 2
+    assert set(counts) == set(range(j.n_shards()))
+    assert j.size_bytes() > 0
+    assert j.seal() >= 0 and j.compact()
+    assert sum(j.segment_counts().values()) <= sum(counts.values())
+    assert len(j.request_states()) == 12
+
+
+def test_maintenance_lease_is_exclusive(tmp_path):
+    """Two members race for one shard's maint lease: exactly one wins,
+    and release hands it over."""
+    j = _seg_dir(tmp_path)
+    m1 = PoolMembership(j, ttl_s=30.0, member_id="m1", host=1)
+    m2 = PoolMembership(j, ttl_s=30.0, member_id="m2", host=2)
+    assert m1.claim_maintenance(3, now=100.0)
+    assert not m2.claim_maintenance(3, now=101.0)
+    m1.release_maintenance(3, now=102.0)
+    assert m2.claim_maintenance(3, now=103.0)
+    # distinct shards are independent
+    assert m1.claim_maintenance(4, now=103.0)
+
+
+# ------------------------------------------------------- directory fsck
+
+def test_fsck_dir_green_and_counts_segments(tmp_path):
+    a, b = _write_pair(tmp_path)
+    j = _seg_dir(tmp_path)
+    for _, fn in _workload_ops(a, b):
+        fn(j)
+    report = fsck_journal(j.path)
+    assert report.ok
+    assert report.n_segments > 0
+    assert "segment" in report.render_text()
+    reg = MetricsRegistry()
+    record_fsck(reg, report)
+    snap = reg.snapshot()
+    assert snap["gauges"]["journal_fsck_segments"] == report.n_segments
+    # single-file journals report zero segments
+    ref = FleetJournal(str(tmp_path / "ref.jsonl"))
+    ref.record_request("r", "accepted")
+    assert fsck_journal(ref.path).n_segments == 0
+
+
+def test_fsck_dir_missing_manifest_is_error(tmp_path):
+    d = tmp_path / "bare.d"
+    d.mkdir()
+    report = fsck_journal(str(d))
+    assert not report.ok
+    assert any(i.kind == "manifest" for i in report.issues)
+
+
+def test_fsck_dir_bad_manifest_schema_is_error(tmp_path):
+    d = tmp_path / "bad.d"
+    d.mkdir()
+    (d / MANIFEST_NAME).write_text(json.dumps(
+        {"schema": "icln-journal/999", "n_shards": 8, "shards": {}}))
+    report = fsck_journal(str(d))
+    assert not report.ok
+    assert any(i.kind == "manifest" and "schema" in i.message
+               for i in report.issues)
+    assert MANIFEST_SCHEMA in " ".join(i.message for i in report.issues)
+
+
+def test_fsck_dir_listed_segment_missing_is_error(tmp_path):
+    j = _seg_dir(tmp_path)
+    j.record_request("r0", "accepted")
+    assert j.seal() == 1
+    man_path = os.path.join(j.path, MANIFEST_NAME)
+    man = json.loads(open(man_path).read())
+    (name,) = [n for ent in man["shards"].values()
+               for n in ent["segments"]]
+    os.unlink(os.path.join(j.path, name))
+    report = fsck_journal(j.path)
+    assert not report.ok
+    assert any(i.kind == "manifest" and name in i.message
+               for i in report.issues)
+
+
+def test_fsck_dir_flags_misrouted_line(tmp_path):
+    j = _seg_dir(tmp_path)
+    j.record_request("r0", "accepted")
+    entry = {"schema": "icln-fleet-journal/1", "event": "req",
+             "req": "misrouted", "state": "accepted"}
+    home = stable_shard(entry_key(entry), j.n_shards())
+    wrong = (home + 1) % j.n_shards()
+    with open(j.log._active_path(wrong), "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    report = fsck_journal(j.path)
+    assert not report.ok
+    assert any(i.kind == "shard-routing" for i in report.issues)
+
+
+def test_fsck_dir_heals_torn_segment_tail(tmp_path):
+    """A torn tail inside a sealed segment is the heal-aware warning,
+    not an error — exactly the single-file torn-tail contract."""
+    j = _seg_dir(tmp_path)
+    j.record_request("r0", "accepted")
+    j.record_request("r0", "running")
+    shard = stable_shard("req:r0", j.n_shards())
+    victim = j.log._active_path(shard)
+    with open(victim, "rb+") as f:
+        f.truncate(os.path.getsize(victim) - 4)
+    report = fsck_journal(j.path)
+    assert report.ok
+    assert any(i.severity == "warning" and i.kind == "torn-line"
+               for i in report.issues)

@@ -252,11 +252,13 @@ def test_journal_compaction_keeps_live_lines(tmp_path):
     assert j.request_states()["r1"]["state"] == "done"
 
 
-def test_journal_compact_while_appending_loses_nothing(tmp_path):
-    """The flock race drill: writer threads locked_append unique 'done'
-    lines while the main thread compacts repeatedly.  Every line is live
-    (unique paths), so none may be lost to the inode swap."""
-    j = FleetJournal(str(tmp_path / "j.jsonl"))
+def test_journal_compact_while_appending_loses_nothing(make_journal):
+    """The flock race drill, on both backends: writer threads
+    locked_append unique 'done' lines while the main thread compacts
+    repeatedly.  Every line is live (unique paths), so none may be lost
+    to the inode swap (file) or to a seal/manifest-swap race
+    (segmented — the ~2 KB fixture threshold seals constantly here)."""
+    j = make_journal()
     N_THREADS, N_EACH = 4, 40
     errors = []
 
@@ -281,7 +283,7 @@ def test_journal_compact_while_appending_loses_nothing(tmp_path):
     assert not errors
     j.compact()
     paths = {json.loads(ln)["path"]
-             for ln in open(j.path).read().splitlines()}
+             for ln in j.log.scan_text().splitlines() if ln.strip()}
     assert len(paths) == N_THREADS * N_EACH
 
 
@@ -371,11 +373,12 @@ def test_journal_compaction_keeps_live_claims_and_stats(tmp_path):
     assert stats[1] == {"fleet_stolen": 2.0}
 
 
-def test_journal_claim_two_process_flock_race(tmp_path):
+def test_journal_claim_two_process_flock_race(make_journal):
     """Two fresh processes race try_claim on the same work with distinct
-    nonces: the flock'd append serializes them, so exactly one must win
-    — and the journal must stay fully parseable afterwards."""
-    j = FleetJournal(str(tmp_path / "j.jsonl"))
+    nonces, on both backends: the flock'd append serializes them, so
+    exactly one must win — and the journal must stay fully parseable
+    afterwards.  The workers auto-detect the backend from the path."""
+    j = make_journal()
     worker = (
         "import sys\n"
         "from iterative_cleaner_tpu.resilience import FleetJournal\n"
@@ -395,7 +398,7 @@ def test_journal_claim_two_process_flock_race(tmp_path):
     # the fold agrees with the winner's own read-back
     winner = outs.index("WON")
     assert j.claim_table(now=0.0)["w"]["nonce"] == str(winner)
-    for ln in open(j.path).read().splitlines():
+    for ln in j.log.scan_text().splitlines():
         assert json.loads(ln)["event"] == "claim"
 
 
@@ -655,11 +658,19 @@ def _wait_request_done(jpath, rid, proc=None, timeout=240):
     pytest.fail("request %s never reached a terminal state" % rid)
 
 
-def _count_done_lines(jpath):
+def _journal_text(jpath):
+    """The journal's full text on either backend (file or segmented
+    directory) — raw reads in tests go through here."""
+    if os.path.isdir(jpath):
+        return FleetJournal(jpath).log.scan_text()
     if not os.path.exists(jpath):
-        return []
+        return ""
+    return open(jpath).read()
+
+
+def _count_done_lines(jpath):
     out = []
-    for ln in open(jpath).read().splitlines():
+    for ln in _journal_text(jpath).splitlines():
         try:
             e = json.loads(ln)
         except ValueError:
@@ -692,25 +703,34 @@ def _assert_outputs_bit_equal(paths, ref_paths, ext):
 
 
 @pytest.mark.slow
-def test_serve_kill9_restart_zero_duplicate_cleans(tmp_path):
-    """The daemon's crash contract end-to-end: wedge a request mid-fleet
-    with a hang fault, ``kill -9`` the daemon, restart it — the journaled
-    request re-enqueues, already-journaled archives are skipped, and the
-    outputs are byte-identical to an uninterrupted batch CLI run.
-    ``.icar`` outputs are raw little-endian arrays, so byte comparison is
-    exact."""
+def test_serve_kill9_restart_zero_duplicate_cleans(tmp_path,
+                                                   journal_backend):
+    """The daemon's crash contract end-to-end, on both journal backends:
+    wedge a request mid-fleet with a hang fault, ``kill -9`` the daemon,
+    restart it — the journaled request re-enqueues, already-journaled
+    archives are skipped, and the outputs are byte-identical to an
+    uninterrupted batch CLI run.  ``.icar`` outputs are raw little-endian
+    arrays, so byte comparison is exact.  The segmented variant runs
+    with a 10 KB seal threshold, so the crash leaves sealed segments plus
+    a torn active tail for the restart to heal."""
     geoms = [(6, 16, 32)] * 2 + [(8, 16, 32)] * 2
     paths = _write_fleet(tmp_path, geoms, ext=".icar")
     ref_dir = tmp_path / "ref"
     ref_dir.mkdir()
     ref_paths = _write_fleet(ref_dir, geoms, ext=".icar")
     _run_batch_reference(ref_dir, ref_paths)
-    jpath = str(tmp_path / "serve.journal.jsonl")
+    if journal_backend == "segmented":
+        jpath = str(tmp_path / "journal.d")
+        jflags = ["--journal", "journal.d" + os.sep,
+                  "--journal-segment-mb", "0.01"]
+    else:
+        jpath = str(tmp_path / "serve.journal.jsonl")
+        jflags = []
 
     # daemon 1: the 3rd load hangs 600s -> first bucket (2 archives)
     # completes and journals, then the pipeline wedges
     proc, out = _start_daemon(tmp_path,
-                              extra=["--faults", "load:hang@3"],
+                              extra=["--faults", "load:hang@3", *jflags],
                               ICLEAN_FAULT_HANG_S="600")
     _daemon_port(proc, out)
     _spool_submit(str(tmp_path / "spool"), "big",
@@ -732,7 +752,7 @@ def test_serve_kill9_restart_zero_duplicate_cleans(tmp_path):
 
     # daemon 2: same cwd, no faults — recovery re-runs the journaled
     # request; the two journaled archives must not re-clean
-    proc2, out2 = _start_daemon(tmp_path)
+    proc2, out2 = _start_daemon(tmp_path, extra=jflags)
     _daemon_port(proc2, out2)
     assert _wait_request_done(jpath, "big", proc2) == "done"
     assert _sigterm_and_wait(proc2) == 0
@@ -745,6 +765,11 @@ def test_serve_kill9_restart_zero_duplicate_cleans(tmp_path):
     assert states["big"]["n_cleaned"] == 2
     _assert_outputs_bit_equal(paths, ref_paths, ".icar")
     assert "serve: recovered 1 journaled request" in open(out2).read()
+    # whatever the kill -9 left behind, the journal fscks clean
+    from iterative_cleaner_tpu.analysis.journal_fsck import fsck_journal
+
+    report = fsck_journal(jpath)
+    assert report.ok, [i.render() for i in report.issues]
 
 
 def test_serve_sigterm_drains_gracefully(tmp_path):
